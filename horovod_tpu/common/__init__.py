@@ -1,0 +1,26 @@
+"""Framework-neutral core: state, config, lifecycle, error taxonomy.
+
+Structural counterpart of the reference's horovod/common/ (operations.cc,
+common.h, __init__.py). The compiled-path coordinator lives in XLA program
+order; the eager-path native core lives in csrc/ and is loaded lazily by
+horovod_tpu.common.native.
+"""
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.common.config import Config  # noqa: F401
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    AbortedError,
+    HorovodError,
+    HorovodInternalError,
+    InvalidArgumentError,
+    NotInitializedError,
+    PreconditionError,
+)

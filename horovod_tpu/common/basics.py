@@ -1,0 +1,178 @@
+"""Lifecycle + topology API: init/shutdown/rank/size/local_rank/local_size.
+
+Parity surface of the reference's ``HorovodBasics``
+(horovod/common/__init__.py:51-154) and the C init API
+(horovod/common/operations.cc:2413-2468), bound to the TPU pod topology
+instead of MPI_COMM_WORLD:
+
+* ``init()``            -> record jax device/process topology, build the
+                           default 1-D "hvd" mesh, start aux subsystems.
+* ``rank()/size()``     -> chip-granular (see state.py docstring); inside an
+                           SPMD region rank() is the traced mesh index.
+* ``local_rank()/local_size()``  -> position within this host/process.
+* ``mpi_threads_supported()``    -> False (no MPI anywhere), kept for parity.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional, Sequence
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.exceptions import InvalidArgumentError
+from horovod_tpu.common.state import current_spmd_axis, global_state
+
+
+def init(comm: Optional[Sequence[int]] = None) -> None:
+    """Initialize the framework.
+
+    ``comm`` optionally restricts the job to a subset of processes, mirroring
+    ``horovod_init(ranks, nranks)`` (reference operations.cc:1728-1746). On
+    TPU the device set is fixed by the slice topology, so a subset is only
+    honored for process-level eager collectives.
+
+    Safe to call more than once (reference InitializeHorovodOnce,
+    operations.cc:2384-2401).
+    """
+    state = global_state()
+    with state.lock:
+        if state.initialized:
+            return
+        import jax
+
+        # Multi-host: the launcher (horovod_tpu.run) or the TPU runtime sets
+        # the coordinator env; jax.distributed is initialized there. We do
+        # not force it here so single-process usage stays zero-config.
+        state.config = Config.from_env()
+        state.devices = list(jax.devices())
+        state.process_index = jax.process_index()
+        state.process_count = jax.process_count()
+        state.local_device_count = jax.local_device_count()
+        state.global_device_count = jax.device_count()
+        state.subset_ranks = list(comm) if comm is not None else None
+
+        from jax.sharding import Mesh
+        import numpy as np
+
+        state.mesh = Mesh(np.asarray(state.devices), ("hvd",))
+
+        from horovod_tpu.utils.timeline import Timeline
+
+        state.timeline = Timeline(
+            state.config.timeline_path or None,
+            mark_cycles=state.config.timeline_mark_cycles,
+            enabled_rank=state.process_index == 0,
+        )
+
+        state.initialized = True
+        atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    """Coordinated shutdown (reference horovod_shutdown,
+    operations.cc:2425-2439). Flushes the timeline and drops state."""
+    state = global_state()
+    with state.lock:
+        if not state.initialized:
+            return
+        if state.timeline is not None:
+            state.timeline.close()
+        if state.native is not None:
+            state.native.shutdown()
+            state.native = None
+        state.initialized = False
+        state.mesh = None
+        state.devices = []
+
+
+def is_initialized() -> bool:
+    return global_state().initialized
+
+
+def size() -> int:
+    """Total number of chips in the job (reference horovod_size,
+    operations.cc:2448, where the unit was one process == one GPU)."""
+    state = global_state()
+    state.require_init()
+    return state.global_device_count
+
+
+def local_size() -> int:
+    """Chips attached to this process (reference horovod_local_size,
+    operations.cc:2456)."""
+    state = global_state()
+    state.require_init()
+    return state.local_device_count
+
+
+def rank():
+    """Global rank.
+
+    Inside an SPMD region: the traced chip index along the "hvd" mesh axis.
+    Outside: the global index of this process's first chip (so rank()==0
+    selects the logging/checkpointing process, reference horovod_rank
+    operations.cc:2441).
+    """
+    state = global_state()
+    state.require_init()
+    axis = current_spmd_axis()
+    if axis is not None:
+        from jax import lax
+
+        return lax.axis_index(axis)
+    return state.process_index * state.local_device_count
+
+
+def local_rank():
+    """Rank within this process/host (reference horovod_local_rank,
+    operations.cc:2444). Traced inside SPMD regions."""
+    state = global_state()
+    state.require_init()
+    axis = current_spmd_axis()
+    if axis is not None:
+        from jax import lax
+
+        return lax.axis_index(axis) % state.local_device_count
+    return 0
+
+
+def process_rank() -> int:
+    """Index of this process (TPU extension; == jax.process_index())."""
+    state = global_state()
+    state.require_init()
+    return state.process_index
+
+
+def process_count() -> int:
+    """Number of processes (TPU extension; == jax.process_count())."""
+    state = global_state()
+    state.require_init()
+    return state.process_count
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim for horovod_mpi_threads_supported (operations.cc:2462-2468).
+
+    There is no MPI in this framework; always False.
+    """
+    global_state().require_init()
+    return False
+
+
+def mesh():
+    """The default 1-D device mesh with axis name "hvd"."""
+    state = global_state()
+    state.require_init()
+    return state.mesh
+
+
+def check_extension(ext_name: str, ext_env_var: str, path=None) -> None:
+    """Parity shim for HorovodBasics.check_extension
+    (reference horovod/common/__init__.py:43-48): raise if a binding was
+    disabled at build time. All of our bindings are pure-config, so the
+    only failure mode is an explicit opt-out via the env var."""
+    if os.environ.get(ext_env_var, "") in ("0", "false", "False"):
+        raise ImportError(
+            f"Extension {ext_name} has been disabled via {ext_env_var}"
+        )

@@ -1,0 +1,108 @@
+"""Environment-variable configuration surface.
+
+Keeps the reference's env-var names verbatim so scripts written against the
+reference keep working (reference: horovod/common/operations.h:56-66 and the
+parsing block horovod/common/operations.cc:1707-1909).
+
+All values are read lazily at ``hvd.init()`` time into a :class:`Config`
+snapshot, so tests can monkeypatch ``os.environ`` before init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# Reference defaults: 64 MB fusion threshold, 5 ms cycle time
+# (horovod/common/operations.cc:1846, operations.h:56-60).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 5.0
+# Reference: FUSION_BUFFER_ATOMIC_UNIT alignment (operations.h:52-54).
+FUSION_BUFFER_ATOMIC_UNIT = 64
+# Reference: STALL_WARNING_TIME 60s (operations.cc:258).
+DEFAULT_STALL_WARNING_SECS = 60.0
+
+
+def _env_bool(name: str) -> bool:
+    v = os.environ.get(name, "")
+    return v not in ("", "0", "false", "False", "FALSE")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of every runtime knob, read once at init."""
+
+    # Gradient-bucket fusion threshold in bytes (HOROVOD_FUSION_THRESHOLD).
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
+    # Coordinator cycle time in ms — only meaningful for the native eager
+    # backend; the XLA path has no background loop (HOROVOD_CYCLE_TIME).
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    # Chrome-trace timeline output path (HOROVOD_TIMELINE).
+    timeline_path: str = ""
+    timeline_mark_cycles: bool = False
+    # Autotuner (HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_LOG).
+    autotune: bool = False
+    autotune_log: str = ""
+    # Stall detection (HOROVOD_STALL_CHECK_DISABLE).
+    stall_check_disable: bool = False
+    stall_warning_secs: float = DEFAULT_STALL_WARNING_SECS
+    # Hierarchical collectives: on TPU this selects two-level
+    # (ICI x DCN) mesh factorization rather than NCCL+MPI staging
+    # (reference semantics: operations.cc:1284-1436).
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    # Log level (HOROVOD_LOG_LEVEL: trace|debug|info|warning|error|fatal).
+    log_level: str = "warning"
+    log_hide_time: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        return cls(
+            fusion_threshold=_env_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD
+            ),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            timeline_path=os.environ.get("HOROVOD_TIMELINE", ""),
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            autotune=_env_bool("HOROVOD_AUTOTUNE"),
+            autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG", ""),
+            stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            stall_warning_secs=_env_float(
+                "HOROVOD_STALL_WARNING_TIME", DEFAULT_STALL_WARNING_SECS
+            ),
+            hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+            log_hide_time=_env_bool("HOROVOD_LOG_HIDE_TIME"),
+        )
+
+
+def round_to_atomic_unit(nbytes: int) -> int:
+    """Round a buffer size up to the fusion atomic unit.
+
+    Mirrors the reference's FUSION_BUFFER_ATOMIC_UNIT sizing rule
+    (horovod/common/operations.cc:742-764) so bucket boundaries stay aligned
+    for the TPU lane width as well (64 B = 16 f32 lanes).
+    """
+    unit = FUSION_BUFFER_ATOMIC_UNIT
+    return (nbytes + unit - 1) // unit * unit

@@ -1,0 +1,44 @@
+"""Error taxonomy mirroring the reference's Status codes.
+
+Reference: horovod/common/common.h:38-75 defines StatusType
+{OK, UNKNOWN_ERROR, PRECONDITION_ERROR, ABORTED, INVALID_ARGUMENT} — we expose
+them as exception classes so Python callers get idiomatic errors while tests
+can assert on the same failure classes the reference's negotiation produces
+(e.g. mismatched shapes/dtypes across ranks, operations.cc:321-523).
+"""
+
+
+class HorovodError(Exception):
+    """Base class for all framework errors (UNKNOWN_ERROR)."""
+
+
+class HorovodInternalError(HorovodError):
+    """Unexpected internal failure."""
+
+
+class NotInitializedError(HorovodError):
+    """An API requiring ``hvd.init()`` was called before initialization.
+
+    Reference: horovod/common/operations.cc:2441-2468 returns -1 / raises when
+    rank()/size() are called before init.
+    """
+
+
+class PreconditionError(HorovodError):
+    """PRECONDITION_ERROR: op submitted in an invalid state (e.g. duplicate
+    in-flight tensor name, reference operations.cc:2497-2506)."""
+
+
+class AbortedError(HorovodError):
+    """ABORTED: collective cancelled by coordinated shutdown
+    (reference SHUT_DOWN_ERROR, operations.cc:263-268)."""
+
+
+class InvalidArgumentError(HorovodError, ValueError):
+    """INVALID_ARGUMENT: rank-inconsistent dtype/shape/device/root detected by
+    negotiation (reference ConstructMPIResponse, operations.cc:321-523)."""
+
+
+class StalledTensorWarning(UserWarning):
+    """Emitted when a tensor sits un-negotiated past the stall deadline
+    (reference CheckForStalledTensors, operations.cc:1625-1672)."""

@@ -1,0 +1,86 @@
+"""Process-global framework state.
+
+TPU-native analogue of the reference's ``HorovodGlobalState``
+(horovod/common/operations.cc:115-249). On TPU there is no background
+coordinator thread for the compiled path — XLA executes collectives in
+program order across identical SPMD replicas — so the state reduces to:
+
+* the device set and the default 1-D ``"hvd"`` mesh over it,
+* process/topology info (the reference derived rank/local_rank/size by
+  splitting MPI_COMM_WORLD, operations.cc:1748-1797; we read the JAX
+  runtime's pod topology),
+* a config snapshot, the timeline, and the (optional) native eager core.
+
+Rank semantics (documented divergence from the reference): the unit of
+parallelism is the **chip**. ``size()`` is the number of chips in the job and
+inside an SPMD region ``rank()`` is the chip's mesh index. Outside SPMD
+regions there is one Python rank per *process*; ``rank()`` returns the global
+index of the process's first chip so that ``rank() == 0`` keeps its
+Horovod meaning of "the process that logs/checkpoints".
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any, Optional
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.exceptions import NotInitializedError
+
+
+class GlobalState:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.config: Config = Config()
+        # jax.sharding.Mesh over all devices with axis "hvd".
+        self.mesh: Any = None
+        self.devices: list = []
+        self.process_index: int = 0
+        self.process_count: int = 1
+        self.local_device_count: int = 0
+        self.global_device_count: int = 0
+        # Optional sub-group of ranks passed to init(ranks) — reference
+        # horovod_init(ranks, nranks) operations.cc:1728-1746.
+        self.subset_ranks: Optional[list] = None
+        # Aux subsystems, created lazily at init.
+        self.timeline: Any = None
+        self.autotuner: Any = None
+        # Native eager core handle (ctypes), used by the torch/numpy
+        # eager backend when running multi-process on CPU.
+        self.native: Any = None
+
+    def require_init(self) -> None:
+        if not self.initialized:
+            raise NotInitializedError(
+                "horovod_tpu has not been initialized; call hvd.init() first."
+            )
+
+
+_global_state = GlobalState()
+
+
+def global_state() -> GlobalState:
+    return _global_state
+
+
+# Axis name of the enclosing SPMD region, set by horovod_tpu.parallel.spmd
+# when tracing a per-chip program. When set, collectives become
+# jax.lax collectives over this axis and rank() returns the traced
+# axis index.
+_spmd_axis: contextvars.ContextVar = contextvars.ContextVar(
+    "horovod_tpu_spmd_axis", default=None
+)
+
+
+def current_spmd_axis():
+    return _spmd_axis.get()
+
+
+def set_spmd_axis(axis):
+    return _spmd_axis.set(axis)
+
+
+def reset_spmd_axis(token) -> None:
+    _spmd_axis.reset(token)

@@ -1,0 +1,119 @@
+"""horovod_tpu.jax — the flagship framework binding.
+
+Usage mirrors the reference bindings (e.g. ``import horovod.torch as hvd``,
+reference examples/pytorch_synthetic_benchmark.py):
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    @hvd.spmd                      # every chip is a rank
+    def train_step(params, batch):
+        ...
+        return hvd.allreduce(metric), new_params
+"""
+
+from horovod_tpu.common.basics import (
+    check_extension,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_threads_supported,
+    process_count,
+    process_rank,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.jax.mpi_ops import (
+    Average,
+    Handle,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allgatherv,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    grouped_allreduce,
+    poll,
+    reducescatter,
+    synchronize,
+)
+from horovod_tpu.jax.optimizer import (
+    DistributedOptimizer,
+    allreduce_gradients_transform,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    grad,
+    value_and_grad,
+)
+from horovod_tpu.parallel.spmd import spmd, spmd_run
+
+# TF-parity aliases (reference tensorflow/__init__.py:95-115).
+broadcast_variables = broadcast_parameters
+broadcast_global_variables = broadcast_parameters
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "local_rank",
+    "size",
+    "local_size",
+    "process_rank",
+    "process_count",
+    "mesh",
+    "mpi_threads_supported",
+    "check_extension",
+    "allreduce",
+    "allreduce_",
+    "allreduce_async",
+    "allreduce_async_",
+    "grouped_allreduce",
+    "allgather",
+    "allgather_async",
+    "allgatherv",
+    "broadcast",
+    "broadcast_",
+    "broadcast_async",
+    "broadcast_async_",
+    "alltoall",
+    "reducescatter",
+    "poll",
+    "synchronize",
+    "Handle",
+    "Sum",
+    "Average",
+    "Min",
+    "Max",
+    "Product",
+    "Compression",
+    "DistributedOptimizer",
+    "allreduce_gradients_transform",
+    "grad",
+    "value_and_grad",
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "broadcast_object",
+    "broadcast_variables",
+    "broadcast_global_variables",
+    "spmd",
+    "spmd_run",
+]

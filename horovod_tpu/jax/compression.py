@@ -1,0 +1,76 @@
+"""Gradient compression for collective ops.
+
+Parity with the reference compression module (horovod/torch/compression.py
+and horovod/tensorflow/compression.py:33-74): a ``Compressor`` has
+``compress(tensor) -> (tensor, ctx)`` and ``decompress(tensor, ctx)``;
+``Compression.none`` and ``Compression.fp16`` match the reference, and
+``Compression.bf16`` is the TPU-native addition (bfloat16 is the natural
+reduced-precision wire format on TPU: full fp32 exponent range, so no
+scale management, and ICI/MXU operate on it natively).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No-op (reference NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast to float16 before the collective, back after (reference
+    FP16Compressor, tensorflow/compression.py:46-64)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native: cast to bfloat16 on the wire."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

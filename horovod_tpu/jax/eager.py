@@ -1,0 +1,47 @@
+"""Process-level eager collectives.
+
+The reference's eager path moved concrete tensors between OS processes over
+MPI/NCCL from a background thread (operations.cc:1491-1612). The TPU-native
+equivalent moves concrete host arrays between *processes* over the JAX
+distributed runtime (ICI within a slice, DCN across slices) — there is no
+background thread because JAX dispatch is already asynchronous.
+
+Only used when ``jax.process_count() > 1`` (multi-host); single-process jobs
+short-circuit in mpi_ops.py to the reference's size()==1 semantics, and
+pure-CPU multi-process jobs use the native core (horovod_tpu.torch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def process_allreduce(x):
+    """Elementwise sum of each process's array."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.asarray(x))
+    return jnp.sum(gathered, axis=0)
+
+
+def process_allgather(x):
+    """Concatenate each process's array along dim 0 (ragged allowed when
+    trailing dims agree, matching reference allgatherv semantics
+    operations.cc:843-925)."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.asarray(x))
+    # process_allgather stacks along a new leading axis when shapes agree.
+    return jnp.concatenate(list(gathered), axis=0) if gathered.ndim > jnp.asarray(x).ndim else gathered
+
+
+def process_broadcast(x, root_rank: int):
+    """Every process receives process ``root_rank``'s value."""
+    from jax.experimental import multihost_utils
+
+    x = jnp.asarray(x)
+    if root_rank == 0:
+        return multihost_utils.broadcast_one_to_all(x)
+    gathered = multihost_utils.process_allgather(x)
+    return gathered[root_rank]

@@ -1,0 +1,1 @@
+"""Compute ops: Pallas TPU kernels and XLA-fused building blocks."""

@@ -1,0 +1,10 @@
+"""Parallelism strategies over device meshes.
+
+The reference supported exactly one strategy — data parallelism (SURVEY §2.9)
+— delegated to MPI/NCCL rings. Here DP is one axis of a general
+``jax.sharding.Mesh``; this package adds the TPU-first strategies the
+hardware makes natural: tensor parallelism, sequence/context parallelism
+(ring attention, all-to-all), pipeline parallelism, and expert parallelism.
+"""
+
+from horovod_tpu.parallel.spmd import axis_size, spmd, spmd_run  # noqa: F401

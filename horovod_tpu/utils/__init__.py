@@ -1,0 +1,1 @@
+"""Auxiliary subsystems: timeline, logging, autotune glue."""

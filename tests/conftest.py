@@ -1,0 +1,34 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+The reference ran its suite under ``mpirun -np N`` so the same tests covered
+size 1 and size N (reference test/common.py:25-58). The TPU-native
+equivalent: force the JAX host platform to expose 8 virtual CPU devices and
+run every SPMD test over that mesh — sharding semantics (psum, all_gather,
+shard_map partitioning) are platform-independent, so what compiles and
+passes here compiles on a v5e slice.
+
+Note: this image's sitecustomize imports jax at interpreter startup (axon
+PJRT plugin), so JAX_PLATFORMS in the shell env is already consumed;
+``jax.config.update`` is the reliable override, and XLA_FLAGS is still read
+lazily at first backend init.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    return hvd

@@ -1,0 +1,60 @@
+"""Lifecycle and topology tests.
+
+Ports the reference's rank/size assertions (test/test_tensorflow.py:63-75,
+which compared hvd.rank()/size() against mpirun env vars) to the 8-device
+virtual mesh.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.common.exceptions import NotInitializedError
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_size_is_device_count(hvd):
+    import jax
+
+    assert hvd.size() == jax.device_count() == 8
+
+
+def test_local_size(hvd):
+    import jax
+
+    assert hvd.local_size() == jax.local_device_count()
+
+
+def test_rank_outside_spmd_is_process_lead(hvd):
+    assert int(hvd.rank()) == 0
+    assert int(hvd.local_rank()) == 0
+
+
+def test_rank_inside_spmd_is_chip_index(hvd):
+    import jax.numpy as jnp
+
+    ranks = hvd.spmd_run(
+        lambda: hvd.allgather(jnp.asarray(hvd.rank(), jnp.int32)[None])
+    )
+    assert list(np.asarray(ranks)) == list(range(8))
+
+
+def test_mpi_threads_supported_false(hvd):
+    assert hvd.mpi_threads_supported() is False
+
+
+def test_mesh_axis(hvd):
+    assert hvd.mesh().shape["hvd"] == 8
+
+
+def test_require_init():
+    from horovod_tpu.common.state import GlobalState
+
+    st = GlobalState()
+    with pytest.raises(NotInitializedError):
+        st.require_init()

@@ -1,0 +1,110 @@
+"""Regression tests for review findings on the collective layer."""
+
+import gc
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.common.exceptions import InvalidArgumentError
+
+
+def test_allreduce_product(hvd):
+    def fn():
+        t = np.full((3,), 2.0, np.float32)
+        return hvd.allreduce(t, op=hvd.Product)
+
+    out = np.asarray(hvd.spmd_run(fn))
+    np.testing.assert_allclose(out, 2.0**8)
+
+
+def test_grouped_allreduce_min_max(hvd):
+    def fn():
+        t1 = np.ones((4,), np.float32) * hvd.rank().astype(np.float32)
+        t2 = np.ones((4,), np.float32) * hvd.rank().astype(np.float32)
+        mn = hvd.grouped_allreduce([t1, t2], op=hvd.Min)
+        mx = hvd.grouped_allreduce([t1, t2], op=hvd.Max)
+        return mn[0], mx[1]
+
+    mn, mx = hvd.spmd_run(fn)
+    np.testing.assert_allclose(np.asarray(mn), 0.0)
+    np.testing.assert_allclose(np.asarray(mx), 7.0)
+
+
+def test_submesh_average_uses_axis_size(hvd):
+    # Averaging on a 4-device sub-mesh must divide by 4, not by the global
+    # device count of 8.
+    import jax
+    from jax.sharding import Mesh
+
+    submesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+
+    def fn():
+        return hvd.allreduce(np.ones((2,), np.float32), average=True)
+
+    out = np.asarray(hvd.spmd_run(fn, mesh=submesh))
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_spmd_broadcast_root_out_of_range_raises(hvd):
+    with pytest.raises(InvalidArgumentError):
+        hvd.spmd_run(
+            lambda: hvd.broadcast(np.ones((2,), np.float32), root_rank=8)
+        )
+
+
+def test_dropped_async_handle_frees_name(hvd):
+    x = np.ones((3,), np.float32)
+    h = hvd.allreduce_async(x, name="droppable")
+    del h
+    gc.collect()
+    h2 = hvd.allreduce_async(x, name="droppable")
+    hvd.synchronize(h2)
+
+
+def test_failed_async_frees_name(hvd):
+    # An async op that raises must not poison its name.
+    bad = np.ones((7, 2), np.float32)
+
+    def submit():
+        return hvd.spmd_run(
+            lambda: hvd.alltoall(bad)
+        )
+
+    with pytest.raises(Exception):
+        hvd.spmd_run(lambda: hvd.alltoall(bad))
+    # Name-level check on the eager surface:
+    with pytest.raises(InvalidArgumentError):
+        hvd.allreduce_async(np.ones(3), name="failing", op=object)
+    h = hvd.allreduce_async(np.ones(3), name="failing")
+    hvd.synchronize(h)
+
+
+def test_name_normalization_applied(hvd):
+    h = hvd.allreduce_async(np.ones(3), name="weird/name:0")
+    assert h.name == "weird_name_0"
+    hvd.synchronize(h)
+
+
+def test_spmd_decorator_kwargs(hvd):
+    @hvd.spmd
+    def step(x, scale=1.0):
+        return hvd.allreduce(x * scale, average=False)
+
+    out = np.asarray(step(np.ones((2,), np.float32), scale=3.0))
+    np.testing.assert_allclose(out, 24.0)
+
+
+def test_timeline_disabled_no_leak(hvd):
+    st = __import__(
+        "horovod_tpu.common.state", fromlist=["global_state"]
+    ).global_state()
+    tl = st.timeline
+    if tl is None or tl._enabled:
+        pytest.skip("timeline enabled in this run")
+    before_tracks = len(tl._tensor_tracks)
+    for _ in range(50):
+        hvd.allreduce(np.ones(2))
+    assert len(tl._tensor_tracks) == before_tracks
+    assert tl._queue.empty()
