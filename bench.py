@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Canonical scaling benchmark: ResNet-50 synthetic data, Horovod protocol.
+
+Mirrors the reference's benchmark protocol exactly
+(reference examples/pytorch_synthetic_benchmark.py:79-110): warmup
+iterations, then ``num_iters`` timed groups of ``num_batches_per_iter``
+training steps; report images/sec ± CI. TPU-native execution: the whole
+step (fwd + bwd + fused gradient allreduce + update) is one XLA program
+run over a 1-D "hvd" mesh of every visible chip.
+
+Prints ONE JSON line:
+    {"metric": "resnet50_img_per_sec_per_chip", "value": N,
+     "unit": "img/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares against the reference's published per-GPU
+absolute throughput: 1656.82 img/s over 16 Pascal GPUs = 103.55 img/s/GPU
+(reference docs/benchmarks.md:22-38) — the only absolute number the
+reference publishes.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16.0  # docs/benchmarks.md:22-38
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--batch-size", type=int, default=64, help="per-chip batch size")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--fp32", action="store_true", help="disable bfloat16 compute")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu import models
+
+    hvd.init()
+    n = hvd.size()
+
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    model = models.build(args.model, num_classes=1000, dtype=dtype)
+    rng = jax.random.PRNGKey(42)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    state, optimizer = models.create_train_state(rng, model, optax.sgd(0.01, momentum=0.9), sample)
+    step_fn = models.make_train_step(model, optimizer, average_loss=False)
+
+    global_batch = args.batch_size * n
+    batch = {
+        "image": jax.random.normal(rng, (global_batch, args.image_size, args.image_size, 3), jnp.float32),
+        "label": jax.random.randint(rng, (global_batch,), 0, 1000),
+    }
+
+    def run_step(state, batch):
+        return hvd.spmd_run(step_fn, state, batch, in_specs=(P(), P("hvd")), out_specs=(P(), P()))
+
+    log = print if hvd.rank() == 0 else (lambda *a, **k: None)
+    log(f"Model: {args.model}, batch size {args.batch_size}/chip, {n} chips "
+        f"({jax.devices()[0].platform})", file=sys.stderr)
+
+    # Warmup (compile included, as in the reference's timeit warmup).
+    for _ in range(args.num_warmup_batches):
+        state, metrics = run_step(state, batch)
+    jax.block_until_ready(state)
+
+    img_secs = []
+    for x in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            state, metrics = run_step(state, batch)
+        jax.block_until_ready(state)
+        elapsed = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / elapsed
+        log(f"Iter #{x}: {img_sec:.1f} img/sec per chip", file=sys.stderr)
+        img_secs.append(img_sec)
+
+    img_sec_mean = float(np.mean(img_secs))
+    img_sec_conf = float(1.96 * np.std(img_secs))
+    log(f"Img/sec per chip: {img_sec_mean:.1f} +-{img_sec_conf:.1f}", file=sys.stderr)
+    log(f"Total img/sec on {n} chip(s): {img_sec_mean * n:.1f} +-{img_sec_conf * n:.1f}",
+        file=sys.stderr)
+
+    if hvd.rank() == 0:
+        print(json.dumps({
+            "metric": "resnet50_img_per_sec_per_chip",
+            "value": round(img_sec_mean, 2),
+            "unit": "img/sec/chip",
+            "vs_baseline": round(img_sec_mean / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,13 +24,16 @@ from horovod_tpu.common.exceptions import InvalidArgumentError
 from horovod_tpu.common.state import current_spmd_axis, global_state
 
 
-def init(comm: Optional[Sequence[int]] = None) -> None:
+def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
     """Initialize the framework.
 
     ``comm`` optionally restricts the job to a subset of processes, mirroring
     ``horovod_init(ranks, nranks)`` (reference operations.cc:1728-1746). On
     TPU the device set is fixed by the slice topology, so a subset is only
     honored for process-level eager collectives.
+
+    ``devices`` optionally restricts the mesh to an explicit device list
+    (TPU extension; the chip-level analogue of the ranks subset).
 
     Safe to call more than once (reference InitializeHorovodOnce,
     operations.cc:2384-2401).
@@ -45,11 +48,22 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
         # the coordinator env; jax.distributed is initialized there. We do
         # not force it here so single-process usage stays zero-config.
         state.config = Config.from_env()
-        state.devices = list(jax.devices())
+        state.devices = list(devices) if devices is not None else list(jax.devices())
         state.process_index = jax.process_index()
         state.process_count = jax.process_count()
-        state.local_device_count = jax.local_device_count()
-        state.global_device_count = jax.device_count()
+        if devices is not None:
+            local_indices = [
+                i
+                for i, d in enumerate(state.devices)
+                if getattr(d, "process_index", 0) == jax.process_index()
+            ]
+            state.local_device_count = len(local_indices)
+            state.global_device_count = len(state.devices)
+            state.first_device_index = local_indices[0] if local_indices else 0
+        else:
+            state.local_device_count = jax.local_device_count()
+            state.global_device_count = jax.device_count()
+            state.first_device_index = jax.process_index() * jax.local_device_count()
         state.subset_ranks = list(comm) if comm is not None else None
 
         from jax.sharding import Mesh
@@ -121,7 +135,7 @@ def rank():
         from jax import lax
 
         return lax.axis_index(axis)
-    return state.process_index * state.local_device_count
+    return state.first_device_index
 
 
 def local_rank():
@@ -133,7 +147,10 @@ def local_rank():
     if axis is not None:
         from jax import lax
 
-        return lax.axis_index(axis) % state.local_device_count
+        # Assumes a uniform chips-per-process layout (true for every TPU
+        # slice topology; device subsets that break it would need a
+        # per-process constant, which would diverge the SPMD programs).
+        return lax.axis_index(axis) % max(state.local_device_count, 1)
     return 0
 
 

@@ -41,6 +41,9 @@ class GlobalState:
         self.process_count: int = 1
         self.local_device_count: int = 0
         self.global_device_count: int = 0
+        # Mesh index of this process's first chip: the value rank() reports
+        # outside SPMD regions (so rank()==0 gates logging/checkpointing).
+        self.first_device_index: int = 0
         # Optional sub-group of ranks passed to init(ranks) — reference
         # horovod_init(ranks, nranks) operations.cc:1728-1746.
         self.subset_ranks: Optional[list] = None

@@ -1,1 +1,35 @@
 """Model zoo used by the examples, benchmarks, and tests."""
+
+from horovod_tpu.models.mnist import MNISTNet
+from horovod_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+    build,
+)
+from horovod_tpu.models.train import (
+    TrainState,
+    create_train_state,
+    cross_entropy_loss,
+    make_eval_step,
+    make_train_step,
+)
+
+__all__ = [
+    "MNISTNet",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "build",
+    "TrainState",
+    "create_train_state",
+    "cross_entropy_loss",
+    "make_eval_step",
+    "make_train_step",
+]

@@ -1,0 +1,33 @@
+"""MNIST convnet — the smoke-test model.
+
+Counterpart of the reference's ``examples/pytorch_mnist.py`` Net
+(reference examples/pytorch_mnist.py:54-69): two convs + dropout + two
+dense layers. Used by the single-process CPU smoke config in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MNISTNet(nn.Module):
+    """Conv(10,5x5) -> pool -> Conv(20,5x5) -> pool -> 50 -> 10, NHWC."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(10, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,157 @@
+"""Data-parallel training-step builder.
+
+This is the TPU-native shape of "one training step, PyTorch" from the
+reference (SURVEY §3.2; reference torch/__init__.py:95-151): forward, local
+backward, cross-rank fused gradient allreduce, optimizer update. Under XLA
+the whole sequence is one compiled program per chip; the reference's
+background-thread negotiation and per-gradient hooks collapse into the
+trace-time bucket fusion in :mod:`horovod_tpu.jax.fusion`.
+
+Usage::
+
+    state, optimizer = create_train_state(rng, model, optax.sgd(0.1), sample)
+    step = make_train_step(model, optimizer)          # pure fn, jit/shard_map-able
+    state, metrics = hvd.spmd_run(step, state, batch,
+                                  in_specs=(P(), P("hvd")),
+                                  out_specs=(P(), P()))
+
+``create_train_state`` returns the (DistributedOptimizer-wrapped) optimizer
+alongside the state; pass that same wrapped optimizer to
+``make_train_step`` so ``opt_state`` and the update chain match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.core import FrozenDict
+
+from horovod_tpu.common.state import current_spmd_axis
+from horovod_tpu.jax import mpi_ops
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+
+def cross_entropy_loss(logits, labels) -> jnp.ndarray:
+    """Mean softmax cross-entropy against integer labels, in fp32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+class TrainState(Dict[str, Any]):
+    """A plain pytree-of-arrays training state: params, batch_stats,
+    opt_state, step. Dict subclass so it flows through jax transforms."""
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: (tuple(s[k] for k in sorted(s)), tuple(sorted(s))),
+    lambda keys, vals: TrainState(zip(keys, vals)),
+)
+
+
+def create_train_state(
+    rng,
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    distributed: bool = True,
+    compression=Compression.none,
+    backward_passes_per_step: int = 1,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    """Initialize params/batch_stats and the (wrapped) optimizer state.
+
+    ``distributed=True`` wraps ``optimizer`` in :func:`DistributedOptimizer`
+    — the one-line change the reference advertised
+    (reference README.md:96-141).
+    """
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", FrozenDict())
+    if distributed:
+        optimizer = DistributedOptimizer(
+            optimizer,
+            compression=compression,
+            backward_passes_per_step=backward_passes_per_step,
+        )
+    opt_state = optimizer.init(params)
+    state = TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+    )
+    return state, optimizer
+
+
+def make_train_step(model, optimizer: optax.GradientTransformation, average_loss: bool = True):
+    """Build the per-rank SPMD training step.
+
+    The returned function takes ``(state, batch)`` where ``batch`` is the
+    *per-rank* shard ``{"image": ..., "label": ...}``, and returns
+    ``(new_state, metrics)``. Collectives inside (gradient psum from
+    DistributedOptimizer, loss pmean) activate when run under
+    ``hvd.spmd_run``; outside SPMD (single process eager) they are
+    identities, matching the reference's size()==1 degradation.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng):
+        outputs, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+        loss = cross_entropy_loss(outputs, batch["label"])
+        return loss, (mutated.get("batch_stats", FrozenDict()), outputs)
+
+    def train_step(state, batch):
+        # Deterministic per-step dropout key, decorrelated across ranks
+        # under SPMD (each rank folds in its axis index).
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), state["step"])
+        axis = current_spmd_axis()
+        if axis is not None:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        (loss, (new_stats, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], state["batch_stats"], batch, rng
+        )
+        # DistributedOptimizer's update performs the fused cross-rank
+        # gradient allreduce before the inner optimizer sees the grads.
+        updates, new_opt_state = optimizer.update(grads, state["opt_state"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        if average_loss:
+            loss = mpi_ops.allreduce(loss, average=True, name="train.loss")
+            accuracy = mpi_ops.allreduce(accuracy, average=True, name="train.accuracy")
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            step=state["step"] + 1,
+        )
+        return new_state, {"loss": loss, "accuracy": accuracy}
+
+    return train_step
+
+
+def make_eval_step(model):
+    """Per-rank evaluation step returning summed (correct, count) so the
+    caller can allreduce totals (the reference's metric-average pattern,
+    examples/pytorch_mnist.py:120-133)."""
+
+    def eval_step(state, batch):
+        logits = model.apply(
+            {"params": state["params"], "batch_stats": state["batch_stats"]},
+            batch["image"],
+            train=False,
+        )
+        loss = cross_entropy_loss(logits, batch["label"])
+        correct = jnp.sum((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        return {"loss": loss, "correct": correct, "count": jnp.asarray(batch["label"].shape[0], jnp.float32)}
+
+    return eval_step

@@ -41,23 +41,23 @@ def axis_size(mesh: Optional[Mesh] = None, axis: str = "hvd") -> int:
     return mesh.shape[axis]
 
 
-def spmd_run(
+def spmd_fn(
     fn,
-    *args,
+    *,
     mesh: Optional[Mesh] = None,
     axis_name: str = "hvd",
     in_specs: Any = P(),
     out_specs: Any = P(),
     check_vma: bool = False,
+    jit: bool = True,
 ):
-    """Run ``fn(*args)`` as a per-chip SPMD program.
+    """Build (once) the compiled SPMD form of ``fn``.
 
-    Defaults treat inputs as replicated (every rank sees the same value, the
-    way every Horovod process loads the same script state) and require
-    outputs to be rank-invariant (e.g. allreduce results). Pass
-    ``out_specs=P("hvd")`` (or a pytree of specs) for per-rank outputs:
-    they come back concatenated along their leading axis, exactly like the
-    reference's allgathered test assertions.
+    Returns ``jit(shard_map(fn'))`` where ``fn'`` activates the "hvd"
+    collective axis for :mod:`horovod_tpu.jax.mpi_ops` at trace time. Build
+    this once and call it every step — the XLA executable is cached, which
+    is the TPU analogue of the reference's compiled graph ops being built
+    once per tensor name (horovod/tensorflow/mpi_ops.py:73-91).
     """
     mesh = mesh or _default_mesh()
 
@@ -76,7 +76,67 @@ def spmd_run(
         out_specs=out_specs,
         check_vma=check_vma,
     )
-    return shmapped(*args)
+    return jax.jit(shmapped) if jit else shmapped
+
+
+# (fn, mesh, axis, specs, check_vma) -> compiled, bounded LRU. The compiled
+# callable closes over fn, so weak keying can never evict; a hard cap keeps
+# per-call lambdas from accumulating executables without bound. Callers who
+# want cache hits must pass a stable fn object (same contract as jax.jit).
+_SPMD_CACHE_MAX = 128
+_spmd_cache: "dict" = {}
+
+
+def _hashable_specs(specs):
+    if isinstance(specs, (list, tuple)):
+        return tuple(_hashable_specs(s) for s in specs)
+    if isinstance(specs, dict):
+        return tuple(sorted((k, _hashable_specs(v)) for k, v in specs.items()))
+    return specs
+
+
+def spmd_run(
+    fn,
+    *args,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "hvd",
+    in_specs: Any = P(),
+    out_specs: Any = P(),
+    check_vma: bool = False,
+):
+    """Run ``fn(*args)`` as a per-chip SPMD program.
+
+    Defaults treat inputs as replicated (every rank sees the same value, the
+    way every Horovod process loads the same script state) and require
+    outputs to be rank-invariant (e.g. allreduce results). Pass
+    ``out_specs=P("hvd")`` (or a pytree of specs) for per-rank outputs:
+    they come back concatenated along their leading axis, exactly like the
+    reference's allgathered test assertions.
+
+    The compiled executable is cached per (fn, mesh, specs): repeated calls
+    with the same ``fn`` object re-dispatch without re-tracing.
+    """
+    mesh = mesh or _default_mesh()
+    try:
+        key = (fn, mesh, axis_name, _hashable_specs(in_specs), _hashable_specs(out_specs), check_vma)
+        compiled = _spmd_cache.pop(key, None)  # pop+reinsert = LRU touch
+    except TypeError:  # unhashable fn or specs: build uncached
+        key = None
+        compiled = None
+    if compiled is None:
+        compiled = spmd_fn(
+            fn,
+            mesh=mesh,
+            axis_name=axis_name,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    if key is not None:
+        _spmd_cache[key] = compiled
+        while len(_spmd_cache) > _SPMD_CACHE_MAX:
+            _spmd_cache.pop(next(iter(_spmd_cache)))
+    return compiled(*args)
 
 
 def spmd(
@@ -95,11 +155,24 @@ def spmd(
     """
 
     def deco(f):
+        # Keyword arguments are bound as (replicated) closure constants —
+        # shard_map partitions only the positional inputs. Reuse one partial
+        # per kwargs combination so repeated calls hit the spmd_run cache
+        # instead of re-tracing every step.
+        partials: dict = {}
+
         @functools.wraps(f)
         def caller(*args, **kwargs):
-            # Keyword arguments are bound as (replicated) closure constants:
-            # shard_map partitions only the positional inputs.
-            fn = functools.partial(f, **kwargs) if kwargs else f
+            if kwargs:
+                try:
+                    pkey = tuple(sorted(kwargs.items()))
+                    fn = partials.get(pkey)
+                    if fn is None:
+                        fn = partials[pkey] = functools.partial(f, **kwargs)
+                except TypeError:  # unhashable kwarg: no caching possible
+                    fn = functools.partial(f, **kwargs)
+            else:
+                fn = f
             return spmd_run(
                 fn,
                 *args,
