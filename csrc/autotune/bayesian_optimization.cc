@@ -1,0 +1,94 @@
+#include "bayesian_optimization.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvdtpu {
+
+namespace {
+double NormalPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+double NormalCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+}  // namespace
+
+void BayesianOptimization::Clear() {
+  x_.clear();
+  y_.clear();
+}
+
+std::vector<double> BayesianOptimization::Normalize(
+    const std::vector<double>& x) const {
+  std::vector<double> z(x.size());
+  for (size_t i = 0; i < x.size(); ++i) {
+    double span = bounds_[i].second - bounds_[i].first;
+    z[i] = span > 0 ? (x[i] - bounds_[i].first) / span : 0.0;
+  }
+  return z;
+}
+
+std::vector<double> BayesianOptimization::Denormalize(
+    const std::vector<double>& z) const {
+  std::vector<double> x(z.size());
+  for (size_t i = 0; i < z.size(); ++i)
+    x[i] = bounds_[i].first + z[i] * (bounds_[i].second - bounds_[i].first);
+  return x;
+}
+
+void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
+  x_.push_back(Normalize(x));
+  y_.push_back(y);
+}
+
+double BayesianOptimization::ExpectedImprovement(
+    const std::vector<double>& z, const GaussianProcess& gp,
+    double best) const {
+  double mu, var;
+  gp.Predict(z, &mu, &var);
+  double sigma = std::sqrt(var);
+  double imp = mu - best - xi_;
+  double u = imp / sigma;
+  return imp * NormalCdf(u) + sigma * NormalPdf(u);
+}
+
+std::vector<double> BayesianOptimization::Suggest() {
+  std::uniform_real_distribution<double> unit(0.0, 1.0);
+  size_t d = bounds_.size();
+  if (x_.size() < 3) {
+    std::vector<double> z(d);
+    for (auto& v : z) v = unit(rng_);
+    return Denormalize(z);
+  }
+  // Normalize targets so the unit-variance GP prior fits.
+  double mean = 0.0;
+  for (double y : y_) mean += y;
+  mean /= y_.size();
+  double sd = 0.0;
+  for (double y : y_) sd += (y - mean) * (y - mean);
+  sd = std::sqrt(sd / y_.size());
+  if (sd < 1e-12) sd = 1.0;
+  std::vector<double> ynorm(y_.size());
+  for (size_t i = 0; i < y_.size(); ++i) ynorm[i] = (y_[i] - mean) / sd;
+
+  GaussianProcess gp;
+  if (!gp.Fit(x_, ynorm)) {
+    std::vector<double> z(d);
+    for (auto& v : z) v = unit(rng_);
+    return Denormalize(z);
+  }
+  double best = *std::max_element(ynorm.begin(), ynorm.end());
+  std::vector<double> best_z(d);
+  double best_ei = -1.0;
+  for (int trial = 0; trial < 512; ++trial) {
+    std::vector<double> z(d);
+    for (auto& v : z) v = unit(rng_);
+    double ei = ExpectedImprovement(z, gp, best);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_z = z;
+    }
+  }
+  return Denormalize(best_z);
+}
+
+}  // namespace hvdtpu

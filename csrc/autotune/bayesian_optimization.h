@@ -1,0 +1,44 @@
+// Expected-improvement Bayesian optimization over a GP surrogate.
+//
+// Role parity with reference horovod/common/optim/bayesian_optimization.h:
+// 31-44 (EI acquisition over a GP). The reference maximized EI with L-BFGS
+// restarts; this rebuild maximizes over a dense random-candidate sweep —
+// equivalent at d=2 with box bounds, and dependency-free.
+#pragma once
+
+#include <cstdint>
+#include <random>
+#include <vector>
+
+#include "gaussian_process.h"
+
+namespace hvdtpu {
+
+class BayesianOptimization {
+ public:
+  // bounds: per-dimension [lo, hi]; work happens in normalized [0,1]^d.
+  explicit BayesianOptimization(
+      std::vector<std::pair<double, double>> bounds, double xi = 0.01,
+      uint64_t seed = 0x5eedULL)
+      : bounds_(std::move(bounds)), xi_(xi), rng_(seed) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+  // Next point to probe (denormalized). Random until >= 3 samples.
+  std::vector<double> Suggest();
+  size_t num_samples() const { return x_.size(); }
+  void Clear();
+
+ private:
+  std::vector<double> Normalize(const std::vector<double>& x) const;
+  std::vector<double> Denormalize(const std::vector<double>& z) const;
+  double ExpectedImprovement(const std::vector<double>& z,
+                             const GaussianProcess& gp, double best) const;
+
+  std::vector<std::pair<double, double>> bounds_;
+  double xi_;
+  std::mt19937_64 rng_;
+  std::vector<std::vector<double>> x_;  // normalized
+  std::vector<double> y_;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,97 @@
+#include "gaussian_process.h"
+
+#include <cmath>
+
+namespace hvdtpu {
+
+bool CholeskyFactor(std::vector<double>* a, int n) {
+  std::vector<double>& m = *a;
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double sum = m[i * n + j];
+      for (int k = 0; k < j; ++k) sum -= m[i * n + k] * m[j * n + k];
+      if (i == j) {
+        if (sum <= 0.0) return false;
+        m[i * n + j] = std::sqrt(sum);
+      } else {
+        m[i * n + j] = sum / m[j * n + j];
+      }
+    }
+    for (int j = i + 1; j < n; ++j) m[i * n + j] = 0.0;
+  }
+  return true;
+}
+
+void CholeskyForwardSub(const std::vector<double>& l, int n,
+                        std::vector<double>* b) {
+  std::vector<double>& v = *b;
+  for (int i = 0; i < n; ++i) {
+    double sum = v[i];
+    for (int k = 0; k < i; ++k) sum -= l[i * n + k] * v[k];
+    v[i] = sum / l[i * n + i];
+  }
+}
+
+void CholeskyBackSub(const std::vector<double>& l, int n,
+                     std::vector<double>* b) {
+  std::vector<double>& v = *b;
+  for (int i = n - 1; i >= 0; --i) {
+    double sum = v[i];
+    for (int k = i + 1; k < n; ++k) sum -= l[k * n + i] * v[k];
+    v[i] = sum / l[i * n + i];
+  }
+}
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return signal_variance_ * std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+bool GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  n_ = static_cast<int>(x.size());
+  if (n_ == 0) return false;
+  x_train_ = x;
+  chol_.assign(static_cast<size_t>(n_) * n_, 0.0);
+  for (int i = 0; i < n_; ++i)
+    for (int j = 0; j < n_; ++j)
+      chol_[i * n_ + j] =
+          Kernel(x[i], x[j]) + (i == j ? noise_variance_ : 0.0);
+  if (!CholeskyFactor(&chol_, n_)) {
+    fitted_ = false;
+    return false;
+  }
+  alpha_ = y;
+  CholeskyForwardSub(chol_, n_, &alpha_);
+  CholeskyBackSub(chol_, n_, &alpha_);
+  fitted_ = true;
+  return true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* variance) const {
+  if (!fitted_) {
+    *mean = 0.0;
+    *variance = signal_variance_;
+    return;
+  }
+  std::vector<double> k(n_);
+  for (int i = 0; i < n_; ++i) k[i] = Kernel(x, x_train_[i]);
+  double mu = 0.0;
+  for (int i = 0; i < n_; ++i) mu += k[i] * alpha_[i];
+  *mean = mu;
+  // var = k(x,x) - v^T v where L v = k.
+  std::vector<double> v = k;
+  CholeskyForwardSub(chol_, n_, &v);
+  double vtv = 0.0;
+  for (int i = 0; i < n_; ++i) vtv += v[i] * v[i];
+  double var = Kernel(x, x) - vtv;
+  *variance = var > 1e-12 ? var : 1e-12;
+}
+
+}  // namespace hvdtpu

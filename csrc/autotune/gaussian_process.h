@@ -1,0 +1,55 @@
+// RBF-kernel Gaussian-process regressor.
+//
+// Role parity with reference horovod/common/optim/gaussian_process.h:32-60
+// (RBF kernel, Cholesky solve). The reference used vendored Eigen + L-BFGS
+// hyperparameter fitting; this rebuild carries its own dense Cholesky (the
+// problem is 2-D with tens of samples — a 30x30 solve) and fixed, scale-
+// normalized hyperparameters, which removes both vendored dependencies.
+#pragma once
+
+#include <vector>
+
+namespace hvdtpu {
+
+class GaussianProcess {
+ public:
+  GaussianProcess(double length_scale = 0.3, double signal_variance = 1.0,
+                  double noise_variance = 1e-4)
+      : length_scale_(length_scale),
+        signal_variance_(signal_variance),
+        noise_variance_(noise_variance) {}
+
+  // X: n samples x d dims (row major, normalized to [0,1]); y: n targets.
+  // Returns false if the kernel matrix is not positive definite.
+  bool Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+
+  // Posterior mean + variance at a point.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* variance) const;
+
+  bool fitted() const { return fitted_; }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_, signal_variance_, noise_variance_;
+  bool fitted_ = false;
+  std::vector<std::vector<double>> x_train_;
+  std::vector<double> alpha_;           // K^-1 y
+  std::vector<double> chol_;            // lower Cholesky factor, row major
+  int n_ = 0;
+};
+
+// Dense lower-Cholesky of a row-major n x n SPD matrix (in/out: `a` becomes
+// L). Returns false when not positive definite.
+bool CholeskyFactor(std::vector<double>* a, int n);
+// Solve L z = b in place.
+void CholeskyForwardSub(const std::vector<double>& l, int n,
+                        std::vector<double>* b);
+// Solve L^T z = b in place.
+void CholeskyBackSub(const std::vector<double>& l, int n,
+                     std::vector<double>* b);
+
+}  // namespace hvdtpu

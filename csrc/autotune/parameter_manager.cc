@@ -1,0 +1,85 @@
+#include "parameter_manager.h"
+
+#include "../logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// Search space: fusion threshold 0..64 MB, cycle time 1..25 ms
+// (reference parameter_manager.cc explored the same knobs).
+constexpr double kMinThresholdMb = 0.0;
+constexpr double kMaxThresholdMb = 64.0;
+constexpr double kMinCycleMs = 1.0;
+constexpr double kMaxCycleMs = 25.0;
+}  // namespace
+
+ParameterManager::ParameterManager()
+    : bayes_({{kMinThresholdMb, kMaxThresholdMb}, {kMinCycleMs, kMaxCycleMs}}) {}
+
+void ParameterManager::Initialize(int rank, const std::string& log_path) {
+  rank_ = rank;
+  if (rank_ == 0 && !log_path.empty()) {
+    log_.open(log_path, std::ios::out | std::ios::trunc);
+  }
+}
+
+bool ParameterManager::Update(int64_t cycle_bytes, double cur_cycle_ms,
+                              int64_t cur_threshold, double* new_cycle_ms,
+                              int64_t* new_threshold) {
+  if (!active_ || converged_ || rank_ != 0) return false;
+  cur_cycle_ms_ = cur_cycle_ms;
+  cur_threshold_ = cur_threshold;
+  auto now = std::chrono::steady_clock::now();
+  if (!window_open_) {
+    window_open_ = true;
+    window_start_ = now;
+    window_bytes_ = 0;
+    window_cycles_ = 0;
+  }
+  window_bytes_ += cycle_bytes;
+  ++window_cycles_;
+  if (window_cycles_ < kCyclesPerSample) return false;
+
+  double elapsed = std::chrono::duration<double>(now - window_start_).count();
+  window_open_ = false;
+  if (elapsed <= 0.0) return false;
+  Score(static_cast<double>(window_bytes_) / elapsed);
+  if (converged_) {
+    *new_cycle_ms = best_cycle_ms_;
+    *new_threshold = best_threshold_;
+    return true;
+  }
+  auto next = bayes_.Suggest();
+  *new_threshold = static_cast<int64_t>(next[0] * 1024.0 * 1024.0);
+  *new_cycle_ms = next[1];
+  return true;
+}
+
+void ParameterManager::Score(double bytes_per_sec) {
+  ++samples_seen_;
+  bool warmup = samples_seen_ <= kWarmupSamples;
+  if (!warmup) {
+    double threshold_mb =
+        static_cast<double>(cur_threshold_) / (1024.0 * 1024.0);
+    bayes_.AddSample({threshold_mb, cur_cycle_ms_}, bytes_per_sec);
+    if (bytes_per_sec > best_score_) {
+      best_score_ = bytes_per_sec;
+      best_cycle_ms_ = cur_cycle_ms_;
+      best_threshold_ = cur_threshold_;
+    }
+  }
+  if (log_.is_open()) {
+    log_ << samples_seen_ << "\t" << (warmup ? "warmup" : "sample") << "\t"
+         << cur_threshold_ << "\t" << cur_cycle_ms_ << "\t" << bytes_per_sec
+         << "\n";
+    log_.flush();
+  }
+  if (samples_seen_ >= kMaxSamples + kWarmupSamples) {
+    converged_ = true;
+    HVD_LOG(INFO) << "autotune converged: fusion_threshold="
+                  << best_threshold_ << " cycle_time_ms=" << best_cycle_ms_
+                  << " score=" << best_score_ << " B/s";
+  }
+}
+
+}  // namespace hvdtpu

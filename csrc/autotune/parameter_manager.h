@@ -1,0 +1,60 @@
+// Joint autotuning of {fusion threshold, cycle time} by throughput score.
+//
+// Role parity with reference horovod/common/parameter_manager.h:35-217:
+// warmup discards, 5-cycle scoring windows of bytes/sec, Bayesian
+// optimization over the joint space, convergence to the best seen, optional
+// score log (HOROVOD_AUTOTUNE_LOG). Divergence from the reference: only
+// rank 0 tunes and there is no cross-rank param broadcast — in this rebuild
+// fusion decisions are made exclusively at rank 0 (the coordinator), and
+// worker cycle pacing is driven by the blocking control round-trip, so
+// tuned values on workers would be dead state.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <fstream>
+#include <string>
+
+#include "bayesian_optimization.h"
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  ParameterManager();
+  void Initialize(int rank, const std::string& log_path);
+  void SetAutoTuning(bool active) { active_ = active; }
+  bool IsAutoTuning() const { return active_; }
+
+  // Called once per cycle with the payload bytes the cycle moved. Returns
+  // true when the caller should adopt *new_cycle_ms / *new_threshold.
+  bool Update(int64_t cycle_bytes, double cur_cycle_ms, int64_t cur_threshold,
+              double* new_cycle_ms, int64_t* new_threshold);
+
+ private:
+  void Score(double bytes_per_sec);
+
+  bool active_ = false;
+  int rank_ = 0;
+  std::ofstream log_;
+
+  static constexpr int kWarmupSamples = 3;    // discarded (reference :38-43)
+  static constexpr int kCyclesPerSample = 10; // scoring window
+  static constexpr int kMaxSamples = 30;      // then converge to best
+
+  BayesianOptimization bayes_;
+  int64_t window_bytes_ = 0;
+  int window_cycles_ = 0;
+  std::chrono::steady_clock::time_point window_start_;
+  bool window_open_ = false;
+
+  int samples_seen_ = 0;
+  double best_score_ = -1.0;
+  double best_cycle_ms_ = 5.0;
+  int64_t best_threshold_ = 64 << 20;
+  double cur_cycle_ms_ = 5.0;
+  int64_t cur_threshold_ = 64 << 20;
+  bool converged_ = false;
+};
+
+}  // namespace hvdtpu

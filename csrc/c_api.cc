@@ -1,0 +1,164 @@
+// extern "C" surface loaded by the Python bindings via ctypes.
+//
+// Role parity with the reference's C init API + enqueue API
+// (horovod/common/operations.h:76-126, operations.cc:2413-2591) and the
+// torch handle API (horovod/torch/handle_manager.h:31-42). The reference
+// exposed one pybind/ctypes symbol per (framework x dtype x op); this
+// rebuild passes a wire dtype id instead, collapsing the surface to one
+// symbol per op.
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "coordinator.h"
+
+using hvdtpu::Coordinator;
+using hvdtpu::DataType;
+using hvdtpu::GlobalCoordinator;
+using hvdtpu::Request;
+using hvdtpu::Status;
+using hvdtpu::StatusType;
+using hvdtpu::TensorShape;
+
+namespace {
+
+// Last error strings per handle, so ctypes callers can fetch the reason
+// after a non-OK wait. Guarded; sized by release discipline in Python.
+std::mutex g_err_mu;
+std::unordered_map<int, std::string> g_errors;
+
+void RecordError(int handle, const Status& s) {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  g_errors[handle] = s.reason();
+}
+
+TensorShape MakeShape(int ndims, const int64_t* dims) {
+  TensorShape shape;
+  shape.dims.assign(dims, dims + ndims);
+  return shape;
+}
+
+int DoEnqueue(Request::Type type, const char* name, void* data, int dtype,
+              int ndims, const int64_t* dims, int root_rank) {
+  int handle = -1;
+  Status s = GlobalCoordinator()->Enqueue(
+      type, name, data, static_cast<DataType>(dtype), MakeShape(ndims, dims),
+      root_rank, &handle);
+  if (!s.ok()) {
+    std::lock_guard<std::mutex> lock(g_err_mu);
+    g_errors[-1] = s.reason();
+    return -1;
+  }
+  return handle;
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvdtpu_init(int rank, int size, int local_rank, int local_size,
+                const char* coord_host, int coord_port, int timeout_ms) {
+  Status s = GlobalCoordinator()->Init(rank, size, local_rank, local_size,
+                                       coord_host ? coord_host : "127.0.0.1",
+                                       coord_port, timeout_ms);
+  if (!s.ok()) {
+    std::lock_guard<std::mutex> lock(g_err_mu);
+    g_errors[-1] = s.reason();
+  }
+  return s.ok() ? 0 : static_cast<int>(s.type());
+}
+
+void hvdtpu_shutdown() { GlobalCoordinator()->Shutdown(); }
+
+int hvdtpu_initialized() { return GlobalCoordinator()->initialized() ? 1 : 0; }
+int hvdtpu_rank() { return GlobalCoordinator()->rank(); }
+int hvdtpu_size() { return GlobalCoordinator()->size(); }
+int hvdtpu_local_rank() { return GlobalCoordinator()->local_rank(); }
+int hvdtpu_local_size() { return GlobalCoordinator()->local_size(); }
+
+int hvdtpu_enqueue_allreduce(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims) {
+  return DoEnqueue(Request::ALLREDUCE, name, data, dtype, ndims, dims, -1);
+}
+
+int hvdtpu_enqueue_allgather(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims) {
+  return DoEnqueue(Request::ALLGATHER, name, data, dtype, ndims, dims, -1);
+}
+
+int hvdtpu_enqueue_broadcast(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims, int root_rank) {
+  return DoEnqueue(Request::BROADCAST, name, data, dtype, ndims, dims,
+                   root_rank);
+}
+
+// 1 = done, 0 = pending.
+int hvdtpu_poll(int handle) {
+  return GlobalCoordinator()->handles().Poll(handle) ? 1 : 0;
+}
+
+// Blocks; returns the StatusType code.
+int hvdtpu_wait(int handle) {
+  Status s = GlobalCoordinator()->handles().Wait(handle);
+  if (!s.ok()) RecordError(handle, s);
+  return static_cast<int>(s.type());
+}
+
+// Copies the error string (empty if none) into buf; returns needed length.
+int hvdtpu_error(int handle, char* buf, int buf_len) {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  auto it = g_errors.find(handle);
+  const std::string& msg = it == g_errors.end() ? "" : it->second;
+  if (buf != nullptr && buf_len > 0) {
+    int n = static_cast<int>(msg.size());
+    if (n >= buf_len) n = buf_len - 1;
+    memcpy(buf, msg.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(msg.size());
+}
+
+// Allgather result: size in bytes (-1 if absent), copy-out, release.
+int64_t hvdtpu_result_size(int handle) {
+  const std::vector<uint8_t>* r = GlobalCoordinator()->Result(handle);
+  return r == nullptr ? -1 : static_cast<int64_t>(r->size());
+}
+
+int hvdtpu_result_copy(int handle, void* dst) {
+  const std::vector<uint8_t>* r = GlobalCoordinator()->Result(handle);
+  if (r == nullptr) return -1;
+  memcpy(dst, r->data(), r->size());
+  return 0;
+}
+
+void hvdtpu_release(int handle) {
+  GlobalCoordinator()->ReleaseResult(handle);
+  GlobalCoordinator()->handles().Release(handle);
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  g_errors.erase(handle);
+}
+
+// Tunables + aux subsystems.
+void hvdtpu_set_fusion_threshold(int64_t bytes) {
+  GlobalCoordinator()->set_fusion_threshold(bytes);
+}
+int64_t hvdtpu_fusion_threshold() {
+  return GlobalCoordinator()->fusion_threshold();
+}
+void hvdtpu_set_cycle_time_ms(double ms) {
+  GlobalCoordinator()->set_cycle_time_ms(ms);
+}
+double hvdtpu_cycle_time_ms() { return GlobalCoordinator()->cycle_time_ms(); }
+
+int hvdtpu_timeline_start(const char* path, int mark_cycles) {
+  GlobalCoordinator()->timeline().Initialize(path, mark_cycles != 0);
+  return GlobalCoordinator()->timeline().Initialized() ? 0 : 1;
+}
+void hvdtpu_timeline_end() { GlobalCoordinator()->timeline().Shutdown(); }
+
+void hvdtpu_enable_autotune(const char* log_path) {
+  GlobalCoordinator()->EnableAutotune(log_path ? log_path : "");
+}
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+#include "collectives.h"
+
+#include <cstring>
+#include <vector>
+
+#include "half.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// Identical segmentation on every rank: first `count % size` segments get
+// one extra element.
+void SegmentBounds(int64_t count, int size, std::vector<int64_t>* starts,
+                   std::vector<int64_t>* lens) {
+  int64_t base = count / size;
+  int64_t rem = count % size;
+  starts->resize(size);
+  lens->resize(size);
+  int64_t off = 0;
+  for (int s = 0; s < size; ++s) {
+    (*starts)[s] = off;
+    (*lens)[s] = base + (s < rem ? 1 : 0);
+    off += (*lens)[s];
+  }
+}
+
+}  // namespace
+
+Status RingAllreduce(Transport* t, void* data, int64_t count, DataType dt) {
+  int size = t->size();
+  int rank = t->rank();
+  if (size == 1 || count == 0) return Status::OK();
+  size_t esz = DataTypeSize(dt);
+  uint8_t* bytes = static_cast<uint8_t*>(data);
+
+  std::vector<int64_t> starts, lens;
+  SegmentBounds(count, size, &starts, &lens);
+  int64_t max_len = 0;
+  for (auto l : lens) max_len = l > max_len ? l : max_len;
+  std::vector<uint8_t> recv_buf(static_cast<size_t>(max_len) * esz);
+
+  // Phase 1 — reduce-scatter: after step k, segment (rank - k) holds the
+  // partial sum of k+1 ranks; after size-1 steps, segment (rank + 1) % size
+  // holds the full sum on this rank.
+  for (int step = 0; step < size - 1; ++step) {
+    int send_seg = (rank - step + size) % size;
+    int recv_seg = (rank - step - 1 + size) % size;
+    Status s = t->SendRecv(bytes + starts[send_seg] * esz,
+                           static_cast<size_t>(lens[send_seg]) * esz,
+                           recv_buf.data(),
+                           static_cast<size_t>(lens[recv_seg]) * esz);
+    if (!s.ok()) return s;
+    ReduceSum(bytes + starts[recv_seg] * esz, recv_buf.data(), lens[recv_seg],
+              dt);
+  }
+
+  // Phase 2 — allgather: circulate the fully-reduced segments.
+  for (int step = 0; step < size - 1; ++step) {
+    int send_seg = (rank + 1 - step + size) % size;
+    int recv_seg = (rank - step + size) % size;
+    Status s = t->SendRecv(bytes + starts[send_seg] * esz,
+                           static_cast<size_t>(lens[send_seg]) * esz,
+                           bytes + starts[recv_seg] * esz,
+                           static_cast<size_t>(lens[recv_seg]) * esz);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherv(Transport* t, const void* in,
+                      const std::vector<int64_t>& counts, size_t elem_size,
+                      void* out) {
+  int size = t->size();
+  int rank = t->rank();
+  std::vector<int64_t> starts(size);
+  int64_t off = 0;
+  for (int s = 0; s < size; ++s) {
+    starts[s] = off;
+    off += counts[s];
+  }
+  uint8_t* obytes = static_cast<uint8_t*>(out);
+  if (obytes + starts[rank] * elem_size != in) {
+    memmove(obytes + starts[rank] * elem_size, in,
+            static_cast<size_t>(counts[rank]) * elem_size);
+  }
+  if (size == 1) return Status::OK();
+  // Circulate: at step k, forward the segment originally owned by
+  // (rank - k), receive the one owned by (rank - k - 1).
+  for (int step = 0; step < size - 1; ++step) {
+    int send_seg = (rank - step + size) % size;
+    int recv_seg = (rank - step - 1 + size) % size;
+    Status s = t->SendRecv(obytes + starts[send_seg] * elem_size,
+                           static_cast<size_t>(counts[send_seg]) * elem_size,
+                           obytes + starts[recv_seg] * elem_size,
+                           static_cast<size_t>(counts[recv_seg]) * elem_size);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status StarBroadcast(Transport* t, void* data, size_t len, int root) {
+  int size = t->size();
+  int rank = t->rank();
+  if (size == 1 || len == 0) return Status::OK();
+  if (root != 0) {
+    if (rank == root) {
+      Status s = t->SendToRank(0, data, len);
+      if (!s.ok()) return s;
+    } else if (rank == 0) {
+      Status s = t->RecvFromRank(root, data, len);
+      if (!s.ok()) return s;
+    }
+  }
+  if (rank == 0) {
+    for (int dst = 1; dst < size; ++dst) {
+      if (dst == root) continue;
+      Status s = t->SendToRank(dst, data, len);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  if (rank == root) return Status::OK();
+  return t->RecvFromRank(0, data, len);
+}
+
+}  // namespace hvdtpu

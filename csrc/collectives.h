@@ -1,0 +1,32 @@
+// CPU collective algorithms over the TCP ring.
+//
+// The reference's data plane delegated to MPI_Allreduce / ncclAllReduce
+// (horovod/common/operations.cc:1491-1586, 1136-1488). This rebuild
+// implements the classic bandwidth-optimal ring algorithms directly —
+// the algorithm Horovod's README describes (ring-allreduce) — over the
+// Transport's persistent ring connections. All ops are synchronous and are
+// only ever called from the coordinator background thread.
+#pragma once
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+// In-place sum-allreduce of `count` elements. Reduce-scatter phase then
+// allgather phase, 2*(size-1) full-duplex ring steps.
+Status RingAllreduce(Transport* t, void* data, int64_t count, DataType dt);
+
+// Allgatherv: every rank contributes `counts[rank]` elements (first-dim
+// ragged, trailing dims equal — validated by the coordinator, reference
+// operations.cc:855-925); `out` receives the rank-ordered concatenation.
+// `in` may alias `out + offset(rank)`.
+Status RingAllgatherv(Transport* t, const void* in,
+                      const std::vector<int64_t>& counts, size_t elem_size,
+                      void* out);
+
+// Broadcast `len` bytes from `root` through the rank-0 star (at most two
+// hops: root -> 0 -> workers).
+Status StarBroadcast(Transport* t, void* data, size_t len, int root);
+
+}  // namespace hvdtpu

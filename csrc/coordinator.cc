@@ -1,0 +1,614 @@
+#include "coordinator.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+#include "autotune/parameter_manager.h"
+#include "collectives.h"
+#include "logging.h"
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------- handles
+
+int HandleManager::Allocate() {
+  std::lock_guard<std::mutex> lock(mu_);
+  int h = next_++;
+  known_[h] = false;
+  return h;
+}
+
+void HandleManager::MarkDone(int handle, const Status& status) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    results_[handle] = status;
+    known_[handle] = true;
+  }
+  cv_.notify_all();
+}
+
+bool HandleManager::Poll(int handle) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = known_.find(handle);
+  return it == known_.end() ? true : it->second;
+}
+
+Status HandleManager::Wait(int handle) {
+  std::unique_lock<std::mutex> lock(mu_);
+  cv_.wait(lock, [&] {
+    auto it = known_.find(handle);
+    return it == known_.end() || it->second;
+  });
+  auto it = results_.find(handle);
+  return it == results_.end() ? Status::OK() : it->second;
+}
+
+Status HandleManager::Get(int handle) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = results_.find(handle);
+  return it == results_.end() ? Status::InProgress() : it->second;
+}
+
+void HandleManager::Release(int handle) {
+  std::lock_guard<std::mutex> lock(mu_);
+  results_.erase(handle);
+  known_.erase(handle);
+}
+
+// ------------------------------------------------------------- coordinator
+
+static double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::atof(v) : dflt;
+}
+
+Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
+                         const std::string& coord_host, int coord_port,
+                         int timeout_ms) {
+  if (initialized_.load()) return Status::OK();
+  rank_ = rank;
+  size_ = size;
+  local_rank_ = local_rank;
+  local_size_ = local_size;
+  shutdown_requested_ = false;
+  shutdown_votes_ = 0;
+  rank_shutdown_.assign(size_, false);
+  last_stall_check_ = std::chrono::steady_clock::now();
+
+  // Env config surface kept verbatim from the reference
+  // (operations.h:56-66, parsing operations.cc:1707-1909).
+  fusion_threshold_ = static_cast<int64_t>(
+      EnvDouble("HOROVOD_FUSION_THRESHOLD", 64.0 * 1024 * 1024));
+  cycle_time_ms_ = EnvDouble("HOROVOD_CYCLE_TIME", 5.0);
+  stall_check_disabled_ = std::getenv("HOROVOD_STALL_CHECK_DISABLE") != nullptr;
+
+  Status s = transport_.Init(rank_, size_, coord_host, coord_port, timeout_ms);
+  if (!s.ok()) return s;
+
+  const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
+  if (timeline_path != nullptr && rank_ == 0) {
+    timeline_.Initialize(timeline_path,
+                         std::getenv("HOROVOD_TIMELINE_MARK_CYCLES") != nullptr);
+  }
+  if (std::getenv("HOROVOD_AUTOTUNE") != nullptr) {
+    const char* log = std::getenv("HOROVOD_AUTOTUNE_LOG");
+    EnableAutotune(log ? log : "");
+  }
+
+  initialized_ = true;
+  background_ = std::thread(&Coordinator::BackgroundLoop, this);
+  HVD_LOG_RANK(DEBUG, rank_) << "coordinator up, size " << size_;
+  return Status::OK();
+}
+
+void Coordinator::EnableAutotune(const std::string& log_path) {
+  if (autotuner_ == nullptr) {
+    autotuner_ = new ParameterManager();
+    autotuner_->Initialize(rank_, log_path);
+    autotuner_->SetAutoTuning(true);
+  }
+}
+
+void Coordinator::Shutdown() {
+  if (!initialized_.load()) return;
+  shutdown_requested_ = true;
+  if (background_.joinable()) background_.join();
+  transport_.Close();
+  timeline_.Shutdown();
+  delete autotuner_;
+  autotuner_ = nullptr;
+  initialized_ = false;
+  {
+    std::lock_guard<std::mutex> lock(results_mu_);
+    results_.clear();
+  }
+  std::lock_guard<std::mutex> lock(table_mu_);
+  tensor_table_.clear();
+  message_queue_.clear();
+  message_table_.clear();
+}
+
+Status Coordinator::Enqueue(Request::Type type, const std::string& name,
+                            void* data, DataType dtype,
+                            const TensorShape& shape, int root_rank,
+                            int* handle_out) {
+  std::lock_guard<std::mutex> lock(table_mu_);
+  if (!initialized_.load() || shutdown_requested_.load())
+    return Status::Aborted("Horovod has been shut down");
+  if (tensor_table_.count(name) > 0) {
+    // Reference rejects duplicate in-flight names at enqueue
+    // (operations.cc:2497-2506).
+    return Status::InvalidArgument("Duplicate tensor name in flight: " + name);
+  }
+  TableEntry entry;
+  entry.name = name;
+  entry.type = type;
+  entry.dtype = dtype;
+  entry.shape = shape;
+  entry.data = data;
+  entry.root_rank = root_rank;
+  entry.handle = handles_.Allocate();
+  entry.enqueued_at = std::chrono::steady_clock::now();
+  *handle_out = entry.handle;
+  tensor_table_[name] = entry;
+
+  Request req;
+  req.request_rank = rank_;
+  req.request_type = type;
+  req.tensor_type = dtype;
+  req.tensor_name = name;
+  req.root_rank = root_rank;
+  req.tensor_shape = shape;
+  message_queue_.push_back(std::move(req));
+  return Status::OK();
+}
+
+const std::vector<uint8_t>* Coordinator::Result(int handle) {
+  std::lock_guard<std::mutex> lock(results_mu_);
+  auto it = results_.find(handle);
+  return it == results_.end() ? nullptr : &it->second;
+}
+
+void Coordinator::ReleaseResult(int handle) {
+  std::lock_guard<std::mutex> lock(results_mu_);
+  results_.erase(handle);
+}
+
+void Coordinator::BackgroundLoop() {
+  while (RunLoopOnce()) {
+    auto cycle = std::chrono::duration<double, std::milli>(cycle_time_ms_.load());
+    std::this_thread::sleep_for(cycle);
+  }
+  // The loop also exits on transport/codec errors (a dead peer); flag
+  // shutdown first so later Enqueue calls are rejected instead of queueing
+  // handles nobody will ever complete.
+  shutdown_requested_ = true;
+  // Drain: everything still pending gets the shutdown error (reference
+  // operations.cc:263-268, 1942-1957).
+  std::lock_guard<std::mutex> lock(table_mu_);
+  for (auto& kv : tensor_table_) {
+    handles_.MarkDone(kv.second.handle,
+                      Status::Aborted("Horovod has been shut down"));
+  }
+  tensor_table_.clear();
+  message_queue_.clear();
+  HVD_LOG_RANK(DEBUG, rank_) << "coordinator loop exited";
+}
+
+bool Coordinator::RunLoopOnce() {
+  timeline_.MarkCycleStart();
+  // 1. Drain the local queue.
+  RequestList my_list;
+  {
+    std::lock_guard<std::mutex> lock(table_mu_);
+    while (!message_queue_.empty()) {
+      my_list.requests.push_back(std::move(message_queue_.front()));
+      message_queue_.pop_front();
+    }
+  }
+  my_list.shutdown = shutdown_requested_.load();
+
+  ResponseList to_perform;
+  if (size_ == 1) {
+    // No negotiation partner: every local tensor is globally ready.
+    std::vector<Response> ready;
+    for (auto& req : my_list.requests) {
+      message_table_[req.tensor_name].requests = {req};
+      ready.push_back(BuildResponse(req.tensor_name));
+    }
+    FuseResponses(&ready);
+    to_perform.responses = std::move(ready);
+    to_perform.shutdown = my_list.shutdown;
+  } else if (rank_ == 0) {
+    // 2a. Coordinator: gather announcements, count readiness, respond.
+    std::vector<uint8_t> mine;
+    SerializeRequestList(my_list, &mine);
+    std::vector<std::vector<uint8_t>> all;
+    Status s = transport_.GatherToRoot(mine, &all);
+    if (!s.ok()) {
+      HVD_LOG_RANK(ERROR, rank_) << "control gather failed: " << s.reason();
+      return false;
+    }
+    std::vector<Response> ready;
+    for (int r = 0; r < size_; ++r) {
+      RequestList list;
+      if (r == 0) {
+        list = std::move(my_list);
+      } else if (!DeserializeRequestList(all[r].data(), all[r].size(), &list)) {
+        HVD_LOG_RANK(ERROR, rank_) << "bad request list from rank " << r;
+        return false;
+      }
+      if (list.shutdown && !rank_shutdown_[r]) {
+        rank_shutdown_[r] = true;
+        ++shutdown_votes_;
+      }
+      HandleRequests(list, &ready);
+    }
+    FuseResponses(&ready);
+    CheckForStalled();
+    to_perform.responses = std::move(ready);
+    // Reference semantics: shutdown once every rank has voted
+    // (operations.cc:2125-2128) so in-flight collectives still finish.
+    to_perform.shutdown = shutdown_votes_ == size_;
+    std::vector<uint8_t> wire;
+    SerializeResponseList(to_perform, &wire);
+    s = transport_.BcastFromRoot(&wire);
+    if (!s.ok()) {
+      HVD_LOG_RANK(ERROR, rank_) << "control bcast failed: " << s.reason();
+      return false;
+    }
+  } else {
+    // 2b. Worker: announce, receive verdicts.
+    std::vector<uint8_t> mine;
+    SerializeRequestList(my_list, &mine);
+    Status s = transport_.GatherToRoot(mine, nullptr);
+    if (!s.ok()) {
+      HVD_LOG_RANK(ERROR, rank_) << "control send failed: " << s.reason();
+      return false;
+    }
+    std::vector<uint8_t> wire;
+    s = transport_.BcastFromRoot(&wire);
+    if (!s.ok()) {
+      HVD_LOG_RANK(ERROR, rank_) << "control recv failed: " << s.reason();
+      return false;
+    }
+    if (!DeserializeResponseList(wire.data(), wire.size(), &to_perform)) {
+      HVD_LOG_RANK(ERROR, rank_) << "bad response list";
+      return false;
+    }
+  }
+
+  // 3. Execute the identical plan in identical order on every rank.
+  int64_t cycle_bytes = 0;
+  for (const auto& response : to_perform.responses) {
+    if (autotuner_ != nullptr && response.response_type != Response::ERROR) {
+      std::lock_guard<std::mutex> lock(table_mu_);
+      for (const auto& nm : response.tensor_names) {
+        auto it = tensor_table_.find(nm);
+        if (it != tensor_table_.end())
+          cycle_bytes += it->second.shape.num_elements() *
+                         static_cast<int64_t>(DataTypeSize(it->second.dtype));
+      }
+    }
+    PerformOperation(response);
+  }
+  if (autotuner_ != nullptr) {
+    double new_cycle_ms;
+    int64_t new_threshold;
+    if (autotuner_->Update(cycle_bytes, cycle_time_ms_.load(),
+                           fusion_threshold_.load(), &new_cycle_ms,
+                           &new_threshold)) {
+      cycle_time_ms_ = new_cycle_ms;
+      fusion_threshold_ = new_threshold;
+    }
+  }
+  return !to_perform.shutdown;
+}
+
+void Coordinator::HandleRequests(const RequestList& list,
+                                 std::vector<Response>* ready) {
+  for (const auto& req : list.requests) {
+    auto& pending = message_table_[req.tensor_name];
+    if (pending.requests.empty()) {
+      pending.first_seen = std::chrono::steady_clock::now();
+      timeline_.NegotiateStart(req.tensor_name,
+                               Request::TypeName(req.request_type));
+    }
+    timeline_.NegotiateRankReady(req.tensor_name, req.request_rank);
+    pending.requests.push_back(req);
+    if (static_cast<int>(pending.requests.size()) == size_) {
+      timeline_.NegotiateEnd(req.tensor_name);
+      ready->push_back(BuildResponse(req.tensor_name));
+    }
+  }
+}
+
+// Cross-rank consistency validation + response construction; parity with
+// ConstructMPIResponse (reference operations.cc:321-523).
+Response Coordinator::BuildResponse(const std::string& name) {
+  auto node = message_table_.extract(name);
+  auto& requests = node.mapped().requests;
+  const Request& first = requests[0];
+  std::string error;
+
+  for (size_t i = 1; i < requests.size() && error.empty(); ++i) {
+    const Request& r = requests[i];
+    if (r.request_type != first.request_type) {
+      error = std::string("Mismatched collective operations: one rank did ") +
+              Request::TypeName(first.request_type) + " and another did " +
+              Request::TypeName(r.request_type) + ".";
+    } else if (r.tensor_type != first.tensor_type) {
+      error = std::string("Mismatched data types: one rank had type ") +
+              DataTypeName(first.tensor_type) + " and another had type " +
+              DataTypeName(r.tensor_type) + ".";
+    } else if (first.request_type == Request::BROADCAST &&
+               r.root_rank != first.root_rank) {
+      error = "Mismatched broadcast root ranks: one rank specified root " +
+              std::to_string(first.root_rank) + " and another " +
+              std::to_string(r.root_rank) + ".";
+    } else if (first.request_type != Request::ALLGATHER &&
+               r.tensor_shape != first.tensor_shape) {
+      error = "Mismatched tensor shapes: one rank sent " +
+              first.tensor_shape.DebugString() + " and another " +
+              r.tensor_shape.DebugString() + ".";
+    } else if (first.request_type == Request::ALLGATHER) {
+      // First dimension may be ragged; rank count and trailing dims must
+      // agree (reference operations.cc:424-464).
+      bool bad = r.tensor_shape.dims.size() != first.tensor_shape.dims.size() ||
+                 r.tensor_shape.dims.empty();
+      for (size_t d = 1; !bad && d < first.tensor_shape.dims.size(); ++d)
+        bad = r.tensor_shape.dims[d] != first.tensor_shape.dims[d];
+      if (bad)
+        error = "Mismatched allgather tensor shapes: every dimension except "
+                "the first must match across ranks.";
+    }
+  }
+  if (first.request_type == Request::BROADCAST &&
+      (first.root_rank < 0 || first.root_rank >= size_)) {
+    error = "Invalid broadcast root rank " + std::to_string(first.root_rank) +
+            ".";
+  }
+  if (first.request_type == Request::ALLGATHER &&
+      first.tensor_shape.dims.empty()) {
+    // Rank-0 tensors cannot be concatenated along a first dimension
+    // (reference rejects these during response construction,
+    // operations.cc:424-464).
+    error = "Allgather requires a tensor with at least one dimension.";
+  }
+
+  Response resp;
+  resp.tensor_names = {name};
+  if (!error.empty()) {
+    resp.response_type = Response::ERROR;
+    resp.error_message = error;
+    return resp;
+  }
+  switch (first.request_type) {
+    case Request::ALLREDUCE:
+      resp.response_type = Response::ALLREDUCE;
+      break;
+    case Request::ALLGATHER: {
+      resp.response_type = Response::ALLGATHER;
+      resp.tensor_sizes.resize(requests.size());
+      for (const auto& r : requests)
+        resp.tensor_sizes[r.request_rank] = r.tensor_shape.dims[0];
+      break;
+    }
+    case Request::BROADCAST:
+      resp.response_type = Response::BROADCAST;
+      break;
+  }
+  return resp;
+}
+
+// Fuse consecutive same-dtype allreduces up to the fusion threshold
+// (reference operations.cc:2160-2264; dtype uniformity stands in for the
+// reference's device/dtype key since this lane has one CPU device).
+void Coordinator::FuseResponses(std::vector<Response>* responses) {
+  std::vector<Response> fused;
+  std::lock_guard<std::mutex> lock(table_mu_);
+  size_t i = 0;
+  while (i < responses->size()) {
+    Response& cur = (*responses)[i];
+    if (cur.response_type != Response::ALLREDUCE) {
+      fused.push_back(std::move(cur));
+      ++i;
+      continue;
+    }
+    auto entry_bytes = [&](const std::string& nm) -> int64_t {
+      auto it = tensor_table_.find(nm);
+      if (it == tensor_table_.end()) return -1;
+      return it->second.shape.num_elements() *
+             static_cast<int64_t>(DataTypeSize(it->second.dtype));
+    };
+    auto entry_dtype = [&](const std::string& nm) -> int {
+      auto it = tensor_table_.find(nm);
+      return it == tensor_table_.end()
+                 ? -1
+                 : static_cast<int>(it->second.dtype);
+    };
+    int64_t total = entry_bytes(cur.tensor_names[0]);
+    int dtype = entry_dtype(cur.tensor_names[0]);
+    size_t j = i + 1;
+    while (j < responses->size() && total >= 0) {
+      Response& nxt = (*responses)[j];
+      if (nxt.response_type != Response::ALLREDUCE) break;
+      int64_t nb = entry_bytes(nxt.tensor_names[0]);
+      if (nb < 0 || entry_dtype(nxt.tensor_names[0]) != dtype) break;
+      if (total + nb > fusion_threshold_.load()) break;
+      cur.tensor_names.push_back(std::move(nxt.tensor_names[0]));
+      total += nb;
+      ++j;
+    }
+    fused.push_back(std::move(cur));
+    i = j;
+  }
+  *responses = std::move(fused);
+}
+
+void Coordinator::PerformOperation(const Response& response) {
+  // Collect the table entries named by the response.
+  std::vector<TableEntry> entries;
+  {
+    std::lock_guard<std::mutex> lock(table_mu_);
+    for (const auto& nm : response.tensor_names) {
+      auto it = tensor_table_.find(nm);
+      if (it == tensor_table_.end()) {
+        HVD_LOG_RANK(ERROR, rank_) << "response names unknown tensor " << nm;
+        continue;
+      }
+      entries.push_back(it->second);
+      tensor_table_.erase(it);
+    }
+  }
+  if (entries.empty()) return;
+
+  if (response.response_type == Response::ERROR) {
+    for (auto& e : entries)
+      handles_.MarkDone(e.handle,
+                        Status::PreconditionError(response.error_message));
+    return;
+  }
+
+  auto fail_all = [&](const Status& s) {
+    for (auto& e : entries) handles_.MarkDone(e.handle, s);
+  };
+
+  switch (response.response_type) {
+    case Response::ALLREDUCE: {
+      for (auto& e : entries) timeline_.Start(e.name, "ALLREDUCE");
+      Status s = Status::OK();
+      if (entries.size() == 1) {
+        // Single tensor: reduce in place, no staging copy (reference
+        // used MPI_IN_PLACE here, operations.cc:1574-1584).
+        TableEntry& e = entries[0];
+        timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
+        s = RingAllreduce(&transport_, e.data, e.shape.num_elements(),
+                          e.dtype);
+        timeline_.ActivityEnd(e.name);
+      } else {
+        // Fused: stage into the fusion buffer, one ring pass, copy back
+        // (reference operations.cc:1491-1586).
+        size_t esz = DataTypeSize(entries[0].dtype);
+        int64_t total_elems = 0;
+        for (auto& e : entries) total_elems += e.shape.num_elements();
+        if (fusion_buffer_.size() < total_elems * esz)
+          fusion_buffer_.resize(total_elems * esz);
+        size_t off = 0;
+        for (auto& e : entries) {
+          timeline_.ActivityStart(e.name, "MEMCPY_IN_FUSION_BUFFER");
+          size_t nb = e.shape.num_elements() * esz;
+          memcpy(fusion_buffer_.data() + off, e.data, nb);
+          off += nb;
+          timeline_.ActivityEnd(e.name);
+        }
+        for (auto& e : entries)
+          timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
+        s = RingAllreduce(&transport_, fusion_buffer_.data(), total_elems,
+                          entries[0].dtype);
+        for (auto& e : entries) timeline_.ActivityEnd(e.name);
+        off = 0;
+        for (auto& e : entries) {
+          timeline_.ActivityStart(e.name, "MEMCPY_OUT_FUSION_BUFFER");
+          size_t nb = e.shape.num_elements() * esz;
+          memcpy(e.data, fusion_buffer_.data() + off, nb);
+          off += nb;
+          timeline_.ActivityEnd(e.name);
+        }
+      }
+      for (auto& e : entries) {
+        timeline_.End(e.name,
+                      e.shape.num_elements() *
+                          static_cast<int64_t>(DataTypeSize(e.dtype)));
+        handles_.MarkDone(e.handle, s);
+      }
+      break;
+    }
+    case Response::ALLGATHER: {
+      // Never fused in this rebuild (the XLA lane buckets instead); the
+      // response carries every rank's first-dim size.
+      TableEntry& e = entries[0];
+      timeline_.Start(e.name, "ALLGATHER");
+      int64_t trailing = 1;
+      for (size_t d = 1; d < e.shape.dims.size(); ++d)
+        trailing *= e.shape.dims[d];
+      std::vector<int64_t> counts;
+      int64_t total = 0;
+      const std::vector<int64_t>& sizes =
+          size_ == 1 ? std::vector<int64_t>{e.shape.dims.empty()
+                                                ? 1
+                                                : e.shape.dims[0]}
+                     : response.tensor_sizes;
+      for (auto fd : sizes) {
+        counts.push_back(fd * trailing);
+        total += fd * trailing;
+      }
+      size_t esz = DataTypeSize(e.dtype);
+      std::vector<uint8_t> out(static_cast<size_t>(total) * esz);
+      timeline_.ActivityStart(e.name, "RING_ALLGATHER");
+      Status s = RingAllgatherv(&transport_, e.data, counts, esz, out.data());
+      timeline_.ActivityEnd(e.name);
+      timeline_.End(e.name, static_cast<int64_t>(out.size()));
+      if (s.ok()) {
+        std::lock_guard<std::mutex> lock(results_mu_);
+        results_[e.handle] = std::move(out);
+      }
+      handles_.MarkDone(e.handle, s);
+      break;
+    }
+    case Response::BROADCAST: {
+      // Never fused (reference asserts a single entry,
+      // operations.cc:1592-1612).
+      TableEntry& e = entries[0];
+      timeline_.Start(e.name, "BROADCAST");
+      size_t nb = e.shape.num_elements() * DataTypeSize(e.dtype);
+      timeline_.ActivityStart(e.name, "STAR_BCAST");
+      Status s = StarBroadcast(&transport_, e.data, nb, e.root_rank);
+      timeline_.ActivityEnd(e.name);
+      timeline_.End(e.name, static_cast<int64_t>(nb));
+      handles_.MarkDone(e.handle, s);
+      break;
+    }
+    case Response::ERROR:
+      fail_all(Status::Unknown("unreachable"));
+      break;
+  }
+}
+
+// Rank-0 stall warning, parity with CheckForStalledTensors
+// (reference operations.cc:1625-1672, 60 s period).
+void Coordinator::CheckForStalled() {
+  if (stall_check_disabled_ || rank_ != 0) return;
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_stall_check_).count() <
+      stall_warning_secs_)
+    return;
+  last_stall_check_ = now;
+  for (const auto& kv : message_table_) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age < stall_warning_secs_) continue;
+    std::vector<bool> ready(size_, false);
+    for (const auto& r : kv.second.requests) ready[r.request_rank] = true;
+    std::string ready_s, missing_s;
+    for (int r = 0; r < size_; ++r) {
+      std::string& target = ready[r] ? ready_s : missing_s;
+      if (!target.empty()) target += ", ";
+      target += std::to_string(r);
+    }
+    HVD_LOG_RANK(WARNING, rank_)
+        << "One or more tensors were submitted to be reduced, gathered or "
+        << "broadcasted by subset of ranks and are waiting for remainder of "
+        << "ranks for more than " << stall_warning_secs_ << " seconds. Tensor: "
+        << kv.first << " [ready ranks: " << ready_s
+        << "] [missing ranks: " << missing_s << "]";
+  }
+}
+
+Coordinator* GlobalCoordinator() {
+  static Coordinator instance;
+  return &instance;
+}
+
+}  // namespace hvdtpu

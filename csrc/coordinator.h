@@ -1,0 +1,152 @@
+// Background coordinator: negotiation, fusion, execution, stall detection.
+//
+// Role parity with the reference's HorovodGlobalState + BackgroundThreadLoop
+// + RunLoopOnce (horovod/common/operations.cc:115-249, 1695, 2030-2380):
+// every process runs a cycle loop that (a) announces locally-ready tensors
+// to rank 0, (b) rank 0 counts global readiness, validates cross-rank
+// consistency, and fuses small allreduces, (c) everyone executes the
+// identical response list in identical order. The data plane is the TCP
+// ring (collectives.h) instead of MPI/NCCL; completion notifies async
+// handles (reference horovod/torch/handle_manager.h:31-42) instead of
+// framework callbacks.
+//
+// On TPU the compiled path bypasses all of this (XLA program order); this
+// coordinator serves the eager CPU lane and hosts the native aux
+// subsystems (timeline, autotuner).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+class ParameterManager;
+
+struct TableEntry {
+  std::string name;
+  Request::Type type;
+  DataType dtype;
+  TensorShape shape;
+  void* data = nullptr;     // caller-owned, in-place for allreduce/broadcast
+  int root_rank = -1;
+  int handle = -1;
+  std::chrono::steady_clock::time_point enqueued_at;
+};
+
+class HandleManager {
+ public:
+  int Allocate();
+  void MarkDone(int handle, const Status& status);
+  bool Poll(int handle);
+  Status Wait(int handle);            // blocks
+  Status Get(int handle);
+  void Release(int handle);
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int next_ = 0;
+  std::unordered_map<int, Status> results_;   // present only when done
+  std::unordered_map<int, bool> known_;
+};
+
+class Coordinator {
+ public:
+  // rank/size describe this job; local_rank/local_size the within-host
+  // grouping (reference derived them by MPI shared-memory split,
+  // operations.cc:1760-1797; here the launcher passes them down).
+  Status Init(int rank, int size, int local_rank, int local_size,
+              const std::string& coord_host, int coord_port, int timeout_ms);
+  void Shutdown();
+  bool initialized() const { return initialized_.load(); }
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  int local_rank() const { return local_rank_; }
+  int local_size() const { return local_size_; }
+
+  // Returns a handle, or a non-OK status for immediate rejection
+  // (duplicate in-flight name, shutdown in progress — reference
+  // operations.cc:2497-2506).
+  Status Enqueue(Request::Type type, const std::string& name, void* data,
+                 DataType dtype, const TensorShape& shape, int root_rank,
+                 int* handle_out);
+
+  HandleManager& handles() { return handles_; }
+  // Allgather result access (valid once the handle is done, until Release).
+  const std::vector<uint8_t>* Result(int handle);
+  void ReleaseResult(int handle);
+
+  // Tunables (reference HOROVOD_FUSION_THRESHOLD / HOROVOD_CYCLE_TIME,
+  // operations.h:56-60; also driven by the autotuner).
+  void set_fusion_threshold(int64_t bytes) { fusion_threshold_ = bytes; }
+  void set_cycle_time_ms(double ms) { cycle_time_ms_ = ms; }
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+  double cycle_time_ms() const { return cycle_time_ms_; }
+
+  NativeTimeline& timeline() { return timeline_; }
+  void EnableAutotune(const std::string& log_path);
+
+ private:
+  void BackgroundLoop();
+  bool RunLoopOnce();   // false -> exit loop
+  // Rank-0: merge one rank's announcement into the message table, returning
+  // the list of tensor names that just became globally ready.
+  void HandleRequests(const RequestList& list, std::vector<Response>* ready);
+  Response BuildResponse(const std::string& name);
+  void FuseResponses(std::vector<Response>* responses);
+  void PerformOperation(const Response& response);
+  void CheckForStalled();
+
+  int rank_ = 0, size_ = 1, local_rank_ = 0, local_size_ = 1;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_requested_{false};
+  Transport transport_;
+  std::thread background_;
+
+  std::mutex table_mu_;
+  std::unordered_map<std::string, TableEntry> tensor_table_;
+  std::deque<Request> message_queue_;
+
+  // Rank-0 negotiation state: name -> requests seen so far + first-seen
+  // time (drives both readiness and the stall warning, reference
+  // operations.cc:105-107, 1625-1672).
+  struct Pending {
+    std::vector<Request> requests;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<std::string, Pending> message_table_;
+  int shutdown_votes_ = 0;
+  std::vector<bool> rank_shutdown_;
+  std::chrono::steady_clock::time_point last_stall_check_;
+
+  HandleManager handles_;
+  std::vector<uint8_t> fusion_buffer_;   // FusionBufferManager, one device
+  std::atomic<int64_t> fusion_threshold_{64 * 1024 * 1024};
+  std::atomic<double> cycle_time_ms_{5.0};
+  bool stall_check_disabled_ = false;
+  double stall_warning_secs_ = 60.0;
+
+  std::mutex results_mu_;
+  std::unordered_map<int, std::vector<uint8_t>> results_;  // handle -> bytes
+
+  NativeTimeline timeline_;
+  ParameterManager* autotuner_ = nullptr;  // owned; deleted in Shutdown
+};
+
+Coordinator* GlobalCoordinator();
+
+}  // namespace hvdtpu

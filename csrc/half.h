@@ -1,0 +1,171 @@
+// fp16 / bfloat16 scalar math + elementwise reduction kernels.
+//
+// Role parity with reference horovod/common/half.{h,cc} (custom MPI float16
+// sum op with F16C SIMD fast path, half.cc:27-60). Here the reductions feed
+// the ring-allreduce data plane instead of MPI_Op_create; the F16C path is
+// compiled when the toolchain provides it.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+#include "common.h"
+
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
+namespace hvdtpu {
+
+inline float HalfToFloat(uint16_t h) {
+#if defined(__F16C__)
+  return _cvtsh_ss(h);
+#else
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;  // zero
+    } else {
+      // subnormal: normalize
+      int shift = 0;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3ffu;
+      bits = sign | ((127 - 15 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (mant << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+#endif
+}
+
+inline uint16_t FloatToHalf(float f) {
+#if defined(__F16C__)
+  return _cvtss_sh(f, _MM_FROUND_TO_NEAREST_INT);
+#else
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp >= 0x1f) {
+    // overflow -> inf; nan keeps a mantissa bit
+    uint32_t nan_bit = ((bits & 0x7f800000u) == 0x7f800000u && mant) ? 0x200u : 0;
+    return static_cast<uint16_t>(sign | 0x7c00u | nan_bit);
+  }
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);  // underflow to zero
+    // subnormal half
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    uint32_t round = (mant >> (shift - 1)) & 1u;
+    return static_cast<uint16_t>(sign | (half_mant + round));
+  }
+  uint16_t h = static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+  // round to nearest even
+  uint32_t round_bits = mant & 0x1fffu;
+  if (round_bits > 0x1000u || (round_bits == 0x1000u && (h & 1))) ++h;
+  return h;
+#endif
+}
+
+inline float BFloat16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToBFloat16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu))
+    return static_cast<uint16_t>((bits >> 16) | 0x40u);  // quiet the nan
+  uint32_t lsb = (bits >> 16) & 1u;
+  bits += 0x7fffu + lsb;  // round to nearest even
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+// dst[i] += src[i] elementwise, the inner kernel of the reduce-scatter
+// phase of ring allreduce. bool uses saturating OR-like semantics via sum
+// then clamp at the caller's dtype width (uint8 arithmetic).
+template <typename T>
+inline void SumInto(T* dst, const T* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+inline void ReduceSum(void* dst, const void* src, int64_t count, DataType dt) {
+  switch (dt) {
+    case DataType::HVD_UINT8:
+    case DataType::HVD_BOOL:
+      SumInto(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+              count);
+      break;
+    case DataType::HVD_INT8:
+      SumInto(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+              count);
+      break;
+    case DataType::HVD_UINT16:
+      SumInto(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+              count);
+      break;
+    case DataType::HVD_INT16:
+      SumInto(static_cast<int16_t*>(dst), static_cast<const int16_t*>(src),
+              count);
+      break;
+    case DataType::HVD_INT32:
+      SumInto(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+              count);
+      break;
+    case DataType::HVD_INT64:
+      SumInto(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+              count);
+      break;
+    case DataType::HVD_FLOAT32:
+      SumInto(static_cast<float*>(dst), static_cast<const float*>(src), count);
+      break;
+    case DataType::HVD_FLOAT64:
+      SumInto(static_cast<double*>(dst), static_cast<const double*>(src),
+              count);
+      break;
+    case DataType::HVD_FLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      int64_t i = 0;
+#if defined(__F16C__) && defined(__AVX__)
+      for (; i + 8 <= count; i += 8) {
+        __m256 a = _mm256_cvtph_ps(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(d + i)));
+        __m256 b = _mm256_cvtph_ps(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(s + i)));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(d + i),
+                         _mm256_cvtps_ph(_mm256_add_ps(a, b),
+                                         _MM_FROUND_TO_NEAREST_INT));
+      }
+#endif
+      for (; i < count; ++i)
+        d[i] = FloatToHalf(HalfToFloat(d[i]) + HalfToFloat(s[i]));
+      break;
+    }
+    case DataType::HVD_BFLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = FloatToBFloat16(BFloat16ToFloat(d[i]) + BFloat16ToFloat(s[i]));
+      break;
+    }
+  }
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,71 @@
+#include "logging.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace hvdtpu {
+
+static LogLevel ParseLevel(const char* v) {
+  if (v == nullptr) return LogLevel::WARNING;
+  if (!strcasecmp(v, "trace")) return LogLevel::TRACE;
+  if (!strcasecmp(v, "debug")) return LogLevel::DEBUG;
+  if (!strcasecmp(v, "info")) return LogLevel::INFO;
+  if (!strcasecmp(v, "warning")) return LogLevel::WARNING;
+  if (!strcasecmp(v, "error")) return LogLevel::ERROR;
+  if (!strcasecmp(v, "fatal")) return LogLevel::FATAL;
+  return LogLevel::WARNING;
+}
+
+LogLevel MinLogLevel() {
+  static LogLevel level = ParseLevel(std::getenv("HOROVOD_LOG_LEVEL"));
+  return level;
+}
+
+bool LogHideTimestamp() {
+  static bool hide = std::getenv("HOROVOD_LOG_HIDE_TIME") != nullptr;
+  return hide;
+}
+
+static const char* LevelName(LogLevel l) {
+  switch (l) {
+    case LogLevel::TRACE: return "trace";
+    case LogLevel::DEBUG: return "debug";
+    case LogLevel::INFO: return "info";
+    case LogLevel::WARNING: return "warning";
+    case LogLevel::ERROR: return "error";
+    case LogLevel::FATAL: return "fatal";
+  }
+  return "?";
+}
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level, int rank)
+    : level_(level) {
+  const char* base = strrchr(file, '/');
+  stream_ << "[" << LevelName(level);
+  if (rank >= 0) stream_ << " rank " << rank;
+  stream_ << "] " << (base ? base + 1 : file) << ":" << line << " ";
+}
+
+LogMessage::~LogMessage() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  if (!LogHideTimestamp()) {
+    auto now = std::chrono::system_clock::now();
+    auto t = std::chrono::system_clock::to_time_t(now);
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch()).count() % 1000000;
+    char buf[32];
+    struct tm tm_buf;
+    localtime_r(&t, &tm_buf);
+    strftime(buf, sizeof(buf), "%F %T", &tm_buf);
+    fprintf(stderr, "%s.%06ld: ", buf, static_cast<long>(us));
+  }
+  fprintf(stderr, "%s\n", stream_.str().c_str());
+  if (level_ == LogLevel::FATAL) std::abort();
+}
+
+}  // namespace hvdtpu

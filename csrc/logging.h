@@ -1,0 +1,34 @@
+// Leveled stream logging (parity surface of reference
+// horovod/common/logging.h:22-58: LOG(severity[, rank]) macros with
+// HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME environment control).
+#pragma once
+
+#include <sstream>
+
+namespace hvdtpu {
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARNING = 3, ERROR = 4, FATAL = 5 };
+
+LogLevel MinLogLevel();        // cached from HOROVOD_LOG_LEVEL
+bool LogHideTimestamp();       // cached from HOROVOD_LOG_HIDE_TIME
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level, int rank);
+  ~LogMessage();
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  LogLevel level_;
+};
+
+}  // namespace hvdtpu
+
+#define HVD_LOG_AT(level, rank)                                         \
+  if (static_cast<int>(::hvdtpu::LogLevel::level) >=                    \
+      static_cast<int>(::hvdtpu::MinLogLevel()))                        \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::level, rank).stream()
+
+#define HVD_LOG(level) HVD_LOG_AT(level, -1)
+#define HVD_LOG_RANK(level, rank) HVD_LOG_AT(level, rank)

@@ -1,0 +1,158 @@
+#include "message.h"
+
+#include <cstring>
+
+namespace hvdtpu {
+
+const char* Request::TypeName(Type t) {
+  switch (t) {
+    case ALLREDUCE: return "ALLREDUCE";
+    case ALLGATHER: return "ALLGATHER";
+    case BROADCAST: return "BROADCAST";
+  }
+  return "?";
+}
+
+const char* Response::TypeName(Type t) {
+  switch (t) {
+    case ALLREDUCE: return "ALLREDUCE";
+    case ALLGATHER: return "ALLGATHER";
+    case BROADCAST: return "BROADCAST";
+    case ERROR: return "ERROR";
+  }
+  return "?";
+}
+
+namespace {
+
+class Writer {
+ public:
+  explicit Writer(std::vector<uint8_t>* out) : out_(out) {}
+  void U8(uint8_t v) { out_->push_back(v); }
+  void I32(int32_t v) { Raw(&v, 4); }
+  void I64(int64_t v) { Raw(&v, 8); }
+  void Str(const std::string& s) {
+    I32(static_cast<int32_t>(s.size()));
+    Raw(s.data(), s.size());
+  }
+  void Raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    out_->insert(out_->end(), b, b + n);
+  }
+
+ private:
+  std::vector<uint8_t>* out_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : data_(data), len_(len) {}
+  bool U8(uint8_t* v) { return Raw(v, 1); }
+  bool I32(int32_t* v) { return Raw(v, 4); }
+  bool I64(int64_t* v) { return Raw(v, 8); }
+  bool Str(std::string* s) {
+    int32_t n;
+    if (!I32(&n) || n < 0 || pos_ + static_cast<size_t>(n) > len_) return false;
+    s->assign(reinterpret_cast<const char*>(data_ + pos_), n);
+    pos_ += n;
+    return true;
+  }
+  bool Raw(void* p, size_t n) {
+    if (pos_ + n > len_) return false;
+    memcpy(p, data_ + pos_, n);
+    pos_ += n;
+    return true;
+  }
+
+ private:
+  const uint8_t* data_;
+  size_t len_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+void SerializeRequestList(const RequestList& in, std::vector<uint8_t>* out) {
+  Writer w(out);
+  w.U8(in.shutdown ? 1 : 0);
+  w.I32(static_cast<int32_t>(in.requests.size()));
+  for (const auto& r : in.requests) {
+    w.I32(r.request_rank);
+    w.U8(static_cast<uint8_t>(r.request_type));
+    w.U8(static_cast<uint8_t>(r.tensor_type));
+    w.Str(r.tensor_name);
+    w.I32(r.root_rank);
+    w.I32(static_cast<int32_t>(r.tensor_shape.dims.size()));
+    for (auto d : r.tensor_shape.dims) w.I64(d);
+  }
+}
+
+bool DeserializeRequestList(const uint8_t* data, size_t len, RequestList* out) {
+  Reader rd(data, len);
+  uint8_t shutdown;
+  int32_t n;
+  if (!rd.U8(&shutdown) || !rd.I32(&n) || n < 0) return false;
+  out->shutdown = shutdown != 0;
+  out->requests.clear();
+  out->requests.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    Request r;
+    uint8_t rt, dt;
+    int32_t ndims;
+    if (!rd.I32(&r.request_rank) || !rd.U8(&rt) || !rd.U8(&dt) ||
+        !rd.Str(&r.tensor_name) || !rd.I32(&r.root_rank) || !rd.I32(&ndims) ||
+        ndims < 0)
+      return false;
+    r.request_type = static_cast<Request::Type>(rt);
+    r.tensor_type = static_cast<DataType>(dt);
+    r.tensor_shape.dims.resize(ndims);
+    for (int32_t d = 0; d < ndims; ++d)
+      if (!rd.I64(&r.tensor_shape.dims[d])) return false;
+    out->requests.push_back(std::move(r));
+  }
+  return true;
+}
+
+void SerializeResponseList(const ResponseList& in, std::vector<uint8_t>* out) {
+  Writer w(out);
+  w.U8(in.shutdown ? 1 : 0);
+  w.I32(static_cast<int32_t>(in.responses.size()));
+  for (const auto& r : in.responses) {
+    w.U8(static_cast<uint8_t>(r.response_type));
+    w.I32(static_cast<int32_t>(r.tensor_names.size()));
+    for (const auto& nm : r.tensor_names) w.Str(nm);
+    w.Str(r.error_message);
+    w.I32(static_cast<int32_t>(r.tensor_sizes.size()));
+    for (auto s : r.tensor_sizes) w.I64(s);
+  }
+}
+
+bool DeserializeResponseList(const uint8_t* data, size_t len,
+                             ResponseList* out) {
+  Reader rd(data, len);
+  uint8_t shutdown;
+  int32_t n;
+  if (!rd.U8(&shutdown) || !rd.I32(&n) || n < 0) return false;
+  out->shutdown = shutdown != 0;
+  out->responses.clear();
+  out->responses.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    Response r;
+    uint8_t rt;
+    int32_t nnames, nsizes;
+    if (!rd.U8(&rt) || !rd.I32(&nnames) || nnames < 0) return false;
+    r.response_type = static_cast<Response::Type>(rt);
+    r.tensor_names.resize(nnames);
+    for (int32_t k = 0; k < nnames; ++k)
+      if (!rd.Str(&r.tensor_names[k])) return false;
+    if (!rd.Str(&r.error_message) || !rd.I32(&nsizes) || nsizes < 0)
+      return false;
+    r.tensor_sizes.resize(nsizes);
+    for (int32_t k = 0; k < nsizes; ++k)
+      if (!rd.I64(&r.tensor_sizes[k])) return false;
+    out->responses.push_back(std::move(r));
+  }
+  return true;
+}
+
+}  // namespace hvdtpu

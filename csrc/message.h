@@ -1,0 +1,62 @@
+// Control-plane message types + binary wire codec.
+//
+// Role parity with the reference's MPIRequest/MPIResponse + FlatBuffers
+// wire format (horovod/common/mpi_message.h:44-155, wire/mpi_message.fbs).
+// The rebuild uses a self-describing little-endian length-prefixed codec
+// instead of FlatBuffers: messages are tiny (tensor names + shapes), built
+// once per cycle, and a ~100-line codec removes the vendored dependency.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// One worker's announcement that a tensor is ready (reference
+// mpi_message.h:44-86).
+struct Request {
+  enum Type : uint8_t { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2 };
+  int32_t request_rank = 0;
+  Type request_type = ALLREDUCE;
+  DataType tensor_type = DataType::HVD_FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = -1;  // broadcast only
+  TensorShape tensor_shape;
+
+  static const char* TypeName(Type t);
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+};
+
+// Coordinator verdict: execute these (possibly fused) tensors now, or
+// deliver an error (reference mpi_message.h:112-155).
+struct Response {
+  enum Type : uint8_t { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, ERROR = 3 };
+  Type response_type = ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  // Allgather: first-dimension size contributed by each rank, negotiated at
+  // the coordinator (reference operations.cc:855-925).
+  std::vector<int64_t> tensor_sizes;
+
+  static const char* TypeName(Type t);
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// Codec. Append-to / read-from a byte buffer; all integers little-endian.
+void SerializeRequestList(const RequestList& in, std::vector<uint8_t>* out);
+bool DeserializeRequestList(const uint8_t* data, size_t len, RequestList* out);
+void SerializeResponseList(const ResponseList& in, std::vector<uint8_t>* out);
+bool DeserializeResponseList(const uint8_t* data, size_t len, ResponseList* out);
+
+}  // namespace hvdtpu

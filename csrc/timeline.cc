@@ -1,0 +1,179 @@
+#include "timeline.h"
+
+#include <chrono>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// Tensor names are user-supplied (op name arguments); escape them so one
+// odd name cannot corrupt the whole trace file.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+}  // namespace
+
+NativeTimeline::~NativeTimeline() { Shutdown(); }
+
+int64_t NativeTimeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+             .count() -
+         start_us_;
+}
+
+void NativeTimeline::Initialize(const std::string& path, bool mark_cycles) {
+  if (initialized_) return;
+  file_.open(path, std::ios::out | std::ios::trunc);
+  if (!file_.good()) {
+    HVD_LOG(ERROR) << "failed to open timeline file " << path;
+    return;
+  }
+  start_us_ = 0;
+  start_us_ = NowUs();
+  mark_cycles_ = mark_cycles;
+  // JSON Array Format: open bracket, never closed — chrome accepts it, and
+  // it survives abrupt process death (same choice as the reference,
+  // timeline.cc comment on format).
+  file_ << "[\n";
+  stop_ = false;
+  writer_ = std::thread(&NativeTimeline::WriterLoop, this);
+  initialized_ = true;
+}
+
+void NativeTimeline::Shutdown() {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  file_.close();
+  initialized_ = false;
+}
+
+void NativeTimeline::Enqueue(EventType type, const std::string& tensor,
+                             std::string name, int64_t arg) {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    queue_.push(Record{type, tensor, std::move(name), NowUs(), arg});
+  }
+  cv_.notify_one();
+}
+
+int NativeTimeline::TensorId(const std::string& tensor) {
+  auto it = tensor_ids_.find(tensor);
+  if (it != tensor_ids_.end()) return it->second;
+  int id = static_cast<int>(tensor_ids_.size()) + 1;
+  tensor_ids_[tensor] = id;
+  // pid metadata row so chrome labels the lane with the tensor name.
+  file_ << "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": " << id
+        << ", \"args\": {\"name\": \"" << JsonEscape(tensor) << "\"}},\n";
+  file_ << "{\"name\": \"process_sort_index\", \"ph\": \"M\", \"pid\": " << id
+        << ", \"args\": {\"sort_index\": " << id << "}},\n";
+  return id;
+}
+
+void NativeTimeline::WriterLoop() {
+  while (true) {
+    Record rec;
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait(lock, [&] { return stop_ || !queue_.empty(); });
+      if (queue_.empty()) {
+        if (stop_) break;
+        continue;
+      }
+      rec = std::move(queue_.front());
+      queue_.pop();
+    }
+    int pid = TensorId(rec.tensor);
+    switch (rec.type) {
+      case EventType::BEGIN:
+        file_ << "{\"name\": \"" << JsonEscape(rec.name)
+              << "\", \"ph\": \"B\", \"ts\": " << rec.ts_us << ", \"pid\": "
+              << pid << "},\n";
+        break;
+      case EventType::END:
+        file_ << "{\"ph\": \"E\", \"ts\": " << rec.ts_us << ", \"pid\": "
+              << pid;
+        if (rec.arg >= 0) file_ << ", \"args\": {\"bytes\": " << rec.arg << "}";
+        file_ << "},\n";
+        break;
+      case EventType::INSTANT:
+        file_ << "{\"name\": \"" << JsonEscape(rec.name)
+              << "\", \"ph\": \"i\", \"ts\": " << rec.ts_us << ", \"pid\": "
+              << pid << ", \"s\": \"g\"},\n";
+        break;
+    }
+    file_.flush();
+  }
+}
+
+void NativeTimeline::NegotiateStart(const std::string& tensor,
+                                    const char* op_name) {
+  Enqueue(EventType::BEGIN, tensor, std::string("NEGOTIATE_") + op_name);
+  open_depth_[tensor] = 1;
+}
+
+void NativeTimeline::NegotiateRankReady(const std::string& tensor, int rank) {
+  Enqueue(EventType::INSTANT, tensor, std::to_string(rank));
+}
+
+void NativeTimeline::NegotiateEnd(const std::string& tensor) {
+  Enqueue(EventType::END, tensor, "");
+  open_depth_[tensor] = 0;
+}
+
+void NativeTimeline::Start(const std::string& tensor, const char* op_name) {
+  Enqueue(EventType::BEGIN, tensor, op_name);
+  open_depth_[tensor] = 1;
+}
+
+void NativeTimeline::ActivityStart(const std::string& tensor,
+                                   const std::string& activity) {
+  Enqueue(EventType::BEGIN, tensor, activity);
+  open_depth_[tensor]++;
+}
+
+void NativeTimeline::ActivityEnd(const std::string& tensor) {
+  Enqueue(EventType::END, tensor, "");
+  open_depth_[tensor]--;
+}
+
+void NativeTimeline::End(const std::string& tensor, int64_t result_bytes) {
+  // Close any dangling activity then the top-level event.
+  auto it = open_depth_.find(tensor);
+  int depth = it == open_depth_.end() ? 1 : it->second;
+  for (int i = 0; i < depth - 1; ++i) Enqueue(EventType::END, tensor, "");
+  Enqueue(EventType::END, tensor, "", result_bytes);
+  open_depth_[tensor] = 0;
+}
+
+void NativeTimeline::MarkCycleStart() {
+  if (mark_cycles_) Enqueue(EventType::INSTANT, "cycle", "CYCLE_START");
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,72 @@
+// Chrome-tracing timeline with an async writer thread.
+//
+// Role parity with reference horovod/common/timeline.{h,cc}: a per-tensor
+// state machine (NEGOTIATING -> TOP_LEVEL -> ACTIVITY, timeline.h:75-121)
+// whose transitions are recorded from the coordinator hot path into a
+// bounded queue and drained to disk by a dedicated writer thread
+// (timeline.cc:120-146), so tracing never blocks collectives. The reference
+// used a boost lock-free SPSC queue; this rebuild uses a mutex+condvar MPSC
+// queue — the enqueue cost is a few hundred ns, far below the 5 ms cycle.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+class NativeTimeline {
+ public:
+  ~NativeTimeline();
+  void Initialize(const std::string& path, bool mark_cycles);
+  void Shutdown();
+  bool Initialized() const { return initialized_; }
+
+  // State machine API (reference timeline.h:83-93).
+  void NegotiateStart(const std::string& tensor, const char* op_name);
+  void NegotiateRankReady(const std::string& tensor, int rank);
+  void NegotiateEnd(const std::string& tensor);
+  void Start(const std::string& tensor, const char* op_name);
+  void ActivityStart(const std::string& tensor, const std::string& activity);
+  void ActivityEnd(const std::string& tensor);
+  void End(const std::string& tensor, int64_t result_bytes);
+  void MarkCycleStart();
+
+ private:
+  enum class EventType : uint8_t { BEGIN, END, INSTANT };
+  struct Record {
+    EventType type;
+    std::string tensor;
+    std::string name;
+    int64_t ts_us;
+    int64_t arg = -1;
+  };
+
+  void Enqueue(EventType type, const std::string& tensor, std::string name,
+               int64_t arg = -1);
+  void WriterLoop();
+  int64_t NowUs() const;
+  int TensorId(const std::string& tensor);  // writer thread only
+
+  bool initialized_ = false;
+  bool mark_cycles_ = false;
+  int64_t start_us_ = 0;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<Record> queue_;
+  bool stop_ = false;
+  std::thread writer_;
+
+  std::ofstream file_;
+  std::unordered_map<std::string, int> tensor_ids_;
+  // Depth of open B events per tensor so End can close nesting cleanly.
+  std::unordered_map<std::string, int> open_depth_;
+};
+
+}  // namespace hvdtpu

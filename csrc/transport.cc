@@ -1,0 +1,422 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+void CloseFd(int* fd) {
+  if (*fd >= 0) {
+    ::close(*fd);
+    *fd = -1;
+  }
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+Status SendAll(int fd, const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("send failed: ") + strerror(errno));
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status RecvAll(int fd, void* data, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("recv failed: ") + strerror(errno));
+    }
+    if (n == 0) return Status::Aborted("peer closed connection");
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status SendFrame(int fd, const std::vector<uint8_t>& buf) {
+  uint64_t len = buf.size();
+  Status s = SendAll(fd, &len, sizeof(len));
+  if (!s.ok()) return s;
+  return buf.empty() ? Status::OK() : SendAll(fd, buf.data(), buf.size());
+}
+
+Status RecvFrame(int fd, std::vector<uint8_t>* buf) {
+  uint64_t len = 0;
+  Status s = RecvAll(fd, &len, sizeof(len));
+  if (!s.ok()) return s;
+  if (len > (1ull << 32))
+    return Status::Unknown("oversized control frame");
+  buf->resize(len);
+  return len == 0 ? Status::OK() : RecvAll(fd, buf->data(), len);
+}
+
+Status ResolveAndConnect(const std::string& host, int port, int timeout_ms,
+                         int* out_fd) {
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_str = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0 || res == nullptr)
+    return Status::Unknown("getaddrinfo(" + host + "): " + gai_strerror(rc));
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  Status last = Status::Unknown("connect never attempted");
+  while (Clock::now() < deadline) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      return Status::Unknown(std::string("socket: ") + strerror(errno));
+    }
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      SetNoDelay(fd);
+      freeaddrinfo(res);
+      *out_fd = fd;
+      return Status::OK();
+    }
+    last = Status::Unknown("connect to " + host + ":" + port_str + ": " +
+                           strerror(errno));
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  freeaddrinfo(res);
+  return last;
+}
+
+Status Listen(int port, int backlog, int* out_fd, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return Status::Unknown(std::string("socket: ") + strerror(errno));
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return Status::Unknown("bind port " + std::to_string(port) + ": " +
+                           strerror(errno));
+  }
+  if (::listen(fd, backlog) < 0) {
+    ::close(fd);
+    return Status::Unknown(std::string("listen: ") + strerror(errno));
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  *out_fd = fd;
+  *out_port = ntohs(addr.sin_port);
+  return Status::OK();
+}
+
+Status AcceptWithDeadline(int listen_fd, Clock::time_point deadline,
+                          int* out_fd) {
+  while (true) {
+    auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - Clock::now()).count();
+    if (remain <= 0) return Status::Aborted("accept timed out");
+    struct pollfd pfd = {listen_fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, static_cast<int>(remain));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("poll: ") + strerror(errno));
+    }
+    if (rc == 0) return Status::Aborted("accept timed out");
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("accept: ") + strerror(errno));
+    }
+    SetNoDelay(fd);
+    *out_fd = fd;
+    return Status::OK();
+  }
+}
+
+// The IP this process presents to a peer at `host` — found by connecting a
+// UDP socket and reading the chosen source address (no packets sent). This
+// replaces the reference's Spark-side NIC ring probe
+// (reference horovod/spark/__init__.py:33-39) for simple topologies.
+std::string LocalIpToward(const std::string& host, int port) {
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_DGRAM;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) !=
+          0 ||
+      res == nullptr)
+    return "127.0.0.1";
+  int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+  std::string ip = "127.0.0.1";
+  if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+    struct sockaddr_in local;
+    socklen_t len = sizeof(local);
+    if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&local), &len) ==
+        0) {
+      char buf[INET_ADDRSTRLEN];
+      if (inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf))) ip = buf;
+    }
+  }
+  if (fd >= 0) ::close(fd);
+  freeaddrinfo(res);
+  return ip;
+}
+
+}  // namespace
+
+Transport::~Transport() { Close(); }
+
+void Transport::Close() {
+  CloseFd(&listen_fd_);
+  for (auto& fd : worker_fds_) CloseFd(&fd);
+  worker_fds_.clear();
+  CloseFd(&coord_fd_);
+  CloseFd(&ring_send_fd_);
+  CloseFd(&ring_recv_fd_);
+  CloseFd(&data_listen_fd_);
+}
+
+Status Transport::Init(int rank, int size, const std::string& coord_host,
+                       int coord_port, int timeout_ms) {
+  rank_ = rank;
+  size_ = size;
+  if (size_ <= 1) return Status::OK();
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+
+  // 1. Control star.
+  if (rank_ == 0) {
+    int actual_port;
+    Status s = Listen(coord_port, size_, &listen_fd_, &actual_port);
+    if (!s.ok()) return s;
+    worker_fds_.assign(size_, -1);
+    for (int i = 1; i < size_; ++i) {
+      int fd;
+      s = AcceptWithDeadline(listen_fd_, deadline, &fd);
+      if (!s.ok()) return s;
+      int32_t peer_rank = -1;
+      s = RecvAll(fd, &peer_rank, sizeof(peer_rank));
+      if (!s.ok()) return s;
+      if (peer_rank < 1 || peer_rank >= size_ || worker_fds_[peer_rank] >= 0) {
+        ::close(fd);
+        return Status::Unknown("bad rank announcement " +
+                               std::to_string(peer_rank));
+      }
+      worker_fds_[peer_rank] = fd;
+    }
+  } else {
+    Status s = ResolveAndConnect(coord_host, coord_port, timeout_ms, &coord_fd_);
+    if (!s.ok()) return s;
+    int32_t my_rank = rank_;
+    s = SendAll(coord_fd_, &my_rank, sizeof(my_rank));
+    if (!s.ok()) return s;
+  }
+
+  // 2. Data-ring address exchange: gather "(host:port)" strings, bcast table.
+  int data_port;
+  Status s = Listen(0, 2, &data_listen_fd_, &data_port);
+  if (!s.ok()) return s;
+  std::string my_host =
+      rank_ == 0 ? coord_host : LocalIpToward(coord_host, coord_port);
+  std::string my_addr = my_host + ":" + std::to_string(data_port);
+  std::vector<uint8_t> mine(my_addr.begin(), my_addr.end());
+  std::vector<std::vector<uint8_t>> all;
+  s = GatherToRoot(mine, &all);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> table;
+  if (rank_ == 0) {
+    for (const auto& a : all) {
+      uint32_t n = static_cast<uint32_t>(a.size());
+      table.insert(table.end(), reinterpret_cast<uint8_t*>(&n),
+                   reinterpret_cast<uint8_t*>(&n) + 4);
+      table.insert(table.end(), a.begin(), a.end());
+    }
+  }
+  s = BcastFromRoot(&table);
+  if (!s.ok()) return s;
+  std::vector<std::string> addrs;
+  for (size_t pos = 0; pos + 4 <= table.size();) {
+    uint32_t n;
+    memcpy(&n, table.data() + pos, 4);
+    pos += 4;
+    if (pos + n > table.size()) return Status::Unknown("bad address table");
+    addrs.emplace_back(reinterpret_cast<const char*>(table.data() + pos), n);
+    pos += n;
+  }
+  if (static_cast<int>(addrs.size()) != size_)
+    return Status::Unknown("address table size mismatch");
+
+  // 3. Ring connect: dial next, accept prev. Dial from a thread so the
+  //    2-rank case (mutual connect) cannot deadlock.
+  int next = (rank_ + 1) % size_;
+  const std::string& next_addr = addrs[next];
+  size_t colon = next_addr.rfind(':');
+  std::string next_host = next_addr.substr(0, colon);
+  int next_port = std::stoi(next_addr.substr(colon + 1));
+  Status dial_status = Status::OK();
+  std::thread dialer([&]() {
+    dial_status = ResolveAndConnect(next_host, next_port, timeout_ms,
+                                    &ring_send_fd_);
+    if (dial_status.ok()) {
+      int32_t my_rank = rank_;
+      dial_status = SendAll(ring_send_fd_, &my_rank, sizeof(my_rank));
+    }
+  });
+  Status accept_status = AcceptWithDeadline(data_listen_fd_, deadline,
+                                            &ring_recv_fd_);
+  int32_t prev_rank = -1;
+  if (accept_status.ok())
+    accept_status = RecvAll(ring_recv_fd_, &prev_rank, sizeof(prev_rank));
+  dialer.join();
+  if (!dial_status.ok()) return dial_status;
+  if (!accept_status.ok()) return accept_status;
+  int expect_prev = (rank_ - 1 + size_) % size_;
+  if (prev_rank != expect_prev)
+    return Status::Unknown("ring wired to wrong peer: got rank " +
+                           std::to_string(prev_rank));
+  HVD_LOG_RANK(DEBUG, rank_) << "transport up: ring " << expect_prev << " -> "
+                             << rank_ << " -> " << next;
+  return Status::OK();
+}
+
+Status Transport::GatherToRoot(const std::vector<uint8_t>& mine,
+                               std::vector<std::vector<uint8_t>>* all) {
+  if (size_ == 1) {
+    if (all) *all = {mine};
+    return Status::OK();
+  }
+  if (rank_ == 0) {
+    all->assign(size_, {});
+    (*all)[0] = mine;
+    for (int i = 1; i < size_; ++i) {
+      Status s = RecvFrame(worker_fds_[i], &(*all)[i]);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return SendFrame(coord_fd_, mine);
+}
+
+Status Transport::BcastFromRoot(std::vector<uint8_t>* buf) {
+  if (size_ == 1) return Status::OK();
+  if (rank_ == 0) {
+    for (int i = 1; i < size_; ++i) {
+      Status s = SendFrame(worker_fds_[i], *buf);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+  return RecvFrame(coord_fd_, buf);
+}
+
+Status Transport::SendToNext(const void* data, size_t len) {
+  return SendAll(ring_send_fd_, data, len);
+}
+
+Status Transport::RecvFromPrev(void* data, size_t len) {
+  return RecvAll(ring_recv_fd_, data, len);
+}
+
+Status Transport::SendRecv(const void* send_data, size_t send_len,
+                           void* recv_data, size_t recv_len) {
+  // Full duplex via poll: both fds nonblocking until each side completes.
+  const uint8_t* sp = static_cast<const uint8_t*>(send_data);
+  uint8_t* rp = static_cast<uint8_t*>(recv_data);
+  size_t sent = 0, recvd = 0;
+  int sflags = fcntl(ring_send_fd_, F_GETFL, 0);
+  int rflags = fcntl(ring_recv_fd_, F_GETFL, 0);
+  fcntl(ring_send_fd_, F_SETFL, sflags | O_NONBLOCK);
+  fcntl(ring_recv_fd_, F_SETFL, rflags | O_NONBLOCK);
+  Status result = Status::OK();
+  while (sent < send_len || recvd < recv_len) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      send_idx = n;
+      pfds[n++] = {ring_send_fd_, POLLOUT, 0};
+    }
+    if (recvd < recv_len) {
+      recv_idx = n;
+      pfds[n++] = {ring_recv_fd_, POLLIN, 0};
+    }
+    int rc = ::poll(pfds, n, -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      result = Status::Unknown(std::string("poll: ") + strerror(errno));
+      break;
+    }
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t m = ::send(ring_send_fd_, sp + sent, send_len - sent,
+                         MSG_NOSIGNAL);
+      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        result = Status::Unknown(std::string("send: ") + strerror(errno));
+        break;
+      }
+      if (m > 0) sent += static_cast<size_t>(m);
+    }
+    if (recv_idx >= 0 &&
+        (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t m = ::recv(ring_recv_fd_, rp + recvd, recv_len - recvd, 0);
+      if (m == 0) {
+        result = Status::Aborted("peer closed connection");
+        break;
+      }
+      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        result = Status::Unknown(std::string("recv: ") + strerror(errno));
+        break;
+      }
+      if (m > 0) recvd += static_cast<size_t>(m);
+    }
+  }
+  fcntl(ring_send_fd_, F_SETFL, sflags);
+  fcntl(ring_recv_fd_, F_SETFL, rflags);
+  return result;
+}
+
+Status Transport::SendToRank(int dst, const void* data, size_t len) {
+  if (dst == rank_) return Status::InvalidArgument("send to self");
+  int fd = rank_ == 0 ? worker_fds_[dst] : (dst == 0 ? coord_fd_ : -1);
+  if (fd < 0) return Status::InvalidArgument("no direct link to rank");
+  return SendAll(fd, data, len);
+}
+
+Status Transport::RecvFromRank(int src, void* data, size_t len) {
+  if (src == rank_) return Status::InvalidArgument("recv from self");
+  int fd = rank_ == 0 ? worker_fds_[src] : (src == 0 ? coord_fd_ : -1);
+  if (fd < 0) return Status::InvalidArgument("no direct link to rank");
+  return RecvAll(fd, data, len);
+}
+
+}  // namespace hvdtpu

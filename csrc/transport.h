@@ -1,0 +1,75 @@
+// TCP transport: self-contained process bootstrap + byte movement.
+//
+// The reference's L0/L1 were the MPI runtime: mpirun placed processes and
+// MPI_Gather/Gatherv/Bcast moved control messages while MPI/NCCL moved
+// tensor bytes (reference horovod/common/operations.cc:2089-2109,
+// 2281-2287, 1491-1586). This rebuild has no MPI: the control plane is a
+// star of persistent TCP connections to rank 0, and the data plane is a
+// TCP ring (rank r -> rank (r+1) % size) over which the classic
+// ring-allreduce / ring-allgather run.
+//
+// Bootstrap: every rank knows the coordinator address (from the launcher's
+// env). Workers connect and announce their rank; each rank opens a data
+// listener on an ephemeral port; the (host, port) table is gathered to
+// rank 0 and broadcast back; then the ring connects.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class Transport {
+ public:
+  Transport() = default;
+  ~Transport();
+  Transport(const Transport&) = delete;
+  Transport& operator=(const Transport&) = delete;
+
+  // Establish control star + data ring. size==1 is a no-op (pure local).
+  // timeout_ms bounds every blocking bootstrap step.
+  Status Init(int rank, int size, const std::string& coord_host,
+              int coord_port, int timeout_ms = 60000);
+  void Close();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // --- Control plane (root = rank 0) ------------------------------------
+  // Workers send `mine`; root returns size_ buffers (index == rank, root's
+  // own contribution passed in). Root-only output: `all`.
+  Status GatherToRoot(const std::vector<uint8_t>& mine,
+                      std::vector<std::vector<uint8_t>>* all);
+  // Root sends `buf` to everyone; workers receive into `buf`.
+  Status BcastFromRoot(std::vector<uint8_t>* buf);
+
+  // --- Data plane (ring) ------------------------------------------------
+  Status SendToNext(const void* data, size_t len);
+  Status RecvFromPrev(void* data, size_t len);
+  // Full-duplex step of the ring algorithms: send `send_len` bytes to the
+  // next rank while receiving `recv_len` bytes from the previous one.
+  // Avoids the deadlock of sequential send/recv when segments exceed the
+  // kernel socket buffers.
+  Status SendRecv(const void* send_data, size_t send_len, void* recv_data,
+                  size_t recv_len);
+
+  // Point-to-point over the control star (root<->worker), used by
+  // broadcast when the root is not rank 0 and by shutdown draining.
+  Status SendToRank(int dst, const void* data, size_t len);
+  Status RecvFromRank(int src, void* data, size_t len);
+
+ private:
+  int rank_ = 0;
+  int size_ = 1;
+  int listen_fd_ = -1;                 // root control listener
+  std::vector<int> worker_fds_;        // root: fd per worker rank (index 0 unused)
+  int coord_fd_ = -1;                  // worker: fd to root
+  int ring_send_fd_ = -1;              // to (rank+1) % size
+  int ring_recv_fd_ = -1;              // from (rank-1+size) % size
+  int data_listen_fd_ = -1;
+};
+
+}  // namespace hvdtpu

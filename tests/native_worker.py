@@ -1,0 +1,111 @@
+"""Subprocess worker for the native-core multi-process tests.
+
+The reference ran its test files under ``mpirun -np N`` (SURVEY §4 /
+reference test/common.py:25-58); this worker is the rebuild's equivalent:
+``test_native_core.py`` spawns N of these and each asserts closed-form
+collective results against its (rank, size).
+"""
+
+import sys
+
+import numpy as np
+
+from horovod_tpu.native import NativeCore, NativeError
+
+
+def run(rank: int, size: int, port: int, scenario: str) -> None:
+    core = NativeCore()
+    core.init(rank=rank, size=size, local_rank=rank, local_size=size,
+              coord_host="127.0.0.1", coord_port=port, timeout_ms=30000)
+    core.set_cycle_time_ms(1.0)
+    assert core.rank() == rank and core.size() == size
+
+    if scenario == "collectives":
+        # allreduce == elementwise sum over ranks.
+        a = np.arange(256, dtype=np.float32) * (rank + 1)
+        h = core.allreduce_async_("ar", a)
+        core.wait(h)
+        core.release(h)
+        scale = sum(r + 1 for r in range(size))
+        assert np.allclose(a, np.arange(256, dtype=np.float32) * scale)
+
+        # Fusion exercised by volume (reference test_*_fused pattern,
+        # test_tensorflow.py:107-139): many small tensors in one cycle.
+        arrs, handles = [], []
+        for i in range(64):
+            x = np.full(5, float(rank + i), dtype=np.float32)
+            arrs.append(x)
+            handles.append(core.allreduce_async_(f"small.{i}", x))
+        for i, h in enumerate(handles):
+            core.wait(h)
+            core.release(h)
+            assert np.allclose(arrs[i], sum(r + i for r in range(size)))
+
+        # Ragged allgatherv: rank r contributes r+1 rows.
+        g = np.full((rank + 1, 3), rank, dtype=np.int64)
+        h = core.allgather_async("ag", g)
+        core.wait(h)
+        out = core.take_result(h, np.int64, (3,))
+        assert out.shape[0] == sum(r + 1 for r in range(size))
+        off = 0
+        for r in range(size):
+            assert (out[off:off + r + 1] == r).all()
+            off += r + 1
+
+        # Broadcast from a non-zero root.
+        root = size - 1
+        b = np.full(16, rank * 10.0, dtype=np.float64)
+        h = core.broadcast_async_("bc", b, root)
+        core.wait(h)
+        core.release(h)
+        assert (b == root * 10.0).all()
+
+        # float16 ring reduction (native half math).
+        f16 = np.ones(33, dtype=np.float16) * (rank + 1)
+        h = core.allreduce_async_("f16", f16)
+        core.wait(h)
+        core.release(h)
+        assert np.allclose(f16, scale, atol=0.01)
+
+    elif scenario == "errors":
+        # Mismatched dtypes must produce the negotiation error on every
+        # rank (reference test pattern, test_tensorflow.py:265-333).
+        bad = np.zeros(4, dtype=np.float32 if rank == 0 else np.float64)
+        try:
+            h = core.allreduce_async_("bad_dtype", bad)
+            core.wait(h)
+            raise SystemExit("mismatched dtype was accepted")
+        except NativeError as e:
+            assert "Mismatched data types" in str(e), str(e)
+
+        bad2 = np.zeros(4 + rank, dtype=np.float32)
+        try:
+            h = core.allreduce_async_("bad_shape", bad2)
+            core.wait(h)
+            raise SystemExit("mismatched shape was accepted")
+        except NativeError as e:
+            assert "Mismatched tensor shapes" in str(e), str(e)
+
+        bad3 = np.zeros(4, dtype=np.float32)
+        try:
+            h = core.broadcast_async_("bad_root", bad3, rank % 2)
+            core.wait(h)
+            raise SystemExit("mismatched broadcast roots were accepted")
+        except NativeError as e:
+            assert "root rank" in str(e), str(e)
+
+        # Recovery: the job keeps working after negotiation errors.
+        ok = np.ones(8, dtype=np.float32)
+        h = core.allreduce_async_("after_error", ok)
+        core.wait(h)
+        core.release(h)
+        assert np.allclose(ok, float(size))
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    core.shutdown()
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
