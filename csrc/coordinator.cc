@@ -607,8 +607,11 @@ void Coordinator::CheckForStalled() {
 }
 
 Coordinator* GlobalCoordinator() {
-  static Coordinator instance;
-  return &instance;
+  // Intentionally leaked: static destruction with the background thread
+  // still joinable would std::terminate when a rank dies mid-job (e.g. a
+  // failed assertion in user code). The OS reclaims everything at exit.
+  static Coordinator* instance = new Coordinator();
+  return instance;
 }
 
 }  // namespace hvdtpu

@@ -1,0 +1,278 @@
+"""horovod_tpu.torch — PyTorch (CPU) binding over the native core.
+
+Parity surface of reference horovod/torch/__init__.py: init/rank/size/
+local_rank/local_size, sync+async+in-place collectives with autograd,
+``DistributedOptimizer`` firing allreduce from gradient hooks as backward
+produces them, ``broadcast_parameters`` / ``broadcast_optimizer_state``,
+fp16 compression, ``backward_passes_per_step`` accumulation.
+
+Process topology comes from the launcher's environment
+(``horovod_tpu.run`` sets HOROVOD_RANK / HOROVOD_SIZE / HOROVOD_LOCAL_RANK
+/ HOROVOD_LOCAL_SIZE / HOROVOD_CONTROLLER, replacing the reference's
+mpirun-provided MPI_COMM_WORLD, operations.cc:1748-1797).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+
+import torch
+
+from horovod_tpu.common.basics import check_extension
+from horovod_tpu.native import NativeCore
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.mpi_ops import (
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+def init(comm=None) -> None:
+    """Initialize the torch binding's native core from launcher env vars.
+
+    Single-process (no launcher) degenerates to size 1, the reference's
+    "no cluster needed" mode (SURVEY §4 mechanism 1).
+    """
+    if mpi_ops._core is not None and mpi_ops._core.initialized:
+        return
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))
+    local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", str(size)))
+    controller = os.environ.get("HOROVOD_CONTROLLER", "127.0.0.1:29400")
+    host, _, port = controller.rpartition(":")
+    core = NativeCore()
+    core.init(rank=rank, size=size, local_rank=local_rank,
+              local_size=local_size, coord_host=host or "127.0.0.1",
+              coord_port=int(port),
+              timeout_ms=int(os.environ.get("HOROVOD_START_TIMEOUT", "60"))
+              * 1000)
+    mpi_ops._set_core(core)
+
+
+def shutdown() -> None:
+    if mpi_ops._core is not None:
+        mpi_ops._core.shutdown()
+        mpi_ops._set_core(None)
+
+
+def rank() -> int:
+    return mpi_ops._require_core().rank()
+
+
+def size() -> int:
+    return mpi_ops._require_core().size()
+
+
+def local_rank() -> int:
+    return mpi_ops._require_core().local_rank()
+
+
+def local_size() -> int:
+    return mpi_ops._require_core().local_size()
+
+
+def mpi_threads_supported() -> bool:
+    """No MPI anywhere in this framework (parity shim,
+    reference operations.cc:2462-2468)."""
+    mpi_ops._require_core()
+    return False
+
+
+# ------------------------------------------------------------------------
+# DistributedOptimizer
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin applied by dynamic subclassing in DistributedOptimizer().
+
+    Behavior parity with reference torch/__init__.py:42-197: gradient
+    hooks fire an async allreduce per parameter as autograd finishes each
+    accumulation; ``synchronize()`` drains the handles and installs the
+    averaged gradients; ``step()`` synchronizes then delegates;
+    ``backward_passes_per_step`` delays the allreduce across N local
+    backwards. The hook mechanism differs: torch >= 2.1 provides
+    ``register_post_accumulate_grad_hook``, replacing the reference's
+    grad_fn.next_functions accumulator hack (torch/__init__.py:95-130).
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._backward_passes_per_step = backward_passes_per_step
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, group in enumerate(self.param_groups)
+                for v in group["params"]]
+        # Names must be unique: they key the negotiation
+        # (reference torch/__init__.py:76-83).
+        names = [n for n, _ in named_parameters]
+        dups = [n for n, c in collections.Counter(names).items() if c > 1]
+        if dups:
+            raise ValueError(
+                f"namespace of parameters is not unique: {dups}")
+        self._parameter_names = {v: n for n, v in named_parameters}
+        self._handles = {}
+        self._ctxs = {}
+        self._allreduce_delay = {}
+        self._hook_refs = []
+        if size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._allreduce_delay[p] = self._backward_passes_per_step
+                    ref = p.register_post_accumulate_grad_hook(
+                        self._make_hook())
+                    self._hook_refs.append(ref)
+
+    def _make_hook(self):
+        def hook(p):
+            assert not p.grad.requires_grad
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        compressed, ctx = self._compression.compress(p.grad.detach())
+        handle = allreduce_async_(compressed, average=False, name=name)
+        self._handles[p] = handle
+        self._ctxs[p] = (compressed, ctx)
+
+    def synchronize(self):
+        """Wait for all gradient allreduces; install averaged grads
+        (reference torch/__init__.py:132-147)."""
+        # Parameters whose hook never fired (unused in the graph) must
+        # still be reduced, or the other ranks deadlock
+        # (reference test_force_allreduce, test_torch.py:1040-1108).
+        for p, delay in list(self._allreduce_delay.items()):
+            if p not in self._handles and delay > 0:
+                if p.grad is None:
+                    p.grad = torch.zeros_like(p)
+                self._allreduce_grad_async(p)
+        for p, handle in list(self._handles.items()):
+            synchronize(handle)
+            compressed, ctx = self._ctxs.pop(p)
+            grad = self._compression.decompress(compressed, ctx)
+            p.grad.copy_(grad).div_(size())
+            self._allreduce_delay[p] = self._backward_passes_per_step
+        self._handles.clear()
+
+    def step(self, closure=None):
+        if size() > 1:
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wrap a torch optimizer for data-parallel training.
+
+    Dynamically subclasses the user's optimizer class so isinstance and
+    attribute access keep working (reference torch/__init__.py:192-197).
+    """
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+# ------------------------------------------------------------------------
+# Parameter / optimizer-state bootstrap
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a state_dict or iterable of (name, tensor) in place
+    (reference torch/__init__.py:200-229)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(broadcast_async_(p, root_rank, name=name))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast_object"):
+    """Broadcast an arbitrary picklable object (generalizes the
+    reference's scalar wrapping, torch/__init__.py:273-348): pickle on
+    root, ship length then payload as uint8 tensors."""
+    import pickle
+
+    if rank() == root_rank:
+        payload = pickle.dumps(obj)
+        length = torch.tensor([len(payload)], dtype=torch.int64)
+    else:
+        payload = b""
+        length = torch.tensor([0], dtype=torch.int64)
+    broadcast_(length, root_rank, name=f"{name}.len")
+    buf = torch.empty(int(length.item()), dtype=torch.uint8)
+    if rank() == root_rank:
+        buf.copy_(torch.frombuffer(bytearray(payload), dtype=torch.uint8))
+    broadcast_(buf, root_rank, name=f"{name}.data")
+    return pickle.loads(bytes(buf.numpy().tobytes()))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Synchronize full optimizer state from root, including non-tensor
+    scalars (reference torch/__init__.py:232-348 wrapped each scalar into
+    a tensor with recursive type-restoring callbacks; this rebuild ships
+    one pickled state_dict and loads it, with in-place tensor broadcasts
+    for the tensor leaves so devices/memory don't churn)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    if size() == 1:
+        return
+    state_dict = optimizer.state_dict()
+    # Newly constructed optimizers have empty state; the reference
+    # initialized it on every rank by running a zero-gradient step
+    # (torch/__init__.py:249-262) — every rank constructs the optimizer
+    # identically, so the emptiness check is globally consistent.
+    if not state_dict.get("state"):
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    full = broadcast_object(state_dict, root_rank,
+                            name="optimizer_state_dict")
+    if rank() != root_rank:
+        optimizer.load_state_dict(full)
+
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "mpi_threads_supported", "check_extension",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "poll", "synchronize", "Compression", "DistributedOptimizer",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+]

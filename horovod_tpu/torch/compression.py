@@ -1,0 +1,57 @@
+"""Gradient compression for the torch binding.
+
+Parity surface of reference horovod/torch/compression.py (same scheme as
+tensorflow/compression.py:33-74): ``none`` passes through, ``fp16`` casts
+floating tensors to half for the wire and back after.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No compression (reference compression.py:33-43)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast to fp16 before the collective, back after
+    (reference compression.py:46-74)."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating_point:
+            tensor = tensor.to(torch.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and ctx.is_floating_point and tensor.dtype != ctx:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace mirroring ``hvd.Compression.none`` / ``.fp16``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

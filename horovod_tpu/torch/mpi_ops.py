@@ -1,0 +1,284 @@
+"""Torch collective ops over the native core: sync + async + in-place
+variants with autograd support.
+
+Parity surface of reference horovod/torch/mpi_ops.py (438 LoC: v1/v2
+dispatch, _handle_map keep-alive, autograd Function classes, poll/
+synchronize). The execution engine differs by design: instead of one
+pybind symbol per (dtype x op) enqueueing into the MPI coordinator
+(reference torch/mpi_ops_v2.cc:236-339), tensors are viewed as numpy
+buffers and enqueued into the TCP-ring native core (csrc/coordinator.cc);
+torch-on-TPU traffic belongs to the XLA lane, so this binding's job is the
+CPU eager lane.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import torch
+
+from horovod_tpu.native import NativeCore, NativeError
+
+try:
+    import ml_dtypes
+
+    _BF16_NP = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16_NP = None
+
+# Module-global core, bound by horovod_tpu.torch.init().
+_core: Optional[NativeCore] = None
+
+_name_regex = re.compile(r"[^a-zA-Z0-9_.]")
+_name_lock = threading.Lock()
+_name_counter = 0
+
+# handle -> (keep-alive objects, completion callback -> result tensor).
+# Mirrors the reference's _handle_map (torch/mpi_ops.py:51-54): arrays must
+# outlive the background thread's pointer writes.
+_handle_map: Dict[int, Tuple[Any, Any]] = {}
+_handle_lock = threading.Lock()
+
+
+def _set_core(core: Optional[NativeCore]) -> None:
+    global _core
+    _core = core
+
+
+def _require_core() -> NativeCore:
+    if _core is None:
+        raise RuntimeError(
+            "horovod_tpu.torch has not been initialized; call hvd.init().")
+    return _core
+
+
+def _next_name(op: str, name: Optional[str]) -> str:
+    global _name_counter
+    if name is not None:
+        return _name_regex.sub("_", name)
+    with _name_lock:
+        _name_counter += 1
+        return f"{op}.noname.{_name_counter}"
+
+
+def _as_numpy(tensor: torch.Tensor) -> np.ndarray:
+    """Zero-copy numpy view of a contiguous CPU tensor."""
+    if tensor.dtype == torch.bfloat16:
+        if _BF16_NP is None:
+            raise TypeError("bfloat16 requires ml_dtypes")
+        return tensor.view(torch.int16).numpy().view(_BF16_NP)
+    return tensor.numpy()
+
+
+def _prepare_inplace(tensor: torch.Tensor):
+    """Returns (buffer tensor, copy_back needed). Non-contiguous tensors
+    stage through a contiguous clone."""
+    if not tensor.is_contiguous():
+        return tensor.contiguous(), True
+    return tensor, False
+
+
+def _register(handle: int, keep: Any, complete) -> int:
+    with _handle_lock:
+        _handle_map[handle] = (keep, complete)
+    return handle
+
+
+# ---------------------------------------------------------------- allreduce
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> int:
+    """In-place asynchronous allreduce; returns a handle for
+    poll/synchronize (reference mpi_ops.py:156-199)."""
+    core = _require_core()
+    buf, copy_back = _prepare_inplace(tensor)
+    arr = _as_numpy(buf)
+    h = core.allreduce_async_(_next_name("allreduce", name), arr)
+
+    def complete() -> torch.Tensor:
+        if copy_back:
+            tensor.copy_(buf)
+        if average:
+            tensor.div_(core.size())
+        return tensor
+
+    return _register(h, (tensor, buf, arr), complete)
+
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    """Out-of-place asynchronous allreduce."""
+    output = tensor.detach().clone()
+    return allreduce_async_(output, average, name)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    """Allreduce with gradient = allreduce (reference mpi_ops.py:110-121;
+    the transpose of a sum over ranks is a sum over ranks)."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        output = tensor.detach().clone()
+        h = allreduce_async_(output, average, name)
+        return synchronize(h)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Clone: the reduce is in-place and the incoming gradient buffer
+        # may be user-supplied or shared with the graph.
+        h = allreduce_async_(grad_output.detach().clone().contiguous(),
+                             ctx.average)
+        return synchronize(h), None, None
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, compression=None):
+    """Synchronous out-of-place allreduce, differentiable."""
+    from horovod_tpu.torch.compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    summed = _HorovodAllreduce.apply(compressed, average, name)
+    return compression.decompress(summed, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    """Synchronous in-place allreduce (reference mpi_ops.py:201-219)."""
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+# ---------------------------------------------------------------- allgather
+
+
+def allgather_async(tensor: torch.Tensor,
+                    name: Optional[str] = None) -> int:
+    """Asynchronous allgather: concatenation along dim 0 across ranks
+    (reference mpi_ops.py:256-281)."""
+    core = _require_core()
+    buf = tensor if tensor.is_contiguous() else tensor.contiguous()
+    arr = _as_numpy(buf)
+    h = core.allgather_async(_next_name("allgather", name), arr)
+    trailing = tuple(tensor.shape[1:])
+    dtype = tensor.dtype
+
+    def complete() -> torch.Tensor:
+        out_np = core.take_result(h, arr.dtype, trailing)
+        if dtype == torch.bfloat16:
+            out = torch.from_numpy(out_np.view(np.int16)).view(torch.bfloat16)
+        else:
+            out = torch.from_numpy(out_np)
+        return out
+
+    return _register(h, (tensor, buf, arr), complete)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    """Allgather with gradient = allreduce + slice of this rank's rows
+    (reference mpi_ops.py:236-254, tensorflow/mpi_ops.py:127-148)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.my_rows = tensor.shape[0] if tensor.dim() > 0 else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Offsets need every rank's row count; gather them lazily here so
+        # forward-only calls pay no extra collective (the reference also
+        # defers this to the gradient, mpi_ops.py:236-254).
+        rows = torch.tensor([ctx.my_rows], dtype=torch.int64)
+        all_rows = synchronize(allgather_async(rows))
+        rank = _require_core().rank()
+        offset = int(all_rows[:rank].sum())
+        summed = synchronize(allreduce_async_(
+            grad_output.detach().clone().contiguous(), average=False))
+        return summed[offset:offset + ctx.my_rows], None
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None):
+    """Synchronous allgather, differentiable."""
+    return _HorovodAllgather.apply(tensor, name)
+
+
+# ---------------------------------------------------------------- broadcast
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    """In-place asynchronous broadcast (reference mpi_ops.py:361-380)."""
+    core = _require_core()
+    buf, copy_back = _prepare_inplace(tensor)
+    arr = _as_numpy(buf)
+    h = core.broadcast_async_(_next_name("broadcast", name), arr, root_rank)
+
+    def complete() -> torch.Tensor:
+        if copy_back:
+            tensor.copy_(buf)
+        return tensor
+
+    return _register(h, (tensor, buf, arr), complete)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    output = tensor.detach().clone()
+    return broadcast_async_(output, root_rank, name)
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    """Broadcast with gradient = allreduce on root, zero elsewhere
+    (reference mpi_ops.py:318-332, tensorflow/mpi_ops.py:168-183)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        output = tensor.detach().clone()
+        return synchronize(broadcast_async_(output, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        summed = synchronize(allreduce_async_(
+            grad_output.detach().clone().contiguous(), average=False))
+        if _require_core().rank() != ctx.root_rank:
+            summed = torch.zeros_like(summed)
+        return summed, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None):
+    """Synchronous out-of-place broadcast, differentiable."""
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# --------------------------------------------------------------- completion
+
+
+def poll(handle: int) -> bool:
+    """Non-blocking readiness check (reference mpi_ops.py:406-420)."""
+    return _require_core().poll(handle)
+
+
+def synchronize(handle: int):
+    """Wait for an async op; returns its result tensor
+    (reference mpi_ops.py:422-438)."""
+    core = _require_core()
+    with _handle_lock:
+        entry = _handle_map.pop(handle, None)
+    if entry is None:
+        raise ValueError(f"unknown handle {handle}")
+    _, complete = entry
+    core.wait(handle)
+    result = complete()
+    core.release(handle)
+    return result

@@ -1,0 +1,144 @@
+"""Subprocess worker for horovod_tpu.torch multi-process tests (the
+rebuild's ``mpirun -np N test_torch.py`` equivalent, SURVEY §4)."""
+
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def run(scenario: str) -> None:
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    if scenario == "ops":
+        # Closed-form allreduce (reference test_torch.py:77-137 pattern).
+        t = torch.arange(64, dtype=torch.float32) * (rank + 1)
+        out = hvd.allreduce(t, average=False)
+        scale = sum(r + 1 for r in range(size))
+        assert torch.allclose(out, torch.arange(64, dtype=torch.float32) * scale)
+        assert torch.allclose(t, torch.arange(64, dtype=torch.float32) * (rank + 1)), \
+            "out-of-place allreduce must not mutate input"
+
+        # Default is average=True (reference torch API default).
+        avg = hvd.allreduce(torch.ones(5) * (rank + 1))
+        assert torch.allclose(avg, torch.full((5,), scale / size))
+
+        inp = torch.ones(8) * rank
+        hvd.allreduce_(inp, average=False)
+        assert torch.allclose(inp, torch.full((8,), float(sum(range(size)))))
+
+        # Allgather with ragged first dim (test_torch.py:430-504).
+        g = torch.full((rank + 1, 2), float(rank))
+        out = hvd.allgather(g)
+        assert out.shape == (sum(r + 1 for r in range(size)), 2)
+        off = 0
+        for r in range(size):
+            assert (out[off:off + r + 1] == r).all()
+            off += r + 1
+
+        # Broadcast (test_torch.py:613-648).
+        b = torch.full((4,), float(rank))
+        out = hvd.broadcast(b, root_rank=size - 1)
+        assert (out == size - 1).all()
+        hvd.broadcast_(b, root_rank=0)
+        assert (b == 0).all()
+
+        # Async + poll.
+        h = hvd.allreduce_async_(torch.ones(3), average=False, name="async_t")
+        while not hvd.poll(h):
+            pass
+        res = hvd.synchronize(h)
+        assert (res == size).all()
+
+        # Backward must not corrupt a user-supplied gradient buffer.
+        g_user = torch.ones(4)
+        xg = torch.zeros(4, requires_grad=True)
+        hvd.broadcast(xg, root_rank=0).backward(g_user)
+        assert torch.allclose(g_user, torch.ones(4)), \
+            "backward mutated the incoming gradient"
+
+        # Gradient flow: allreduce grad == allreduce of upstream grad
+        # (test_torch.py:377-429).
+        x = (torch.ones(4) * (rank + 1)).requires_grad_()
+        y = hvd.allreduce(x, average=False)
+        y.backward(torch.ones(4))
+        assert torch.allclose(x.grad, torch.full((4,), float(size)))
+
+    elif scenario == "optimizer":
+        torch.manual_seed(1234)  # same init on all ranks
+        model = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+        # Each rank sees a disjoint shard: convergence proves averaging.
+        torch.manual_seed(100 + rank)
+        w_true = torch.ones(6)
+        losses = []
+        for step in range(60):
+            X = torch.randn(32, 6)
+            y = (X @ w_true).unsqueeze(1)
+            opt.zero_grad()
+            loss = F.mse_loss(model(X), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+        # Params identical across ranks after synchronized training.
+        flat = torch.cat([p.detach().flatten() for p in model.parameters()])
+        gathered = hvd.allgather(flat.unsqueeze(0))
+        for r in range(size):
+            assert torch.allclose(gathered[r], flat, atol=1e-6), \
+                f"rank {rank}: params diverged from rank {r}"
+
+    elif scenario == "optimizer_features":
+        torch.manual_seed(7)
+        model = nn.Linear(4, 2)
+        base = torch.optim.Adam(model.parameters(), lr=0.01)
+        opt = hvd.DistributedOptimizer(
+            base, named_parameters=model.named_parameters(),
+            compression=hvd.Compression.fp16,
+            backward_passes_per_step=2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        # Two backwards per step (gradient accumulation).
+        for it in range(4):
+            opt.zero_grad()
+            for _ in range(2):
+                X = torch.randn(8, 4)
+                loss = model(X).pow(2).mean()
+                loss.backward()
+            opt.step()
+
+        # Unused-parameter path: loss touches only the weight, not bias
+        # (reference test_force_allreduce, test_torch.py:1040-1108).
+        model2 = nn.Linear(3, 3, bias=True)
+        opt2 = hvd.DistributedOptimizer(
+            torch.optim.SGD(model2.parameters(), lr=0.1),
+            named_parameters=model2.named_parameters())
+        opt2.zero_grad()
+        loss2 = (model2.weight @ torch.ones(3)).sum()
+        loss2.backward()
+        opt2.step()  # must not deadlock
+
+        # DistributedOptimizer wraps into a new object; its state (not the
+        # donor optimizer's) is the live one.
+        state = opt.state_dict()
+        assert state["state"], "Adam state should be populated"
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1])
