@@ -1,0 +1,139 @@
+"""horovod_tpu.flax — training-loop + callback binding (keras analogue).
+
+Parity surface of the reference's keras bindings (horovod/keras/,
+horovod/tensorflow/keras/, shared impl horovod/_keras/, SURVEY §2.7):
+``create_distributed_optimizer``, the callback set, and ``load_model``/
+``save_model`` with optimizer re-wrapping. Keras's ``model.fit`` becomes a
+light :class:`TrainLoop` over flax/optax train state — enough structure for
+the callbacks to hook, without hiding the jax step function.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+from flax import serialization
+
+from horovod_tpu.flax.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    get_hyperparam,
+    set_hyperparam,
+)
+from horovod_tpu.jax.optimizer import (
+    DistributedOptimizer,
+    broadcast_parameters,
+)
+
+
+def create_distributed_optimizer(optimizer, name=None, **kwargs):
+    """Reference _keras/__init__.py:20-70 parity: wrap a user optimizer so
+    gradients are cross-rank averaged. ``name`` accepted for signature
+    parity (keras needed it for the dynamic subclass)."""
+    del name
+    return DistributedOptimizer(optimizer, **kwargs)
+
+
+class TrainLoop:
+    """Callback-driven epoch/batch loop over a jax train step.
+
+    ``step_fn(state, batch) -> (state, metrics)`` is a black box — pass an
+    ``hvd.spmd_run``-wrapping closure for multi-chip, or a plain jitted
+    step for one chip. ``data_fn(epoch)`` yields the epoch's batches.
+    """
+
+    def __init__(self, state, step_fn: Callable, data_fn: Callable,
+                 callbacks: Optional[List[Callback]] = None):
+        self.state = state
+        self.step_fn = step_fn
+        self.data_fn = data_fn
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            cb.set_loop(self)
+        self.history: List[Dict[str, float]] = []
+        self.stop_training = False
+
+    def _dispatch(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args)
+
+    def fit(self, epochs: int) -> List[Dict[str, float]]:
+        self._dispatch("on_train_begin", None)
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            self._dispatch("on_epoch_begin", epoch, None)
+            logs: Dict[str, Any] = {}
+            count = 0
+            for batch_idx, batch in enumerate(self.data_fn(epoch)):
+                self._dispatch("on_batch_begin", batch_idx, None)
+                self.state, metrics = self.step_fn(self.state, batch)
+                batch_logs = {k: v for k, v in (metrics or {}).items()}
+                self._dispatch("on_batch_end", batch_idx, batch_logs)
+                # Accumulate device values as-is: float() here would force
+                # a host sync per batch and defeat jax async dispatch.
+                for k, v in batch_logs.items():
+                    logs[k] = logs.get(k, 0.0) + v
+                count += 1
+            epoch_logs = {k: float(v) / max(count, 1)
+                          for k, v in logs.items()}
+            self._dispatch("on_epoch_end", epoch, epoch_logs)
+            self.history.append(epoch_logs)
+        self._dispatch("on_train_end", None)
+        return self.history
+
+
+# ------------------------------------------------------------- checkpointing
+# Reference pattern (SURVEY §5 checkpoint/resume): save on rank 0 only,
+# restore everywhere, then re-broadcast from root.
+
+
+def save_model(path: str, state, only_rank0: bool = True) -> None:
+    """Serialize a train-state pytree (flax msgpack). With
+    ``only_rank0=True`` non-root processes no-op, the reference's
+    checkpoint discipline (reference README.md:113-115)."""
+    from horovod_tpu.common import basics
+
+    if only_rank0 and basics.is_initialized() and basics.rank() != 0:
+        return
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(state))
+    os.replace(tmp, path)
+
+
+def load_model(path: str, template, root_rank: int = 0,
+               broadcast: bool = True):
+    """Restore a train-state pytree saved by :func:`save_model`.
+
+    ``template`` supplies the pytree structure (an initialized state).
+    With ``broadcast=True`` the restored state is re-broadcast from
+    ``root_rank``, mirroring ``hvd.load_model``'s re-wrapping + broadcast
+    flow (reference _keras/__init__.py:93-109, keras/__init__.py:121-148).
+    """
+    with open(path, "rb") as f:
+        state = serialization.from_bytes(template, f.read())
+    if broadcast:
+        state = broadcast_parameters(state, root_rank)
+    return state
+
+
+__all__ = [
+    "Callback",
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+    "TrainLoop",
+    "create_distributed_optimizer",
+    "DistributedOptimizer",
+    "save_model",
+    "load_model",
+    "get_hyperparam",
+    "set_hyperparam",
+]

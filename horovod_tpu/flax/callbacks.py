@@ -1,0 +1,280 @@
+"""Training-loop callbacks: the keras-binding analogue.
+
+Parity surface of reference horovod/_keras/callbacks.py (169 LoC), bound to
+flax/optax instead of keras:
+
+* :class:`BroadcastGlobalVariablesCallback` — reference :20-30
+* :class:`MetricAverageCallback`            — reference :33-67
+* :class:`LearningRateScheduleCallback`     — reference :70-147
+* :class:`LearningRateWarmupCallback`       — reference :149-168
+
+Learning-rate mutation requires the inner optimizer to be built with
+``optax.inject_hyperparams`` (e.g. ``optax.inject_hyperparams(optax.sgd)(
+learning_rate=0.1, momentum=0.9)``) so the LR lives in the optimizer state
+as an array — the TPU-native equivalent of keras's mutable ``K.set_value(
+opt.lr, ...)``. Momentum correction rescales trace/momentum buffers when
+the LR changes, as the reference did for keras SGD.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Callback:
+    """Hook points mirror keras.callbacks.Callback; each receives the
+    :class:`horovod_tpu.flax.TrainLoop` driving training."""
+
+    def set_loop(self, loop) -> None:
+        self.loop = loop
+
+    def on_train_begin(self, logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+    def on_epoch_begin(self, epoch: int,
+                       logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+    def on_batch_begin(self, batch: int,
+                       logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+    def on_batch_end(self, batch: int,
+                     logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+    def on_train_end(self, logs: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+
+# ---------------------------------------------------------------- opt-state
+# surgery helpers: locate InjectHyperparamsState / TraceState leaves inside
+# an arbitrarily nested optax state tuple (chains, MultiSteps, ...).
+
+
+def _is_namedtuple(obj) -> bool:
+    return isinstance(obj, tuple) and hasattr(obj, "_fields")
+
+
+def _rewrite_state(node, visit):
+    """Depth-first structural rewrite over tuples/namedtuples/lists/dicts.
+    ``visit(node)`` may return a replacement (short-circuits recursion into
+    that node) or None to recurse."""
+    replacement = visit(node)
+    if replacement is not None:
+        return replacement
+    if _is_namedtuple(node):
+        return type(node)(*(_rewrite_state(v, visit) for v in node))
+    if isinstance(node, tuple):
+        return tuple(_rewrite_state(v, visit) for v in node)
+    if isinstance(node, list):
+        return [_rewrite_state(v, visit) for v in node]
+    if isinstance(node, dict):
+        return {k: _rewrite_state(v, visit) for k, v in node.items()}
+    return node
+
+
+def get_hyperparam(opt_state, name: str):
+    """Read a hyperparameter injected via optax.inject_hyperparams."""
+    found = []
+
+    def visit(node):
+        if _is_namedtuple(node) and "hyperparams" in getattr(node, "_fields", ()):
+            if name in node.hyperparams:
+                found.append(node.hyperparams[name])
+        return None
+
+    _rewrite_state(opt_state, visit)
+    if not found:
+        raise KeyError(
+            f"hyperparameter {name!r} not found — build the optimizer with "
+            "optax.inject_hyperparams so the LR is mutable state")
+    return found[0]
+
+
+def set_hyperparam(opt_state, name: str, value):
+    """Return a copy of ``opt_state`` with hyperparameter ``name`` set."""
+    hits = []
+
+    def visit(node):
+        if _is_namedtuple(node) and "hyperparams" in getattr(node, "_fields", ()):
+            if name in node.hyperparams:
+                hp = dict(node.hyperparams)
+                hp[name] = jnp.asarray(value, jnp.asarray(hp[name]).dtype)
+                hits.append(True)
+                return node._replace(hyperparams=hp)
+        return None
+
+    new_state = _rewrite_state(opt_state, visit)
+    if not hits:
+        raise KeyError(
+            f"hyperparameter {name!r} not found — build the optimizer with "
+            "optax.inject_hyperparams so the LR is mutable state")
+    return new_state
+
+
+def scale_momentum(opt_state, factor: float):
+    """Multiply momentum/trace buffers by ``factor`` (reference momentum
+    correction, _keras/callbacks.py:70-147: when LR jumps by k, old
+    momentum is worth k× in the new step-size units)."""
+
+    def visit(node):
+        if _is_namedtuple(node) and "trace" in getattr(node, "_fields", ()):
+            return node._replace(
+                trace=jax.tree_util.tree_map(lambda t: t * factor, node.trace))
+        return None
+
+    return _rewrite_state(opt_state, visit)
+
+
+# ----------------------------------------------------------------- callbacks
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast the full train state from ``root_rank`` at train start
+    (reference _keras/callbacks.py:20-30), so all ranks begin from
+    identical weights + optimizer state."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        from horovod_tpu.jax.optimizer import broadcast_parameters
+
+        self.loop.state = broadcast_parameters(self.loop.state,
+                                               self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metrics over ranks (reference :33-67). Metrics
+    produced inside ``spmd_run`` are already chip-averaged; this covers
+    process-level metrics (e.g. locally-computed validation scores)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        from horovod_tpu.jax import mpi_ops
+
+        for key in list(logs):
+            val = logs[key]
+            if isinstance(val, (int, float, jnp.ndarray)):
+                logs[key] = mpi_ops.allreduce(
+                    jnp.asarray(val, jnp.float32), average=True,
+                    name=f"metric.{key}")
+
+
+class LearningRateScheduleCallback(Callback):
+    """Schedule LR as ``initial_lr * multiplier(epoch)``
+    (reference :70-147).
+
+    ``multiplier`` is a float or a callable of the (possibly fractional)
+    epoch. ``staircase=True`` updates on epoch boundaries; otherwise every
+    batch with ``epoch + batch/steps_per_epoch``. When the applied LR
+    changes and ``momentum_correction`` is set, momentum buffers are
+    rescaled by new/old.
+    """
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 initial_lr: Optional[float] = None,
+                 steps_per_epoch: Optional[int] = None):
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda epoch: multiplier))
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = initial_lr
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._last_lr: Optional[float] = None
+
+    def _in_window(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _resolve_initial_lr(self):
+        if self.initial_lr is None:
+            # First application: adopt the optimizer's current LR
+            # (reference read it from the wrapped keras optimizer).
+            self.initial_lr = float(
+                get_hyperparam(self.loop.state["opt_state"], "learning_rate"))
+
+    def _apply(self, epoch_f) -> None:
+        if not self._in_window(epoch_f):
+            return
+        self._resolve_initial_lr()
+        new_lr = self.initial_lr * float(self.multiplier(epoch_f))
+        if self._last_lr is not None and math.isclose(self._last_lr, new_lr):
+            return
+        opt_state = set_hyperparam(self.loop.state["opt_state"],
+                                   "learning_rate", new_lr)
+        if self.momentum_correction and self._last_lr not in (None, 0.0):
+            opt_state = scale_momentum(opt_state, new_lr / self._last_lr)
+        self.loop.state["opt_state"] = opt_state
+        self._last_lr = new_lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._apply(float(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "staircase=False requires steps_per_epoch")
+            self._apply(self.current_epoch + batch / self.steps_per_epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual "lr x size" warmup over the first epochs (reference
+    :149-168, after Goyal et al. 2017): with base LR already scaled by
+    ``size``, ramp the multiplier from 1/size to 1 so training starts at
+    the single-rank LR and reaches the scaled LR after ``warmup_epochs``.
+    """
+
+    def __init__(self, warmup_epochs: float = 5.0,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        from horovod_tpu.common import basics
+
+        self.verbose = verbose
+        size = basics.size() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            if warmup_epochs <= 0:
+                return 1.0
+            progress = min(epoch / warmup_epochs, 1.0)
+            return (1.0 + progress * (size - 1)) / size
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=None, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.warmup_epochs = warmup_epochs
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.current_epoch < self.warmup_epochs:
+            super().on_batch_begin(batch, logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        super().on_epoch_begin(epoch, logs)
+        if epoch >= self.warmup_epochs:
+            # Warmup over: snap to the full (clamped multiplier = 1) LR so
+            # the ramp ends exactly at the scaled rate.
+            self._apply(float(epoch))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose and epoch < self.warmup_epochs and self._last_lr:
+            print(f"Epoch {epoch + 1}: warmup lr = {self._last_lr:.6f}")

@@ -117,9 +117,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             named_parameters = list(named_parameters)
         else:
             named_parameters = [
-                (f"allreduce.noname.{i}", v)
-                for i, group in enumerate(self.param_groups)
-                for v in group["params"]]
+                (f"allreduce.noname.{gi}.{pi}", v)
+                for gi, group in enumerate(self.param_groups)
+                for pi, v in enumerate(group["params"])]
         # Names must be unique: they key the negotiation
         # (reference torch/__init__.py:76-83).
         names = [n for n, _ in named_parameters]
@@ -147,6 +147,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _make_hook(self):
         def hook(p):
             assert not p.grad.requires_grad
+            if self._allreduce_delay[p] <= 0:
+                # A second backward would accumulate into a buffer the
+                # background thread may still be reducing (reference
+                # raises the same way, torch/__init__.py:115-123).
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally.")
             self._allreduce_delay[p] -= 1
             if self._allreduce_delay[p] == 0:
                 self._allreduce_grad_async(p)

@@ -1,0 +1,253 @@
+"""Tests for horovod_tpu.flax (keras-binding analogue): callbacks, train
+loop, checkpoint round-trip (reference test/test_keras.py patterns)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu import flax as hvd_flax
+from horovod_tpu.flax import callbacks as cb
+
+
+def _make_sgd(lr=0.1, momentum=0.9):
+    return optax.inject_hyperparams(optax.sgd)(learning_rate=lr,
+                                               momentum=momentum)
+
+
+def _linear_problem(seed=0):
+    key = jax.random.PRNGKey(seed)
+    w = jax.random.normal(key, (6,))
+    X = jax.random.normal(jax.random.PRNGKey(seed + 1), (64, 6))
+    return X, X @ w
+
+
+def _make_step(optimizer):
+    def step(state, batch):
+        X, y = batch
+
+        def loss_fn(p):
+            return jnp.mean((X @ p - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = optimizer.update(g, state["opt_state"],
+                                              state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+        }, {"loss": loss}
+
+    return step
+
+
+def _make_state(optimizer):
+    params = jnp.zeros((6,))
+    return {"params": params, "opt_state": optimizer.init(params)}
+
+
+class TestHyperparamSurgery:
+    def test_get_set_roundtrip(self, hvd):
+        opt = hvd_flax.DistributedOptimizer(_make_sgd(0.1))
+        state = _make_state(opt)
+        assert float(cb.get_hyperparam(state["opt_state"],
+                                       "learning_rate")) == pytest.approx(0.1)
+        new = cb.set_hyperparam(state["opt_state"], "learning_rate", 0.025)
+        assert float(cb.get_hyperparam(new, "learning_rate")) == \
+            pytest.approx(0.025)
+
+    def test_missing_hyperparam_raises(self, hvd):
+        opt = optax.sgd(0.1)  # no inject_hyperparams
+        state = _make_state(opt)
+        with pytest.raises(KeyError, match="inject_hyperparams"):
+            cb.get_hyperparam(state["opt_state"], "learning_rate")
+
+    def test_scale_momentum(self, hvd):
+        opt = _make_sgd(0.1, momentum=0.9)
+        state = _make_state(opt)
+        step = _make_step(opt)
+        batch = _linear_problem()
+        st, _ = step(state, batch)
+        scaled = cb.scale_momentum(st["opt_state"], 2.0)
+
+        def traces(s):
+            out = []
+
+            def visit(node):
+                if cb._is_namedtuple(node) and "trace" in node._fields:
+                    out.append(node.trace)
+                return None
+
+            cb._rewrite_state(s, visit)
+            return out
+
+        orig, doubled = traces(st["opt_state"]), traces(scaled)
+        assert orig and doubled
+        for a, b in zip(orig, doubled):
+            np.testing.assert_allclose(np.asarray(b), 2 * np.asarray(a),
+                                       rtol=1e-6)
+
+
+class TestTrainLoop:
+    def test_fit_converges_and_history(self, hvd):
+        opt = hvd_flax.DistributedOptimizer(_make_sgd(0.05))
+        step = _make_step(opt)
+        batch = _linear_problem()
+
+        loop = hvd_flax.TrainLoop(
+            _make_state(opt), step, lambda epoch: [batch] * 10)
+        history = loop.fit(epochs=5)
+        assert len(history) == 5
+        assert history[-1]["loss"] < history[0]["loss"] * 0.1
+
+    def test_callback_order_and_hooks(self, hvd):
+        calls = []
+
+        class Recorder(cb.Callback):
+            def on_train_begin(self, logs=None):
+                calls.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                calls.append(f"epoch_begin{epoch}")
+
+            def on_batch_begin(self, batch, logs=None):
+                calls.append(f"batch_begin{batch}")
+
+            def on_batch_end(self, batch, logs=None):
+                calls.append(f"batch_end{batch}")
+
+            def on_epoch_end(self, epoch, logs=None):
+                calls.append(f"epoch_end{epoch}")
+
+            def on_train_end(self, logs=None):
+                calls.append("train_end")
+
+        opt = _make_sgd()
+        loop = hvd_flax.TrainLoop(_make_state(opt), _make_step(opt),
+                                  lambda e: [_linear_problem()] * 2,
+                                  callbacks=[Recorder()])
+        loop.fit(epochs=2)
+        assert calls == [
+            "train_begin",
+            "epoch_begin0", "batch_begin0", "batch_end0", "batch_begin1",
+            "batch_end1", "epoch_end0",
+            "epoch_begin1", "batch_begin0", "batch_end0", "batch_begin1",
+            "batch_end1", "epoch_end1",
+            "train_end",
+        ]
+
+
+class TestCallbacks:
+    def test_broadcast_global_variables(self, hvd):
+        opt = _make_sgd()
+        loop = hvd_flax.TrainLoop(_make_state(opt), _make_step(opt),
+                                  lambda e: [_linear_problem()],
+                                  callbacks=[
+                                      cb.BroadcastGlobalVariablesCallback(0)])
+        loop.fit(epochs=1)  # must run without error; state stays intact
+        assert loop.state["params"].shape == (6,)
+
+    def test_metric_average_callback(self, hvd):
+        logs = {"loss": 4.0, "note": "str-passthrough"}
+        c = cb.MetricAverageCallback()
+        c.set_loop(None)
+        c.on_epoch_end(0, logs)
+        # size==1 average is identity; strings untouched.
+        assert float(logs["loss"]) == pytest.approx(4.0)
+        assert logs["note"] == "str-passthrough"
+
+    def test_lr_schedule_staircase(self, hvd):
+        opt = _make_sgd(0.1)
+        sched = cb.LearningRateScheduleCallback(
+            multiplier=lambda epoch: 0.5 ** epoch, staircase=True,
+            momentum_correction=False)
+        loop = hvd_flax.TrainLoop(_make_state(opt), _make_step(opt),
+                                  lambda e: [_linear_problem()],
+                                  callbacks=[sched])
+        loop.fit(epochs=3)
+        lr = float(cb.get_hyperparam(loop.state["opt_state"],
+                                     "learning_rate"))
+        assert lr == pytest.approx(0.1 * 0.25)
+
+    def test_lr_schedule_window(self, hvd):
+        opt = _make_sgd(0.1)
+        sched = cb.LearningRateScheduleCallback(
+            multiplier=0.01, start_epoch=5, staircase=True)
+        loop = hvd_flax.TrainLoop(_make_state(opt), _make_step(opt),
+                                  lambda e: [_linear_problem()],
+                                  callbacks=[sched])
+        loop.fit(epochs=2)  # before the window: untouched
+        lr = float(cb.get_hyperparam(loop.state["opt_state"],
+                                     "learning_rate"))
+        assert lr == pytest.approx(0.1)
+
+    def test_warmup_ramps_lr(self, hvd):
+        # Over the 8-chip mesh the ramp starts at lr/8 and must end at
+        # exactly the full LR once warmup completes.
+        opt = _make_sgd(0.8)
+        warm = cb.LearningRateWarmupCallback(warmup_epochs=2,
+                                             steps_per_epoch=4)
+        loop = hvd_flax.TrainLoop(_make_state(opt), _make_step(opt),
+                                  lambda e: [_linear_problem()] * 4,
+                                  callbacks=[warm])
+        loop.fit(epochs=3)
+        lr = float(cb.get_hyperparam(loop.state["opt_state"],
+                                     "learning_rate"))
+        assert lr == pytest.approx(0.8)
+
+    def test_warmup_multiplier_math(self, hvd):
+        # The ramp formula at size 8: 1/8 -> 1 across warmup_epochs.
+        warm = cb.LearningRateWarmupCallback.__new__(
+            cb.LearningRateWarmupCallback)
+        size = 8
+
+        def multiplier(epoch, warmup=5.0):
+            progress = min(epoch / warmup, 1.0)
+            return (1.0 + progress * (size - 1)) / size
+
+        assert multiplier(0.0) == pytest.approx(1 / 8)
+        assert multiplier(5.0) == pytest.approx(1.0)
+        assert multiplier(2.5) == pytest.approx((1 + 3.5) / 8)
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, hvd, tmp_path):
+        opt = hvd_flax.DistributedOptimizer(_make_sgd(0.05))
+        step = _make_step(opt)
+        state = _make_state(opt)
+        for _ in range(5):
+            state, _ = step(state, _linear_problem())
+        path = tmp_path / "ckpt.msgpack"
+        hvd_flax.save_model(str(path), state)
+        template = _make_state(opt)
+        restored = hvd_flax.load_model(str(path), template)
+        np.testing.assert_allclose(np.asarray(restored["params"]),
+                                   np.asarray(state["params"]), rtol=1e-6)
+        # Optimizer state (momentum trace + injected lr) restored too.
+        assert float(cb.get_hyperparam(restored["opt_state"],
+                                       "learning_rate")) == \
+            pytest.approx(0.05)
+
+    def test_spmd_training_with_callbacks(self, hvd):
+        """End-to-end: 8-chip SPMD step inside the TrainLoop with
+        broadcast + metric averaging + warmup."""
+        opt = hvd_flax.DistributedOptimizer(_make_sgd(0.05, momentum=0.0))
+        raw_step = _make_step(opt)
+        X, y = _linear_problem()
+
+        def spmd_step(state, batch):
+            return hvd_jax.spmd_run(
+                raw_step, state, batch,
+                in_specs=(P(), (P("hvd"), P("hvd"))),
+                out_specs=(P(), P()))
+
+        loop = hvd_flax.TrainLoop(
+            _make_state(opt), spmd_step, lambda e: [(X, y)] * 5,
+            callbacks=[cb.BroadcastGlobalVariablesCallback(0),
+                       cb.MetricAverageCallback(),
+                       cb.LearningRateWarmupCallback(warmup_epochs=1,
+                                                     steps_per_epoch=5)])
+        history = loop.fit(epochs=3)
+        assert history[-1]["loss"] < history[0]["loss"]

@@ -134,6 +134,26 @@ def run(scenario: str) -> None:
         state = opt.state_dict()
         assert state["state"], "Adam state should be populated"
 
+        # Auto-generated parameter names (no named_parameters) must be
+        # unique and functional.
+        model3 = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        opt3 = hvd.DistributedOptimizer(
+            torch.optim.SGD(model3.parameters(), lr=0.1))
+        opt3.zero_grad()
+        model3(torch.randn(4, 4)).pow(2).mean().backward()
+        opt3.step()
+
+        # A second backward past backward_passes_per_step must raise, not
+        # silently corrupt (reference torch/__init__.py:115-123).
+        opt3.zero_grad()
+        model3(torch.randn(4, 4)).pow(2).mean().backward()
+        try:
+            model3(torch.randn(4, 4)).pow(2).mean().backward()
+            raise SystemExit("double backward did not raise")
+        except (AssertionError, RuntimeError) as e:
+            assert "backward_passes_per_step" in str(e), str(e)
+        opt3.step()
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 
