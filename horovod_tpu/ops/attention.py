@@ -1,0 +1,139 @@
+"""Attention kernels: reference jnp implementation + Pallas flash attention.
+
+These are the single-chip building blocks under the sequence-parallel
+schemes in :mod:`horovod_tpu.parallel` (ring attention rotates K/V blocks
+between chips and calls a block kernel locally; Ulysses reshards heads and
+calls a full local kernel). The reference framework has no attention ops —
+long-context support is a first-class extension of this rebuild (SURVEY
+§5 "Long-context / sequence parallelism: absent").
+
+``flash_attention`` is a Pallas TPU kernel (online-softmax tiling so the
+L x L score matrix never materializes in HBM); off-TPU it runs in
+interpreter mode so tests cover the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite stand-in for -inf: exp() of it is exactly 0
+
+
+def dot_product_attention(q, k, v, causal: bool = False,
+                          scale: Optional[float] = None,
+                          q_offset: int = 0, k_offset: int = 0):
+    """Reference attention. Shapes: q [..., Lq, H, D], k/v [..., Lk, H, D].
+
+    ``q_offset``/``k_offset`` are the global positions of the first query/
+    key token — block-parallel callers (ring attention) pass their shard's
+    global offset so causal masks line up across chips.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[-3])[:, None]
+        ki = k_offset + jnp.arange(k.shape[-3])[None, :]
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("...hqk,...khd->...qhd", weights.astype(q.dtype), v)
+
+
+# --------------------------------------------------------------------------
+# Pallas flash attention
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
+                  causal: bool, scale: float, block_q: int):
+    """One (batch*head, q-block) program: stream K/V blocks through VMEM
+    with online softmax so only O(block_q x d) state persists."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
+    qi = pl.program_id(1)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    n_kblocks = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Only key blocks at or before this q-block's last row contribute.
+        last = (qi * block_q + block_q - 1) // block_k + 1
+        n_iter = jnp.minimum(last, n_kblocks)
+        m, l, acc = jax.lax.fori_loop(
+            0, n_iter, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
+
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Pallas flash attention. Shapes [B, L, H, D] -> [B, L, H, D].
+
+    Sequence lengths must be multiples of the block sizes (pad upstream).
+    ``interpret`` defaults to True off-TPU so the same kernel is testable
+    on the CPU mesh.
+    """
+    from jax.experimental import pallas as pl
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    assert Lq % block_q == 0 and Lk % block_k == 0, (Lq, Lk, block_q, block_k)
+
+    # Collapse (B, H) into the grid's first axis; put seq minor-most for
+    # contiguous VMEM tiles.
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_k=Lk,
+                               causal=causal, scale=scale, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Lq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

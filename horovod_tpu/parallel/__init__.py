@@ -4,7 +4,25 @@ The reference supported exactly one strategy — data parallelism (SURVEY §2.9)
 — delegated to MPI/NCCL rings. Here DP is one axis of a general
 ``jax.sharding.Mesh``; this package adds the TPU-first strategies the
 hardware makes natural: tensor parallelism, sequence/context parallelism
-(ring attention, all-to-all), pipeline parallelism, and expert parallelism.
+(ring attention, Ulysses all-to-all), pipeline parallelism, and expert
+parallelism, plus the hierarchical ICI x DCN mesh that replaces the
+reference's node-local/cross-node communicator split.
 """
 
 from horovod_tpu.parallel.spmd import axis_size, spmd, spmd_run  # noqa: F401
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    hierarchical_allreduce,
+    hierarchical_mesh,
+    make_mesh,
+)
+from horovod_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from horovod_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from horovod_tpu.parallel.tp import (  # noqa: F401
+    column_parallel,
+    row_parallel,
+    shard_columns,
+    shard_rows,
+    tp_mlp,
+)
+from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from horovod_tpu.parallel.moe import moe_layer, top1_routing  # noqa: F401

@@ -1,0 +1,94 @@
+"""Expert parallelism: Switch-style top-1 MoE with all-to-all dispatch.
+
+Beyond-reference capability (SURVEY §2.9: no EP in the reference). Experts
+shard over an ``"ep"`` mesh axis (E_local = E / P per chip). Routing
+builds dispatch/combine tensors from a top-1 softmax gate with capacity
+dropping (Switch Transformer), then two ``lax.all_to_all``s move token
+slots: tokens -> their expert's chip, expert outputs -> back. The einsum
+formulation keeps everything dense for the MXU; dropped tokens pass
+through via the residual (combine weights are zero for them).
+
+Use inside shard_map with tokens sharded over the axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_routing(x, gate_w, num_experts: int, capacity: int):
+    """Switch top-1 routing. x [T, D] -> (dispatch [T, E, C] one-hot,
+    combine [T, E, C] gate-weighted, aux_loss scalar)."""
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # [T]
+    gate = jnp.max(probs, axis=-1)                            # [T]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+    # Position of each token within its expert's queue.
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0      # [T, E]
+    keep = (position >= 0) & (position < capacity)
+    pos_clamped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+    dispatch = onehot[..., None] * slot * keep[..., None]     # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Load-balancing auxiliary loss (Switch eq. 4).
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux
+
+
+def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
+              axis: str = "ep", capacity_factor: float = 1.25,
+              return_aux: bool = False):
+    """Expert-parallel MoE layer inside shard_map.
+
+    Args:
+      x: this chip's tokens [T, D].
+      gate_w: router weights [D, E] (replicated).
+      expert_fn: ``(params_one_expert, tokens [N, D]) -> [N, D]``.
+      expert_params: this chip's experts' params, leading axis E_local
+        (pass stacked [E, ...] with ``P("ep")`` in_specs).
+    Returns y [T, D] (+ aux loss when ``return_aux``).
+    """
+    size = lax.axis_size(axis)
+    T, D = x.shape
+    e_leaves = jax.tree_util.tree_leaves(expert_params)
+    e_local = e_leaves[0].shape[0]
+    num_experts = e_local * size
+    capacity = max(1, math.ceil(T * capacity_factor / num_experts))
+
+    dispatch, combine, aux = top1_routing(x, gate_w, num_experts, capacity)
+
+    # [T, E, C] x [T, D] -> [E, C, D]: expert slots filled with tokens.
+    slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # Reshard tokens -> expert chips. Untiled all_to_all with split ==
+    # concat == 0 is a chip-transpose: recv[s] = sent_by_chip_s[my_rank].
+    # Chip r owns global experts [r*e_local, (r+1)*e_local); so with the
+    # leading axis indexing destination chips, recv[s, le] holds chip s's
+    # dispatched slots for my local expert le.
+    slots = slots.reshape(size, e_local, capacity, D)
+    recv = lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                 # [P_src, e_local, C, D]
+    # Experts process all sources' slots at once (one big MXU matmul per
+    # expert instead of P small ones).
+    tokens = recv.transpose(1, 0, 2, 3).reshape(e_local, size * capacity, D)
+    out = jax.vmap(expert_fn)(expert_params, tokens.astype(x.dtype))
+    out = out.astype(jnp.float32).reshape(e_local, size, capacity, D)
+    out = out.transpose(1, 0, 2, 3)                    # [P_src, e_local, C, D]
+
+    # Route back: the same chip-transpose returns processed slots to their
+    # dispatching chip; reassembling the leading axes as (owner chip,
+    # local expert) recovers the global expert index g = r*e_local + le.
+    back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    back = back.reshape(num_experts, capacity, D)
+    y = jnp.einsum("tec,ecd->td", combine, back).astype(x.dtype)
+    if return_aux:
+        return y, aux
+    return y

@@ -1,0 +1,79 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Beyond-reference capability (SURVEY §2.9: no stage scheduling anywhere in
+the reference). SPMD formulation: every chip runs the same program; chip
+``r`` of the ``"pp"`` axis applies stage ``r``; activations hop to the
+next stage with ``lax.ppermute`` each tick. With M microbatches and P
+stages the schedule runs M + P - 1 ticks (the classic GPipe bubble of
+(P-1)/(M+P-1)); ICI transfers overlap the next tick's compute.
+
+Stage weights are passed stacked over the leading axis and sharded with
+``in_specs=P("pp")`` so each chip holds only its stage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp"):
+    """Run a P-stage pipeline over microbatches inside shard_map.
+
+    Args:
+      stage_fn: ``(params_for_stage, activation) -> activation`` — the same
+        callable for every stage (heterogeneous stages: dispatch on a
+        param field). Activation shape must be stage-invariant.
+      stage_params: this chip's stage weights (pass stacked [P, ...] with
+        ``P("pp")`` in_specs; shard_map strips the leading axis — if the
+        per-chip view keeps a leading singleton, it is squeezed).
+      x: this call's microbatch stack [M, ...micro_shape] (replicated).
+
+    Returns [M, ...out_shape]: outputs of the final stage, replicated via
+    a final broadcast psum so every chip returns the same value.
+    """
+    size = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    M = x.shape[0]
+
+    params = stage_params
+    leaves = jax.tree_util.tree_leaves(params)
+    if leaves and all(l.shape[:1] == (1,) for l in leaves):
+        params = jax.tree_util.tree_map(lambda l: l[0], params)
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    micro_shape = x.shape[1:]
+    n_ticks = M + size - 1
+
+    def tick(t, carry):
+        current, outputs = carry
+        # Stage 0 injects microbatch t (while t < M); other stages use the
+        # activation received from the previous stage.
+        inject = jnp.where(t < M, t, M - 1)
+        current = jnp.where(rank == 0, x[inject], current)
+        result = stage_fn(params, current)
+        # The last stage emits microbatch t - (P - 1) at tick t.
+        out_idx = t - (size - 1)
+        emit = jnp.logical_and(rank == size - 1, out_idx >= 0)
+        safe_idx = jnp.clip(out_idx, 0, M - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(emit, result,
+                               lax.dynamic_index_in_dim(outputs, safe_idx,
+                                                        keepdims=False)),
+            safe_idx, axis=0)
+        outputs = updated
+        # Hop activations forward along the ring.
+        current = lax.ppermute(result, axis, perm)
+        return current, outputs
+
+    current0 = jnp.zeros(micro_shape, x.dtype)
+    outputs0 = jnp.zeros((M,) + micro_shape, x.dtype)
+    _, outputs = lax.fori_loop(0, n_ticks, tick, (current0, outputs0))
+
+    # Only the last stage holds real outputs; replicate them to all chips
+    # (masked psum = broadcast from the last stage).
+    mask = (rank == size - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis)

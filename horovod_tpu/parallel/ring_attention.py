@@ -1,0 +1,73 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+First-class long-context support (beyond the reference, which scaled batch
+only — SURVEY §2.9/§5). Each chip holds a sequence shard of Q, K, V; K/V
+blocks rotate around the mesh axis with ``lax.ppermute`` while every chip
+accumulates its queries' attention over each visiting block with the
+online-softmax (flash) recurrence. Peak memory is O(L_local^2) per step
+instead of O(L^2), and the ICI transfer of the next block overlaps the
+current block's compute (XLA schedules the ppermute concurrently with the
+einsums — the Pallas guide's ring-collective pattern).
+
+Use inside ``shard_map``/``spmd_run`` with the sequence axis sharded, e.g.
+``in_specs=P(None, "sp", None, None)`` for [B, L, H, D].
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.attention import NEG_INF
+
+
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact multi-head attention over a sequence-sharded mesh axis.
+
+    Shapes (per chip): q, k, v [B, L_local, H, D] -> [B, L_local, H, D].
+    Must run inside a shard_map region with ``axis`` active. Causal masks
+    use global token positions, so results match single-chip attention on
+    the gathered sequence exactly.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    size = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+
+    qf = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def step(p, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (rank - p) % size  # owner of the block currently held
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = rank * Lq + jnp.arange(Lq)[:, None]
+            k_pos = src * Lk + jnp.arange(Lk)[None, :]
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p_exp = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p_exp, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p_exp, v_blk.astype(jnp.float32))
+        # Rotate K/V to the next chip; the final rotation returns blocks
+        # home, keeping the loop body uniform for lax.fori_loop.
+        k_next = lax.ppermute(k_blk, axis, perm)
+        v_next = lax.ppermute(v_blk, axis, perm)
+        return k_next, v_next, m_new, l_new, acc_new
+
+    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    _, _, m, l, acc = lax.fori_loop(0, size, step, (k, v, m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Lq, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

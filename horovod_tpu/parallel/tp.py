@@ -1,0 +1,71 @@
+"""Tensor parallelism: Megatron-style column/row-parallel layers.
+
+Beyond-reference capability (SURVEY §2.9: the reference has no sharded
+matmul anywhere): weight matrices shard over a ``"tp"`` mesh axis so the
+MXU works on large local matmuls and only activations cross the ICI. The
+canonical MLP pattern — column-parallel up-projection (no comm), row-
+parallel down-projection (one psum) — costs exactly one allreduce per
+block, and composes with the DP gradient allreduce over an orthogonal
+mesh axis.
+
+Functional helpers assume they run inside shard_map with weights passed
+pre-sharded via in_specs (e.g. ``P(None, "tp")`` for a column split).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel(x, w, b=None, axis: str = "tp",
+                    gather_output: bool = False):
+    """y_local = x @ W_local where W is column-sharded [Din, Dout/P].
+
+    No communication; each chip produces its slice of the output features.
+    ``gather_output=True`` all-gathers feature slices (when the next layer
+    is not row-parallel).
+    """
+    y = x @ w
+    if b is not None:
+        y = y + b
+    if gather_output:
+        y = lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel(x, w, b=None, axis: str = "tp"):
+    """y = psum_p(x_local @ W_local) where W is row-sharded [Din/P, Dout]
+    and x is feature-sharded to match a preceding column-parallel layer.
+
+    One psum produces the full output on every chip; the bias is added
+    once after the reduction.
+    """
+    y = lax.psum(x @ w, axis)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w_up, b_up, w_down, b_down, axis: str = "tp",
+           activation: Callable = jax.nn.gelu):
+    """The canonical 2-layer TP block: column-parallel up (no comm), local
+    activation, row-parallel down (one psum)."""
+    h = activation(column_parallel(x, w_up, b_up, axis))
+    return row_parallel(h, w_down, b_down, axis)
+
+
+def shard_columns(w, axis_size: int, index: int):
+    """Host-side helper: slice the column shard for mesh position
+    ``index`` (used when materializing per-chip weights outside
+    shard_map)."""
+    cols = w.shape[-1] // axis_size
+    return w[..., index * cols:(index + 1) * cols]
+
+
+def shard_rows(w, axis_size: int, index: int):
+    rows = w.shape[0] // axis_size
+    return w[index * rows:(index + 1) * rows]

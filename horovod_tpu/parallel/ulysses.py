@@ -1,0 +1,58 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+The second first-class long-context scheme (with ring attention): instead
+of rotating K/V, one ``lax.all_to_all`` reshards [B, L/P, H, D] (sequence-
+sharded) into [B, L, H/P, D] (head-sharded), full attention runs locally
+per head group, and a second all-to-all reshards back. Communication is
+2 all-to-alls of the activations regardless of sequence length — cheaper
+than ring attention when H >= P and the sequence fits per-chip memory;
+ring attention wins when L_local^2 dominates. Both ride the ICI.
+
+Use inside shard_map with the sequence axis sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.attention import dot_product_attention
+
+
+def _seq_to_heads(x, axis: str):
+    # [B, L/P, H, D] -> [B, L, H/P, D]
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _heads_to_seq(x, axis: str):
+    # [B, L, H/P, D] -> [B, L/P, H, D]
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """All-to-all sequence-parallel attention.
+
+    Per-chip shapes [B, L_local, H, D] -> [B, L_local, H, D]; the head
+    count must be divisible by the axis size. ``attn_fn(q, k, v, causal,
+    scale)`` defaults to the reference jnp kernel; pass
+    :func:`horovod_tpu.ops.attention.flash_attention` on TPU for the
+    Pallas path.
+    """
+    size = lax.axis_size(axis)
+    H = q.shape[2]
+    if H % size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({H}) divisible by axis size ({size}); "
+            "use ring_attention for head counts below the mesh size")
+    qh = _seq_to_heads(q, axis)
+    kh = _seq_to_heads(k, axis)
+    vh = _seq_to_heads(v, axis)
+    if attn_fn is None:
+        out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return _heads_to_seq(out, axis)
