@@ -1,0 +1,232 @@
+"""horovod_tpu.run — the job launcher.
+
+Role parity with the reference's two launch paths:
+
+* ``horovodrun``-style CLI (``python -m horovod_tpu.run -np N cmd...``) —
+  the reference delegated this to ``mpirun`` (docs/running.md); here the
+  launcher owns process placement directly.
+* ``horovod_tpu.run.run(fn, np=N)`` — the ``horovod.spark.run`` analogue
+  (reference spark/__init__.py:80-196): ship a pickled function to N
+  workers, run it, collect per-rank results, fail fast on any error.
+
+Each worker gets the Horovod environment (HOROVOD_RANK/SIZE/LOCAL_RANK/
+LOCAL_SIZE/CONTROLLER/SECRET), replacing the reference's MPI-provided
+COMM_WORLD (operations.cc:1748-1797). Multi-host: ``-H host:n,...`` execs
+workers over ssh with the same env (driver must be reachable).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from horovod_tpu.run.driver import Driver
+from horovod_tpu.run.network import make_secret_key
+
+
+class LaunchError(RuntimeError):
+    def __init__(self, message: str, failures: Optional[dict] = None):
+        super().__init__(message)
+        self.failures = failures or {}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(base: Dict[str, str], rank: int, size: int, local_rank: int,
+                local_size: int, controller: str, driver: str,
+                secret_hex: str) -> Dict[str, str]:
+    env = dict(base)
+    env.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CONTROLLER": controller,
+        "HOROVOD_DRIVER": driver,
+        "HOROVOD_SECRET": secret_hex,
+    })
+    return env
+
+
+def _parse_hosts(hosts: str) -> List[tuple]:
+    """Parse ``host1:4,host2:4`` into [(host, slots), ...]
+    (reference horovodrun -H syntax)."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        out.append((host, int(slots) if slots else 1))
+    return out
+
+
+def _spawn_local(cmd: Sequence[str], env: Dict[str, str]) -> subprocess.Popen:
+    # New process group so one kill() reaps the whole rank's tree
+    # (reference safe_shell_exec process-group discipline).
+    return subprocess.Popen(list(cmd), env=env, start_new_session=True)
+
+
+# Machine-local variables never forwarded to remote ranks; everything else
+# in the job env goes over so all ranks of one job see one environment.
+_SSH_ENV_DENY = ("SSH_", "DISPLAY", "HOSTNAME", "PWD", "OLDPWD", "SHLVL",
+                 "TMPDIR", "XDG_", "DBUS_", "HOME", "LOGNAME", "USER", "_")
+
+
+def _spawn_ssh(host: str, cmd: Sequence[str],
+               env: Dict[str, str]) -> subprocess.Popen:
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items()
+        if not k.startswith(_SSH_ENV_DENY) and "\n" not in v)
+    remote = (f"cd {shlex.quote(os.getcwd())} && env {exports} "
+              + " ".join(shlex.quote(c) for c in cmd))
+    # -tt forces a pty so killing the local ssh client HUPs the remote
+    # process tree — the fail-fast kill works across hosts.
+    return subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", host,
+                             remote], start_new_session=True,
+                            stdin=subprocess.DEVNULL)
+
+
+def _kill_all(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+    deadline = time.monotonic() + 5
+    for p in procs:
+        try:
+            p.wait(max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def launch_command(cmd: Sequence[str], np: int,
+                   hosts: Optional[str] = None,
+                   env: Optional[Dict[str, str]] = None) -> int:
+    """Run ``cmd`` as an N-rank job; returns the job's exit code.
+
+    Fails fast: the first non-zero rank kills the rest (the reference
+    relied on mpirun for exactly this).
+    """
+    base_env = dict(env if env is not None else os.environ)
+    secret_hex = make_secret_key().hex()
+
+    placements: List[tuple] = []  # (host or None, local_rank, local_size)
+    if hosts:
+        parsed = _parse_hosts(hosts)
+        total = sum(s for _, s in parsed)
+        if total != np:
+            raise LaunchError(f"-H slots ({total}) != -np ({np})")
+        for host, slots in parsed:
+            for lr in range(slots):
+                placements.append((host, lr, slots))
+    else:
+        placements = [(None, r, np) for r in range(np)]
+
+    first_host = placements[0][0]
+    if first_host is None or first_host in ("localhost", "127.0.0.1"):
+        controller_host = "127.0.0.1"
+        controller_port = _free_port()  # rank 0 binds on this machine
+    else:
+        # Rank 0 binds on a remote host we cannot probe; pick from the
+        # high ephemeral range and let its init report a bind conflict.
+        controller_host = first_host
+        controller_port = random.randint(20000, 59999)
+    controller = f"{controller_host}:{controller_port}"
+
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank, (host, local_rank, local_size) in enumerate(placements):
+            wenv = _worker_env(base_env, rank, np, local_rank, local_size,
+                               controller, "", secret_hex)
+            if host is None or host in ("localhost", "127.0.0.1"):
+                procs.append(_spawn_local(cmd, wenv))
+            else:
+                procs.append(_spawn_ssh(host, cmd, wenv))
+        # Supervise: poll until all exit or one fails.
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = [c for c in codes if c not in (None, 0)]
+            if bad:
+                _kill_all(procs)
+                return bad[0]
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        _kill_all(procs)
+        raise
+    finally:
+        if any(p.poll() is None for p in procs):
+            _kill_all(procs)
+
+
+def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
+        env: Optional[Dict[str, str]] = None,
+        start_timeout: float = 120.0,
+        run_timeout: float = 600.0) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` local ranks; returns the list
+    of per-rank return values, rank-ordered (reference horovod.spark.run
+    semantics, spark/__init__.py:80-196)."""
+    key = make_secret_key()
+    driver = Driver(np, key, fn=fn, args=args, kwargs=kwargs)
+    base_env = dict(env if env is not None else os.environ)
+    secret_hex = key.hex()
+    controller = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"127.0.0.1:{driver.port}"
+
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(np):
+            wenv = _worker_env(base_env, rank, np, rank, np, controller,
+                               driver_addr, secret_hex)
+            procs.append(_spawn_local(
+                [sys.executable, "-m", "horovod_tpu.run.task_exec"], wenv))
+        if not driver.wait_registered(start_timeout):
+            raise LaunchError(
+                f"timed out after {start_timeout}s waiting for "
+                f"{np} workers to register")
+
+        def worker_died():
+            return any(p.poll() not in (None, 0) for p in procs)
+
+        results = driver.wait_results(run_timeout, should_abort=worker_died)
+        failures = {r: res.payload for r, res in results.items()
+                    if not res.success}
+        if failures:
+            first = min(failures)
+            raise LaunchError(
+                f"rank {first} failed:\n{failures[first]}", failures)
+        if len(results) < np:
+            dead = [r for r, p in enumerate(procs)
+                    if p.poll() not in (None, 0)]
+            if dead:
+                raise LaunchError(
+                    f"rank(s) {dead} exited without reporting "
+                    f"(exit codes {[procs[r].poll() for r in dead]})")
+            raise LaunchError(
+                f"timed out after {run_timeout}s: only {len(results)}/{np} "
+                "ranks reported")
+        return [results[r].payload for r in range(np)]
+    finally:
+        _kill_all(procs)
+        driver.close()
+
+
+__all__ = ["run", "launch_command", "LaunchError"]

@@ -1,0 +1,138 @@
+"""Launch driver: rendezvous, function distribution, result collection.
+
+Role parity with reference horovod/spark/driver/driver_service.py (task
+registration, address table, code distribution :21-95) and
+horovod/spark/task/* (fetch fn, run, report result) — with the process
+placement the Spark+mpirun stack did (SURVEY §2.8) done directly by the
+launcher (subprocess locally, ssh per host remotely).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from horovod_tpu.run.network import BasicClient, BasicService
+
+
+@dataclasses.dataclass
+class RegisterRequest:
+    rank: int
+    host: str
+
+
+@dataclasses.dataclass
+class RegisterResponse:
+    ok: bool
+
+
+@dataclasses.dataclass
+class FetchTaskRequest:
+    rank: int
+
+
+@dataclasses.dataclass
+class FetchTaskResponse:
+    fn: Any          # cloudpickled-by-wire callable
+    args: tuple
+    kwargs: dict
+
+
+@dataclasses.dataclass
+class ResultRequest:
+    rank: int
+    success: bool
+    payload: Any     # return value or formatted traceback
+
+
+@dataclasses.dataclass
+class Ack:
+    pass
+
+
+class Driver:
+    """Runs in the launcher process; workers talk to it over the
+    authenticated RPC."""
+
+    def __init__(self, world_size: int, key: bytes, fn=None, args=(),
+                 kwargs=None):
+        self._world_size = world_size
+        self._fn = fn
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._registered: Dict[int, str] = {}
+        self._results: Dict[int, ResultRequest] = {}
+        self._service = BasicService("driver", key, self._handle)
+
+    @property
+    def port(self) -> int:
+        return self._service.port
+
+    def _handle(self, req):
+        if isinstance(req, RegisterRequest):
+            with self._cond:
+                self._registered[req.rank] = req.host
+                self._cond.notify_all()
+            return RegisterResponse(ok=True)
+        if isinstance(req, FetchTaskRequest):
+            return FetchTaskResponse(fn=self._fn, args=self._args,
+                                     kwargs=self._kwargs)
+        if isinstance(req, ResultRequest):
+            with self._cond:
+                self._results[req.rank] = req
+                self._cond.notify_all()
+            return Ack()
+        raise ValueError(f"unknown request {type(req).__name__}")
+
+    def wait_registered(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self._registered) < self._world_size:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._cond.wait(remain)
+        return True
+
+    def wait_results(self, timeout: float,
+                     should_abort=None) -> Dict[int, ResultRequest]:
+        """``should_abort()`` lets the launcher bail when a worker process
+        dies without reporting (crash, OOM-kill)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self._results) < self._world_size:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                # Fast failure propagation (reference spark/__init__.py:
+                # 181-192): one failed rank fails the job immediately.
+                if any(not r.success for r in self._results.values()):
+                    break
+                if should_abort is not None and should_abort():
+                    break
+                self._cond.wait(min(remain, 0.25))
+            return dict(self._results)
+
+    def close(self) -> None:
+        self._service.close()
+
+
+class WorkerClient:
+    """Worker-side RPC stub (reference task_service.py role)."""
+
+    def __init__(self, driver_addr, key: bytes):
+        self._client = BasicClient(driver_addr, key)
+
+    def register(self, rank: int, host: str) -> None:
+        self._client.request(RegisterRequest(rank=rank, host=host))
+
+    def fetch_task(self, rank: int) -> FetchTaskResponse:
+        return self._client.request(FetchTaskRequest(rank=rank))
+
+    def report(self, rank: int, success: bool, payload: Any) -> None:
+        self._client.request(ResultRequest(rank=rank, success=success,
+                                           payload=payload))

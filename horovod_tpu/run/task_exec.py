@@ -1,0 +1,51 @@
+"""Per-rank exec stub for ``horovod_tpu.run.run(fn, ...)``.
+
+Role parity with reference horovod/spark/task/mpirun_exec_fn.py:29-48:
+look up identity from the environment, fetch the pickled fn from the
+driver, run it, report the result — plus the parent-death watchdog
+(reference :25-31) so orphaned ranks exit instead of leaking.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+
+def _parent_watchdog(parent_pid: int) -> None:
+    while True:
+        if os.getppid() != parent_pid:
+            os._exit(1)  # launcher died; don't linger
+        time.sleep(1.0)
+
+
+def main() -> int:
+    rank = int(os.environ["HOROVOD_RANK"])
+    driver_host, _, driver_port = os.environ["HOROVOD_DRIVER"].rpartition(":")
+    key = bytes.fromhex(os.environ["HOROVOD_SECRET"])
+
+    threading.Thread(target=_parent_watchdog, args=(os.getppid(),),
+                     daemon=True).start()
+
+    from horovod_tpu.run.driver import WorkerClient
+
+    client = WorkerClient((driver_host, int(driver_port)), key)
+    client.register(rank, os.uname().nodename)
+    try:
+        # fetch_task can itself fail (e.g. the fn unpickles by reference
+        # from a module this worker cannot import) — report that too, so
+        # the driver fails fast instead of waiting out its timeout.
+        task = client.fetch_task(rank)
+        result = task.fn(*task.args, **task.kwargs)
+    except BaseException:
+        client.report(rank, False, traceback.format_exc())
+        return 1
+    client.report(rank, True, result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Tests for horovod_tpu.run (reference test/test_spark.py analogue):
+HMAC wire integrity, run(fn) happy path with collectives, failure
+propagation, timeout, CLI launch."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import cloudpickle
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Task fns below live in this module, which workers cannot import (tests/
+# is not a package); ship them by value like user script (__main__) fns.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    return env
+
+
+# Module-level task fns (pickled by cloudpickle; module-level keeps them
+# importable on the worker side too).
+
+def _task_allreduce():
+    import numpy as np
+
+    import horovod_tpu.torch as hvd
+    import torch
+
+    hvd.init()
+    t = torch.ones(8) * (hvd.rank() + 1)
+    out = hvd.allreduce(t, average=False)
+    hvd.shutdown()
+    return float(out[0])
+
+
+def _task_identity():
+    import os
+
+    return (int(os.environ["HOROVOD_RANK"]), int(os.environ["HOROVOD_SIZE"]))
+
+
+def _task_fail_on_rank1():
+    import os
+
+    if os.environ["HOROVOD_RANK"] == "1":
+        raise RuntimeError("boom on rank 1")
+    return "ok"
+
+
+def _task_lambda_capture(x):
+    return x * 2
+
+
+class TestWire:
+    def test_roundtrip(self):
+        from horovod_tpu.run.network import BasicClient, BasicService, \
+            make_secret_key
+
+        key = make_secret_key()
+        svc = BasicService("t", key, lambda req: {"echo": req})
+        try:
+            out = BasicClient(("127.0.0.1", svc.port), key).request([1, "a"])
+            assert out == {"echo": [1, "a"]}
+        finally:
+            svc.close()
+
+    def test_bad_secret_rejected(self):
+        from horovod_tpu.run.network import BasicClient, BasicService, \
+            make_secret_key
+
+        svc = BasicService("t", make_secret_key(), lambda req: req)
+        try:
+            client = BasicClient(("127.0.0.1", svc.port), make_secret_key(),
+                                 timeout=5.0)
+            # Server drops unauthenticated connections without response.
+            with pytest.raises((ConnectionError, socket.timeout, OSError)):
+                client.request("sneaky")
+        finally:
+            svc.close()
+
+    def test_tampered_payload_rejected(self):
+        import struct
+
+        from horovod_tpu.run.network import IntegrityError, Wire, \
+            make_secret_key
+        import cloudpickle
+        import hashlib
+        import hmac as hmac_mod
+
+        key = make_secret_key()
+        wire = Wire(key)
+        payload = cloudpickle.dumps({"x": 1})
+        digest = hmac_mod.new(key, payload, hashlib.sha256).digest()
+        tampered = payload[:-1] + bytes([payload[-1] ^ 0xFF])
+
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack("<Q", len(tampered)) + digest + tampered)
+            with pytest.raises(IntegrityError, match="integrity"):
+                wire.read(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestRunFn:
+    def test_identity_env(self):
+        from horovod_tpu.run import run
+
+        results = run(_task_identity, np=3, env=_clean_env())
+        assert results == [(0, 3), (1, 3), (2, 3)]
+
+    def test_collectives_through_launcher(self):
+        from horovod_tpu.run import run
+
+        results = run(_task_allreduce, np=2, env=_clean_env(),
+                      run_timeout=180.0)
+        assert results == [3.0, 3.0]  # 1 + 2 on both ranks
+
+    def test_args_kwargs_and_closures(self):
+        from horovod_tpu.run import run
+
+        offset = 5
+        results = run(lambda x, y=0: x * 2 + y + offset, args=(10,),
+                      kwargs={"y": 1}, np=2, env=_clean_env())
+        assert results == [26, 26]
+
+    def test_failure_propagates_fast(self):
+        from horovod_tpu.run import LaunchError, run
+
+        t0 = time.monotonic()
+        with pytest.raises(LaunchError, match="boom on rank 1") as ei:
+            run(_task_fail_on_rank1, np=2, env=_clean_env(),
+                run_timeout=300.0)
+        assert time.monotonic() - t0 < 60  # far below run_timeout
+        assert 1 in ei.value.failures
+
+
+class TestCLI:
+    def test_launch_command_success(self):
+        code = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             sys.executable, "-c",
+             "import horovod_tpu.torch as hvd, torch; hvd.init(); "
+             "out = hvd.allreduce(torch.ones(4), average=False); "
+             "assert float(out[0]) == 2.0, out; hvd.shutdown()"],
+            env=_clean_env(), cwd=str(REPO), timeout=180).returncode
+        assert code == 0
+
+    def test_launch_command_failure_code(self):
+        code = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             sys.executable, "-c",
+             "import os, sys; sys.exit(3 if os.environ['HOROVOD_RANK'] == '0' else 0)"],
+            env=_clean_env(), cwd=str(REPO), timeout=120).returncode
+        assert code == 3
+
+    def test_hosts_slot_mismatch(self):
+        from horovod_tpu.run import LaunchError, launch_command
+
+        with pytest.raises(LaunchError, match="slots"):
+            launch_command(["true"], np=3, hosts="localhost:2")
