@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Synthetic benchmark for any zoo model
+(reference examples/pytorch_synthetic_benchmark.py, same protocol).
+
+Thin front-end over the repo-root ``bench.py`` harness:
+
+    python examples/jax_synthetic_benchmark.py --model vgg16
+    python examples/jax_synthetic_benchmark.py --model inception_v3 \
+        --image-size 299
+"""
+
+import pathlib
+import runpy
+import sys
+
+if __name__ == "__main__":
+    bench = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    sys.argv[0] = str(bench)
+    runpy.run_path(str(bench), run_name="__main__")

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Torch CPU data-parallel MNIST (reference examples/pytorch_mnist.py).
+
+The same training script structure as the reference, over the native
+TCP-ring core instead of MPI: per-rank data shard (DistributedSampler
+analogue), DistributedOptimizer, broadcast_parameters, metric allreduce.
+
+Run:  python -m horovod_tpu.run -np 2 python examples/torch_mnist.py
+"""
+
+import argparse
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+from torch.utils.data import DataLoader, TensorDataset
+from torch.utils.data.distributed import DistributedSampler
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    """The reference's convnet (pytorch_mnist.py:30-47)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def make_dataset(n, seed=0):
+    templates = np.random.RandomState(0).randn(10, 1, 28, 28).astype(
+        np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    images = templates[labels] + 0.3 * rng.randn(n, 1, 28, 28).astype(
+        np.float32)
+    return TensorDataset(torch.from_numpy(images),
+                         torch.from_numpy(labels.astype(np.int64)))
+
+
+def metric_average(val, name):
+    """Reference pytorch_mnist.py:120-126."""
+    tensor = torch.tensor(val)
+    avg_tensor = hvd.allreduce(tensor, name=name)
+    return avg_tensor.item()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.005)
+    parser.add_argument("--momentum", type=float, default=0.5)
+    parser.add_argument("--train-size", type=int, default=2048)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    train_dataset = make_dataset(args.train_size)
+    # Partition the data across ranks (reference pytorch_mnist.py:64-67).
+    sampler = DistributedSampler(train_dataset, num_replicas=hvd.size(),
+                                 rank=hvd.rank())
+    loader = DataLoader(train_dataset, batch_size=args.batch_size,
+                        sampler=sampler)
+    test_dataset = make_dataset(512, seed=1)
+    test_loader = DataLoader(test_dataset, batch_size=256)
+
+    model = Net()
+    # Scale lr by size (reference :106), wrap, broadcast.
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(),
+                                momentum=args.momentum)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    for epoch in range(args.epochs):
+        model.train()
+        sampler.set_epoch(epoch)
+        for batch_idx, (data, target) in enumerate(loader):
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+
+        model.eval()
+        test_loss, correct, count = 0.0, 0.0, 0
+        with torch.no_grad():
+            for data, target in test_loader:
+                output = model(data)
+                test_loss += F.nll_loss(output, target,
+                                        reduction="sum").item()
+                correct += output.argmax(1).eq(target).sum().item()
+                count += len(target)
+        test_loss = metric_average(test_loss / count, "avg_loss")
+        accuracy = metric_average(correct / count, "avg_accuracy")
+        if hvd.rank() == 0:
+            print(f"Epoch {epoch + 1}: test_loss={test_loss:.4f} "
+                  f"test_acc={accuracy:.4f}")
+
+    hvd.shutdown()
+    return 0 if accuracy > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

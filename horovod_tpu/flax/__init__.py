@@ -93,6 +93,21 @@ class TrainLoop:
 # restore everywhere, then re-broadcast from root.
 
 
+def _plain_containers(obj):
+    """Flax serialization dispatches on exact container type; normalize
+    Mapping subclasses (TrainState, FrozenDict) to plain dicts so they
+    round-trip. Namedtuples (optax states) are handled natively."""
+    from collections.abc import Mapping
+
+    if isinstance(obj, Mapping):
+        return {k: _plain_containers(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*(_plain_containers(v) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_plain_containers(v) for v in obj)
+    return obj
+
+
 def save_model(path: str, state, only_rank0: bool = True) -> None:
     """Serialize a train-state pytree (flax msgpack). With
     ``only_rank0=True`` non-root processes no-op, the reference's
@@ -102,8 +117,9 @@ def save_model(path: str, state, only_rank0: bool = True) -> None:
     if only_rank0 and basics.is_initialized() and basics.rank() != 0:
         return
     tmp = f"{path}.{os.getpid()}.tmp"
+    payload = serialization.to_bytes(_plain_containers(state))
     with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(state))
+        f.write(payload)
     os.replace(tmp, path)
 
 
@@ -116,8 +132,23 @@ def load_model(path: str, template, root_rank: int = 0,
     ``root_rank``, mirroring ``hvd.load_model``'s re-wrapping + broadcast
     flow (reference _keras/__init__.py:93-109, keras/__init__.py:121-148).
     """
-    with open(path, "rb") as f:
-        state = serialization.from_bytes(template, f.read())
+    from horovod_tpu.common import basics
+
+    # Root-rank-only read (reference restore flow): with broadcast on,
+    # non-root ranks take values purely from the broadcast — required on
+    # multi-host where only rank 0's filesystem has the checkpoint.
+    must_read = (not broadcast or not basics.is_initialized()
+                 or basics.rank() == root_rank)
+    if must_read:
+        with open(path, "rb") as f:
+            restored = serialization.from_bytes(_plain_containers(template),
+                                                f.read())
+    else:
+        restored = _plain_containers(template)
+    # Rebuild with the template's own container types (TrainState etc.).
+    state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template),
+        jax.tree_util.tree_leaves(restored))
     if broadcast:
         state = broadcast_parameters(state, root_rank)
     return state

@@ -44,6 +44,7 @@ from horovod_tpu.jax.mpi_ops import (
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    allreduce_sparse,
     alltoall,
     broadcast,
     broadcast_,
@@ -86,6 +87,7 @@ __all__ = [
     "allreduce_",
     "allreduce_async",
     "allreduce_async_",
+    "allreduce_sparse",
     "grouped_allreduce",
     "allgather",
     "allgather_async",

@@ -518,3 +518,59 @@ def reducescatter(tensor, average: bool = True, name: Optional[str] = None):
     if average:
         out = out / n
     return out
+
+
+# --------------------------------------------------------------------------
+# Sparse allreduce (reference tensorflow/__init__.py:72-83: a sparse
+# tf.IndexedSlices gradient is allreduced as allgather(values) +
+# allgather(indices) — summing slice contributions without densifying the
+# full embedding table on the wire).
+
+
+def allreduce_sparse(indices, values, dense_rows: Optional[int] = None,
+                     average: bool = True, name: Optional[str] = None):
+    """Cross-rank reduction of a sparse row update set.
+
+    ``indices`` [k] are row ids into a [dense_rows, ...] tensor; ``values``
+    [k, ...] the per-row contributions. Returns:
+
+    * with ``dense_rows``: the dense [dense_rows, ...] summed (or averaged)
+      gradient — duplicate rows across ranks accumulate, exactly what
+      ``sparse_as_dense`` produced in the reference
+      (tensorflow/__init__.py:183-209);
+    * without: ``(gathered_indices, gathered_values)``, the reference's raw
+      IndexedSlices semantics (duplicates left to the consumer).
+    """
+    global_state().require_init()
+    axis = _spmd_axis_or_none()
+    name = _normalize_name(name) if name else _auto_name("sparse", values)
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    if axis is not None:
+        all_indices = lax.all_gather(indices, axis, axis=0, tiled=True)
+        all_values = lax.all_gather(values, axis, axis=0, tiled=True)
+        n = _axis_size(axis)
+    else:
+        nproc, _ = _eager_world()
+        tl = _timeline()
+        if tl is not None:
+            tl.start(name, "SPARSE_ALLREDUCE")
+        try:
+            if nproc == 1:
+                all_indices, all_values, n = indices, values, 1
+            else:
+                from horovod_tpu.jax import eager
+
+                all_indices = eager.process_allgather(indices)
+                all_values = eager.process_allgather(values)
+                n = nproc
+        finally:
+            if tl is not None:
+                tl.end(name)
+    if average:
+        all_values = all_values / n
+    if dense_rows is None:
+        return all_indices, all_values
+    dense = jnp.zeros((dense_rows,) + all_values.shape[1:],
+                      all_values.dtype)
+    return dense.at[all_indices].add(all_values)

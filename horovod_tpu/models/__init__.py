@@ -1,5 +1,11 @@
-"""Model zoo used by the examples, benchmarks, and tests."""
+"""Model zoo used by the examples, benchmarks, and tests.
 
+Families mirror the reference's published benchmark set (Inception V3,
+ResNet, VGG — reference docs/benchmarks.md:5-6) plus the long-context
+Transformer LM this rebuild adds as a first-class workload.
+"""
+
+from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.mnist import MNISTNet
 from horovod_tpu.models.resnet import (
     ResNet,
@@ -8,8 +14,8 @@ from horovod_tpu.models.resnet import (
     ResNet50,
     ResNet101,
     ResNet152,
-    build,
 )
+from horovod_tpu.models.resnet import _FAMILY as _RESNET_FAMILY
 from horovod_tpu.models.train import (
     TrainState,
     create_train_state,
@@ -17,6 +23,31 @@ from horovod_tpu.models.train import (
     make_eval_step,
     make_train_step,
 )
+from horovod_tpu.models.transformer import TransformerBlock, TransformerLM
+from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
+
+_FAMILY = dict(_RESNET_FAMILY)
+_FAMILY.update({
+    "vgg11": VGG11,
+    "vgg13": VGG13,
+    "vgg16": VGG16,
+    "vgg19": VGG19,
+    "inception_v3": InceptionV3,
+    "inception3": InceptionV3,
+    "transformer_lm": TransformerLM,
+})
+
+
+def build(name: str, **kwargs):
+    """Construct any zoo model by torchvision-style name (the reference
+    benchmark selected models via ``getattr(torchvision.models, ...)``,
+    examples/pytorch_synthetic_benchmark.py:55)."""
+    try:
+        return _FAMILY[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"Unknown model {name!r}; have {sorted(_FAMILY)}") from None
+
 
 __all__ = [
     "MNISTNet",
@@ -26,6 +57,14 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "VGG",
+    "VGG11",
+    "VGG13",
+    "VGG16",
+    "VGG19",
+    "InceptionV3",
+    "TransformerBlock",
+    "TransformerLM",
     "build",
     "TrainState",
     "create_train_state",

@@ -1,0 +1,153 @@
+"""Inception V3 — the reference's headline 90%-efficiency workload.
+
+The reference's published scaling table leads with Inception V3 (90% at
+512 GPUs — reference README.md:53-58, docs/benchmarks.md:5-6); its
+benchmark harness ran it via tf_cnn_benchmarks. This is a TPU-native
+flax implementation of the standard architecture (Szegedy et al. 2015,
+the torchvision/slim layer plan): NHWC, bf16 compute / fp32 norms,
+static shapes, no aux head by default (benchmarks run without it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(c(48, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train),
+                           train)
+        pool = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(self.pool_features, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(96, (3, 3), strides=(2, 2), padding="VALID")(
+            c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        pool = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, pool], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(c7, (1, 1))(x, train)
+        b2 = c(c7, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b3 = c(c7, (1, 1))(x, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(c7, (1, 7))(b3, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(192, (1, 7))(b3, train)
+        pool = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(192, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (3, 3), strides=(2, 2), padding="VALID")(
+            c(192, (1, 1))(x, train), train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(192, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b2 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        pool = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, pool], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([c(384, (1, 3))(b2, train),
+                              c(384, (3, 1))(b2, train)], axis=-1)
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([c(384, (1, 3))(b3, train),
+                              c(384, (3, 1))(b3, train)], axis=-1)
+        pool = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(192, (1, 1))(pool, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Standard 299x299 Inception V3 (torchvision layer plan)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = c(80, (1, 1), padding="VALID")(x, train)
+        x = c(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionB(self.dtype)(x, train)
+        x = InceptionC(128, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(192, self.dtype)(x, train)
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

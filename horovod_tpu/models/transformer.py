@@ -1,0 +1,90 @@
+"""Decoder-only Transformer LM — the long-context flagship.
+
+Beyond-reference model family (the reference's longest-context artifact is
+word2vec, SURVEY §2.9): a GPT-style causal LM whose attention is pluggable
+so the same network trains single-chip (flash attention on the MXU),
+sequence-parallel via ring attention, or via Ulysses all-to-all — the
+framework's long-context story end to end.
+
+TPU-native choices: bf16 compute / fp32 layernorm+softmax+logits, static
+shapes, pre-norm blocks, learned positional embeddings, no Python control
+flow in the forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.ops.attention import dot_product_attention
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    mlp_ratio: int = 4
+    # attn_fn(q, k, v) -> out, shapes [B, L, H, D]. The fn owns causality
+    # and cross-shard positioning (e.g. a ring-attention closure passes
+    # causal=True itself; ring/Ulysses derive offsets from the mesh axis).
+    # None = dense causal attention using q_offset.
+    attn_fn: Optional[Callable] = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, q_offset: int = 0):
+        E = x.shape[-1]
+        H = self.num_heads
+        D = E // H
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * E, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*q.shape[:-1], H, D)
+        if self.attn_fn is None:
+            attn = dot_product_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                causal=True, q_offset=q_offset)
+        else:
+            attn = self.attn_fn(q.reshape(shape), k.reshape(shape),
+                                v.reshape(shape))
+        attn = attn.reshape(q.shape)
+        x = x + nn.Dense(E, dtype=self.dtype)(attn)
+
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(self.mlp_ratio * E, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + nn.Dense(E, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: token ids [B, L] -> logits [B, L, vocab]."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    embed_dim: int = 512
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, pos_offset: int = 0):
+        """``pos_offset``: global position of tokens[:, 0] — sequence-
+        parallel callers pass their shard's offset so positional
+        embeddings and causal masks stay globally consistent."""
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype)(tokens)
+        pos = pos_offset + jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.embed_dim,
+                         dtype=self.dtype)(pos)[None]
+        for _ in range(self.num_layers):
+            x = TransformerBlock(self.num_heads, dtype=self.dtype,
+                                 attn_fn=self.attn_fn,
+                                 dropout=self.dropout)(
+                                     x, train=train, q_offset=pos_offset)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        use_bias=False)(x)

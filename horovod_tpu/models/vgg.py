@@ -1,0 +1,66 @@
+"""VGG family (11/13/16/19) — the reference's hardest scaling workload.
+
+VGG-16 is the model the reference's published benchmarks scale WORST on
+(68% efficiency at 512 GPUs vs 90% for ResNet — reference README.md:58,
+docs/benchmarks.md:6) because its ~138M parameters make the gradient
+allreduce enormous relative to compute. That makes it the stress test for
+this framework's fused-bucket gradient psum. TPU-native choices mirror
+resnet.py: NHWC, bfloat16 compute with fp32 params, static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Convolution plans: ints are conv filter counts, "M" is 2x2 max-pool
+# (the classic configurations A/B/D/E).
+_PLANS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG with batch-norm (the variant every modern benchmark uses)."""
+
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    hidden: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for step in _PLANS[self.depth]:
+            if step == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(features=step)(x)
+                x = norm()(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+VGG11 = partial(VGG, depth=11)
+VGG13 = partial(VGG, depth=13)
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

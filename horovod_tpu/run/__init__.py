@@ -88,14 +88,21 @@ def _spawn_ssh(host: str, cmd: Sequence[str],
                env: Dict[str, str]) -> subprocess.Popen:
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env.items()
-        if not k.startswith(_SSH_ENV_DENY) and "\n" not in v)
-    remote = (f"cd {shlex.quote(os.getcwd())} && env {exports} "
+        if not k.startswith(_SSH_ENV_DENY) and k != "HOROVOD_SECRET"
+        and "\n" not in v)
+    # The HMAC secret must never appear on a command line (argv is world-
+    # readable via /proc on the remote host); ship it over stdin instead.
+    remote = ("IFS= read -r HOROVOD_SECRET && export HOROVOD_SECRET && "
+              f"cd {shlex.quote(os.getcwd())} && env {exports} "
               + " ".join(shlex.quote(c) for c in cmd))
     # -tt forces a pty so killing the local ssh client HUPs the remote
     # process tree — the fail-fast kill works across hosts.
-    return subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", host,
+    proc = subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", host,
                              remote], start_new_session=True,
-                            stdin=subprocess.DEVNULL)
+                            stdin=subprocess.PIPE)
+    proc.stdin.write((env.get("HOROVOD_SECRET", "") + "\n").encode())
+    proc.stdin.flush()
+    return proc
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
