@@ -1,0 +1,325 @@
+#include "auth.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
+
+namespace hvdtpu {
+
+namespace {
+
+// --- SHA-256 (FIPS 180-4) --------------------------------------------------
+
+constexpr uint32_t kSha256K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256Ctx {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint8_t block[64];
+  size_t block_len = 0;
+  uint64_t total_len = 0;
+
+  void Compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + kSha256K[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const uint8_t* data, size_t len) {
+    total_len += len;
+    while (len > 0) {
+      size_t take = 64 - block_len;
+      if (take > len) take = len;
+      memcpy(block + block_len, data, take);
+      block_len += take;
+      data += take;
+      len -= take;
+      if (block_len == 64) {
+        Compress(block);
+        block_len = 0;
+      }
+    }
+  }
+
+  std::vector<uint8_t> Final() {
+    uint64_t bits = total_len * 8;  // message length, captured pre-padding
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (block_len != 56) Update(&zero, 1);
+    uint8_t lenbuf[8];
+    for (int i = 0; i < 8; ++i)
+      lenbuf[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+    Update(lenbuf, 8);
+    std::vector<uint8_t> out(32);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = static_cast<uint8_t>(h[i] >> 24);
+      out[4 * i + 1] = static_cast<uint8_t>(h[i] >> 16);
+      out[4 * i + 2] = static_cast<uint8_t>(h[i] >> 8);
+      out[4 * i + 3] = static_cast<uint8_t>(h[i]);
+    }
+    return out;
+  }
+};
+
+using Clock = std::chrono::steady_clock;
+
+// All handshake I/O is deadline-bounded: a peer running the wrong auth
+// mode (secret set on one side only) desynchronizes the wire protocol, and
+// without a deadline both sides would block in recv() forever instead of
+// failing within Init's timeout.
+Status PollReady(int fd, short events, Clock::time_point deadline) {
+  while (true) {
+    auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - Clock::now()).count();
+    if (remain <= 0)
+      return Status::Aborted(
+          "auth handshake timed out (is HOROVOD_SECRET set consistently on "
+          "every rank?)");
+    struct pollfd pfd = {fd, events, 0};
+    int rc = ::poll(&pfd, 1, static_cast<int>(remain));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("auth poll: ") + strerror(errno));
+    }
+    if (rc > 0) return Status::OK();
+  }
+}
+
+Status SendExact(int fd, const void* data, size_t len,
+                 Clock::time_point deadline) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  while (len > 0) {
+    Status ps = PollReady(fd, POLLOUT, deadline);
+    if (!ps.ok()) return ps;
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return Status::Unknown(std::string("auth send: ") + strerror(errno));
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status RecvExact(int fd, void* data, size_t len, Clock::time_point deadline) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  while (len > 0) {
+    Status ps = PollReady(fd, POLLIN, deadline);
+    if (!ps.ok()) return ps;
+    ssize_t n = ::recv(fd, p, len, MSG_DONTWAIT);
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return Status::Unknown(std::string("auth recv: ") + strerror(errno));
+    }
+    if (n == 0) return Status::Aborted("peer closed during auth handshake");
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status FillRandom(uint8_t* buf, size_t len) {
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd < 0)
+    return Status::Unknown("open /dev/urandom failed");
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::read(fd, buf + got, len - got);
+    if (n <= 0) {
+      ::close(fd);
+      return Status::Unknown("read /dev/urandom failed");
+    }
+    got += static_cast<size_t>(n);
+  }
+  ::close(fd);
+  return Status::OK();
+}
+
+constexpr size_t kNonceLen = 16;
+
+// tag = HMAC(key, label || purpose || nonce1 || nonce2 || rank_le32)
+std::vector<uint8_t> ProofTag(const std::string& key, const char* label,
+                              uint8_t purpose, const uint8_t* nonce1,
+                              const uint8_t* nonce2, int32_t rank) {
+  std::vector<uint8_t> msg;
+  msg.insert(msg.end(), label, label + strlen(label));
+  msg.push_back(purpose);
+  msg.insert(msg.end(), nonce1, nonce1 + kNonceLen);
+  msg.insert(msg.end(), nonce2, nonce2 + kNonceLen);
+  for (int i = 0; i < 4; ++i)
+    msg.push_back(static_cast<uint8_t>(static_cast<uint32_t>(rank) >> (8 * i)));
+  return HmacSha256(key, msg.data(), msg.size());
+}
+
+}  // namespace
+
+std::vector<uint8_t> Sha256(const uint8_t* data, size_t len) {
+  Sha256Ctx ctx;
+  ctx.Update(data, len);
+  return ctx.Final();
+}
+
+std::vector<uint8_t> HmacSha256(const std::string& key, const uint8_t* data,
+                                size_t len) {
+  std::vector<uint8_t> k(key.begin(), key.end());
+  if (k.size() > 64) k = Sha256(k.data(), k.size());
+  k.resize(64, 0);
+  std::vector<uint8_t> inner(64 + len);
+  for (int i = 0; i < 64; ++i) inner[i] = k[i] ^ 0x36;
+  memcpy(inner.data() + 64, data, len);
+  std::vector<uint8_t> ihash = Sha256(inner.data(), inner.size());
+  std::vector<uint8_t> outer(64 + 32);
+  for (int i = 0; i < 64; ++i) outer[i] = k[i] ^ 0x5c;
+  memcpy(outer.data() + 64, ihash.data(), 32);
+  return Sha256(outer.data(), outer.size());
+}
+
+bool ConstantTimeEquals(const std::vector<uint8_t>& a,
+                        const std::vector<uint8_t>& b) {
+  if (a.size() != b.size()) return false;
+  uint8_t acc = 0;
+  for (size_t i = 0; i < a.size(); ++i) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+std::string JobSecretFromEnv() {
+  const char* env = std::getenv("HOROVOD_SECRET");
+  if (env == nullptr || env[0] == '\0') return "";
+  std::string hex(env);
+  auto nibble = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  if (hex.size() % 2 == 0) {
+    std::string raw;
+    raw.reserve(hex.size() / 2);
+    bool ok = true;
+    for (size_t i = 0; i + 1 < hex.size() && ok; i += 2) {
+      int hi = nibble(hex[i]), lo = nibble(hex[i + 1]);
+      if (hi < 0 || lo < 0)
+        ok = false;
+      else
+        raw.push_back(static_cast<char>((hi << 4) | lo));
+    }
+    if (ok && !raw.empty()) return raw;
+  }
+  return hex;  // not hex: use the raw string as the key
+}
+
+Status HandshakeAccept(int fd, const std::string& key, uint8_t purpose,
+                       int timeout_ms, int32_t* out_peer_rank) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  if (key.empty()) {  // unauthenticated mode: plain rank announcement
+    int32_t peer_rank = -1;
+    Status s = RecvExact(fd, &peer_rank, sizeof(peer_rank), deadline);
+    if (!s.ok()) return s;
+    *out_peer_rank = peer_rank;
+    return Status::OK();
+  }
+  uint8_t nonce_a[kNonceLen];
+  Status s = FillRandom(nonce_a, kNonceLen);
+  if (!s.ok()) return s;
+  s = SendExact(fd, nonce_a, kNonceLen, deadline);
+  if (!s.ok()) return s;
+
+  uint8_t reply[kNonceLen + 4 + 32];
+  s = RecvExact(fd, reply, sizeof(reply), deadline);
+  if (!s.ok()) return s;
+  const uint8_t* nonce_b = reply;
+  int32_t peer_rank = 0;
+  memcpy(&peer_rank, reply + kNonceLen, 4);
+  std::vector<uint8_t> got(reply + kNonceLen + 4, reply + sizeof(reply));
+  std::vector<uint8_t> want =
+      ProofTag(key, "hvdtpu-auth-1", purpose, nonce_a, nonce_b, peer_rank);
+  if (!ConstantTimeEquals(got, want))
+    return Status::Unknown(
+        "connection authentication failed: peer does not hold "
+        "HOROVOD_SECRET (rank announcement rejected)");
+
+  std::vector<uint8_t> ack =
+      ProofTag(key, "hvdtpu-auth-2", purpose, nonce_b, nonce_a, peer_rank);
+  s = SendExact(fd, ack.data(), ack.size(), deadline);
+  if (!s.ok()) return s;
+  *out_peer_rank = peer_rank;
+  return Status::OK();
+}
+
+Status HandshakeConnect(int fd, const std::string& key, uint8_t purpose,
+                        int timeout_ms, int32_t my_rank) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  if (key.empty()) {
+    return SendExact(fd, &my_rank, sizeof(my_rank), deadline);
+  }
+  uint8_t nonce_a[kNonceLen];
+  Status s = RecvExact(fd, nonce_a, kNonceLen, deadline);
+  if (!s.ok()) return s;
+  uint8_t nonce_b[kNonceLen];
+  s = FillRandom(nonce_b, kNonceLen);
+  if (!s.ok()) return s;
+
+  std::vector<uint8_t> tag =
+      ProofTag(key, "hvdtpu-auth-1", purpose, nonce_a, nonce_b, my_rank);
+  uint8_t msg[kNonceLen + 4 + 32];
+  memcpy(msg, nonce_b, kNonceLen);
+  memcpy(msg + kNonceLen, &my_rank, 4);
+  memcpy(msg + kNonceLen + 4, tag.data(), 32);
+  s = SendExact(fd, msg, sizeof(msg), deadline);
+  if (!s.ok()) return s;
+
+  uint8_t ack[32];
+  s = RecvExact(fd, ack, sizeof(ack), deadline);
+  if (!s.ok()) return s;
+  std::vector<uint8_t> got(ack, ack + 32);
+  std::vector<uint8_t> want =
+      ProofTag(key, "hvdtpu-auth-2", purpose, nonce_b, nonce_a, my_rank);
+  if (!ConstantTimeEquals(got, want))
+    return Status::Unknown(
+        "connection authentication failed: acceptor does not hold "
+        "HOROVOD_SECRET (possible coordinator impersonation)");
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

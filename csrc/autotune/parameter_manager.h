@@ -3,11 +3,12 @@
 // Role parity with reference horovod/common/parameter_manager.h:35-217:
 // warmup discards, 5-cycle scoring windows of bytes/sec, Bayesian
 // optimization over the joint space, convergence to the best seen, optional
-// score log (HOROVOD_AUTOTUNE_LOG). Divergence from the reference: only
-// rank 0 tunes and there is no cross-rank param broadcast — in this rebuild
-// fusion decisions are made exclusively at rank 0 (the coordinator), and
-// worker cycle pacing is driven by the blocking control round-trip, so
-// tuned values on workers would be dead state.
+// score log (HOROVOD_AUTOTUNE_LOG). Only rank 0 scores and tunes; the
+// winners are synced to every rank by piggybacking {cycle time, fusion
+// threshold} on the coordinator's broadcast ResponseList each cycle
+// (reference synced via a dedicated param bcast, parameter_manager.h:
+// 95-96,232) — the control round runs at the pace of the slowest rank, so
+// all ranks must pace identically for tuning to mean anything.
 #pragma once
 
 #include <chrono>

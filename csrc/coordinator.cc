@@ -239,6 +239,31 @@ bool Coordinator::RunLoopOnce() {
         HVD_LOG_RANK(ERROR, rank_) << "bad request list from rank " << r;
         return false;
       }
+      // Wire hardening: request_rank and shape dims index directly into
+      // size_-length vectors later (BuildResponse tensor_sizes, stall
+      // bookkeeping), so a request that lies about its rank — or arrives
+      // with negative dims — must die here, not corrupt the heap there.
+      bool malformed = false;
+      for (const auto& req : list.requests) {
+        if (req.request_rank != r) {
+          HVD_LOG_RANK(ERROR, rank_)
+              << "request from gather slot " << r << " claims rank "
+              << req.request_rank << "; rejecting list";
+          malformed = true;
+          break;
+        }
+        for (int64_t d : req.tensor_shape.dims) {
+          if (d < 0) {
+            HVD_LOG_RANK(ERROR, rank_)
+                << "negative dimension in request '" << req.tensor_name
+                << "' from rank " << r << "; rejecting list";
+            malformed = true;
+            break;
+          }
+        }
+        if (malformed) break;
+      }
+      if (malformed) return false;
       if (list.shutdown && !rank_shutdown_[r]) {
         rank_shutdown_[r] = true;
         ++shutdown_votes_;
@@ -251,6 +276,14 @@ bool Coordinator::RunLoopOnce() {
     // Reference semantics: shutdown once every rank has voted
     // (operations.cc:2125-2128) so in-flight collectives still finish.
     to_perform.shutdown = shutdown_votes_ == size_;
+    if (autotuner_ != nullptr) {
+      // Piggyback the current tunables so workers adopt rank-0's winners
+      // (reference SyncParams, parameter_manager.h:95-96,232). The control
+      // round runs at the pace of the slowest rank, so tuning the cycle
+      // time on rank 0 alone would be ineffective.
+      to_perform.tuned_cycle_ms = cycle_time_ms_.load();
+      to_perform.tuned_threshold = fusion_threshold_.load();
+    }
     std::vector<uint8_t> wire;
     SerializeResponseList(to_perform, &wire);
     s = transport_.BcastFromRoot(&wire);
@@ -276,6 +309,11 @@ bool Coordinator::RunLoopOnce() {
     if (!DeserializeResponseList(wire.data(), wire.size(), &to_perform)) {
       HVD_LOG_RANK(ERROR, rank_) << "bad response list";
       return false;
+    }
+    if (to_perform.tuned_threshold >= 0) {
+      // Adopt the coordinator's autotuned globals (reference SyncParams).
+      cycle_time_ms_ = to_perform.tuned_cycle_ms;
+      fusion_threshold_ = to_perform.tuned_threshold;
     }
   }
 

@@ -116,6 +116,8 @@ bool DeserializeRequestList(const uint8_t* data, size_t len, RequestList* out) {
 void SerializeResponseList(const ResponseList& in, std::vector<uint8_t>* out) {
   Writer w(out);
   w.U8(in.shutdown ? 1 : 0);
+  w.Raw(&in.tuned_cycle_ms, 8);
+  w.I64(in.tuned_threshold);
   w.I32(static_cast<int32_t>(in.responses.size()));
   for (const auto& r : in.responses) {
     w.U8(static_cast<uint8_t>(r.response_type));
@@ -132,7 +134,9 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   Reader rd(data, len);
   uint8_t shutdown;
   int32_t n;
-  if (!rd.U8(&shutdown) || !rd.I32(&n) || n < 0) return false;
+  if (!rd.U8(&shutdown) || !rd.Raw(&out->tuned_cycle_ms, 8) ||
+      !rd.I64(&out->tuned_threshold) || !rd.I32(&n) || n < 0)
+    return false;
   out->shutdown = shutdown != 0;
   out->responses.clear();
   out->responses.reserve(n);

@@ -51,6 +51,12 @@ struct Response {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // Autotuned globals piggybacked on the coordinator's broadcast so every
+  // rank runs the same {cycle time, fusion threshold} — the reference
+  // synced these with a dedicated MPI_Bcast of a params struct
+  // (parameter_manager.h:95-96,232). threshold < 0 means "no update".
+  double tuned_cycle_ms = 0.0;
+  int64_t tuned_threshold = -1;
 };
 
 // Codec. Append-to / read-from a byte buffer; all integers little-endian.

@@ -1,5 +1,7 @@
 #include "transport.h"
 
+#include "auth.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -213,31 +215,64 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
   if (size_ <= 1) return Status::OK();
   auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
 
+  // Per-job secret: every connection (control star + data ring) runs a
+  // mutual HMAC-SHA256 handshake so a network peer cannot hijack a rank
+  // slot or impersonate the coordinator (parity with the Python launcher's
+  // authenticated Wire, run/network.py). Empty secret = explicitly
+  // unauthenticated (single-host dev); warn once.
+  secret_ = JobSecretFromEnv();
+  if (secret_.empty()) {
+    HVD_LOG_RANK(WARNING, rank_)
+        << "HOROVOD_SECRET is not set: transport connections are "
+           "UNAUTHENTICATED. Use the horovod_tpu.run launcher (which sets "
+           "a per-job secret) for anything beyond localhost development.";
+  }
+
   // 1. Control star.
   if (rank_ == 0) {
     int actual_port;
     Status s = Listen(coord_port, size_, &listen_fd_, &actual_port);
     if (!s.ok()) return s;
     worker_fds_.assign(size_, -1);
-    for (int i = 1; i < size_; ++i) {
+    // Keep accepting until every worker rank has authenticated or the
+    // deadline passes: a rogue/garbage connection (port scanner, peer
+    // without the secret) is closed and logged, never allowed to abort
+    // startup for the legitimate ranks.
+    int registered = 0;
+    while (registered < size_ - 1) {
       int fd;
       s = AcceptWithDeadline(listen_fd_, deadline, &fd);
       if (!s.ok()) return s;
+      // Per-connection cap: a silent rogue connection may stall only its
+      // own handshake slot, never the whole Init deadline.
+      constexpr int kPerConnHandshakeMs = 5000;
+      auto remain_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                           deadline - Clock::now()).count();
+      if (remain_ms < 1) remain_ms = 1;
+      if (remain_ms > kPerConnHandshakeMs) remain_ms = kPerConnHandshakeMs;
       int32_t peer_rank = -1;
-      s = RecvAll(fd, &peer_rank, sizeof(peer_rank));
-      if (!s.ok()) return s;
+      s = HandshakeAccept(fd, secret_, kAuthPurposeControl,
+                          static_cast<int>(remain_ms), &peer_rank);
+      if (!s.ok()) {
+        ::close(fd);
+        HVD_LOG_RANK(WARNING, rank_)
+            << "rejected control connection: " << s.reason();
+        continue;
+      }
       if (peer_rank < 1 || peer_rank >= size_ || worker_fds_[peer_rank] >= 0) {
         ::close(fd);
-        return Status::Unknown("bad rank announcement " +
-                               std::to_string(peer_rank));
+        HVD_LOG_RANK(WARNING, rank_)
+            << "rejected bad rank announcement " << peer_rank;
+        continue;
       }
       worker_fds_[peer_rank] = fd;
+      ++registered;
     }
   } else {
     Status s = ResolveAndConnect(coord_host, coord_port, timeout_ms, &coord_fd_);
     if (!s.ok()) return s;
-    int32_t my_rank = rank_;
-    s = SendAll(coord_fd_, &my_rank, sizeof(my_rank));
+    s = HandshakeConnect(coord_fd_, secret_, kAuthPurposeControl, timeout_ms,
+                         rank_);
     if (!s.ok()) return s;
   }
 
@@ -286,16 +321,20 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
   std::thread dialer([&]() {
     dial_status = ResolveAndConnect(next_host, next_port, timeout_ms,
                                     &ring_send_fd_);
-    if (dial_status.ok()) {
-      int32_t my_rank = rank_;
-      dial_status = SendAll(ring_send_fd_, &my_rank, sizeof(my_rank));
-    }
+    if (dial_status.ok())
+      dial_status = HandshakeConnect(ring_send_fd_, secret_, kAuthPurposeRing,
+                                     timeout_ms, rank_);
   });
   Status accept_status = AcceptWithDeadline(data_listen_fd_, deadline,
                                             &ring_recv_fd_);
   int32_t prev_rank = -1;
-  if (accept_status.ok())
-    accept_status = RecvAll(ring_recv_fd_, &prev_rank, sizeof(prev_rank));
+  if (accept_status.ok()) {
+    auto remain_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - Clock::now()).count();
+    if (remain_ms < 1) remain_ms = 1;
+    accept_status = HandshakeAccept(ring_recv_fd_, secret_, kAuthPurposeRing,
+                                    static_cast<int>(remain_ms), &prev_rank);
+  }
   dialer.join();
   if (!dial_status.ok()) return dial_status;
   if (!accept_status.ok()) return accept_status;

@@ -64,6 +64,7 @@ class Transport {
  private:
   int rank_ = 0;
   int size_ = 1;
+  std::string secret_;                 // per-job HMAC key (empty = unauthenticated)
   int listen_fd_ = -1;                 // root control listener
   std::vector<int> worker_fds_;        // root: fd per worker rank (index 0 unused)
   int coord_fd_ = -1;                  // worker: fd to root

@@ -64,7 +64,7 @@ from horovod_tpu.jax.optimizer import (
     grad,
     value_and_grad,
 )
-from horovod_tpu.parallel.spmd import spmd, spmd_run
+from horovod_tpu.parallel.spmd import spmd, spmd_fn, spmd_run
 
 # TF-parity aliases (reference tensorflow/__init__.py:95-115).
 broadcast_variables = broadcast_parameters
@@ -117,5 +117,6 @@ __all__ = [
     "broadcast_variables",
     "broadcast_global_variables",
     "spmd",
+    "spmd_fn",
     "spmd_run",
 ]

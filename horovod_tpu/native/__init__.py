@@ -26,6 +26,7 @@ _CACHE_DIR = Path(__file__).resolve().parent / "_cache"
 
 _SOURCES = [
     "logging.cc",
+    "auth.cc",
     "message.cc",
     "transport.cc",
     "collectives.cc",
@@ -39,6 +40,7 @@ _SOURCES = [
 _HEADERS = [
     "common.h",
     "logging.h",
+    "auth.h",
     "message.h",
     "transport.h",
     "collectives.h",

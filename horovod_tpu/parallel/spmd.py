@@ -50,6 +50,7 @@ def spmd_fn(
     out_specs: Any = P(),
     check_vma: bool = False,
     jit: bool = True,
+    donate_argnums=(),
 ):
     """Build (once) the compiled SPMD form of ``fn``.
 
@@ -58,6 +59,22 @@ def spmd_fn(
     this once and call it every step — the XLA executable is cached, which
     is the TPU analogue of the reference's compiled graph ops being built
     once per tensor name (horovod/tensorflow/mpi_ops.py:73-91).
+
+    ``donate_argnums`` is forwarded to ``jax.jit``: donate the train-state
+    argument of a training step so XLA reuses its device buffers for the
+    updated state instead of allocating a fresh copy every step (the
+    in-place-update analogue of the reference's in-place ``MPI_IN_PLACE``
+    allreduce path, operations.cc:1574-1584 — but for the whole model).
+
+    When ``HOROVOD_TIMELINE`` is active, each returned handle emits
+    ``XLA_COMPILE`` around its first dispatch (trace+compile happen there,
+    so that span is the real compile cost) and ``XLA_EXECUTE`` around every
+    subsequent dispatch. jax dispatch is asynchronous, so the XLA_EXECUTE
+    span measures HOST DISPATCH time (the analogue of the reference's
+    QUEUE activity), not device execution — the events carry
+    ``args.span = "host_dispatch"`` to say so; use ``jax.profiler`` for
+    device-side op time. Taxonomy parity: reference operations.h:29-50,
+    docs/timeline.md:17-62.
     """
     mesh = mesh or _default_mesh()
 
@@ -76,7 +93,36 @@ def spmd_fn(
         out_specs=out_specs,
         check_vma=check_vma,
     )
-    return jax.jit(shmapped) if jit else shmapped
+    if not jit:
+        return shmapped
+    compiled = jax.jit(shmapped, donate_argnums=donate_argnums)
+
+    track = getattr(fn, "__name__", "spmd_fn")
+    compiled_once = [False]
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        st = _state.global_state()
+        tl = getattr(st, "timeline", None)
+        if tl is None or not tl.enabled:
+            compiled_once[0] = True
+            return compiled(*args, **kwargs)
+        from horovod_tpu.utils import timeline as _tl_names
+
+        # The first dispatch blocks through trace+compile (a real span);
+        # later spans time only the async host dispatch.
+        act = (_tl_names.XLA_EXECUTE if compiled_once[0]
+               else _tl_names.XLA_COMPILE)
+        span = "host_dispatch" if compiled_once[0] else "trace+compile"
+        tl.start(track, act, args={"span": span})
+        try:
+            return compiled(*args, **kwargs)
+        finally:
+            tl.end(track, act)
+            compiled_once[0] = True
+
+    dispatch._compiled = compiled  # escape hatch for AOT (.lower) users
+    return dispatch
 
 
 # (fn, mesh, axis, specs, check_vma) -> compiled, bounded LRU. The compiled

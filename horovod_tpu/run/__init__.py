@@ -24,6 +24,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -84,6 +85,9 @@ _SSH_ENV_DENY = ("SSH_", "DISPLAY", "HOSTNAME", "PWD", "OLDPWD", "SHLVL",
                  "TMPDIR", "XDG_", "DBUS_", "HOME", "LOGNAME", "USER", "_")
 
 
+_SSH_READY_MARKER = b"__HVD_ECHO_OFF__"
+
+
 def _spawn_ssh(host: str, cmd: Sequence[str],
                env: Dict[str, str]) -> subprocess.Popen:
     exports = " ".join(
@@ -92,17 +96,63 @@ def _spawn_ssh(host: str, cmd: Sequence[str],
         and "\n" not in v)
     # The HMAC secret must never appear on a command line (argv is world-
     # readable via /proc on the remote host); ship it over stdin instead.
-    remote = ("IFS= read -r HOROVOD_SECRET && export HOROVOD_SECRET && "
+    # The -tt pty would echo that stdin line back into the launcher's
+    # stdout (and thus scrollback/job logs), so the remote disables echo
+    # and prints a marker; the launcher only writes the secret AFTER the
+    # marker arrives (writing earlier would race the stty and be echoed
+    # by the default line discipline).
+    marker = _SSH_READY_MARKER.decode()
+    remote = (f"stty -echo 2>/dev/null; printf '{marker}\\n'; "
+              "IFS= read -r HOROVOD_SECRET && export HOROVOD_SECRET && "
               f"cd {shlex.quote(os.getcwd())} && env {exports} "
               + " ".join(shlex.quote(c) for c in cmd))
     # -tt forces a pty so killing the local ssh client HUPs the remote
     # process tree — the fail-fast kill works across hosts.
     proc = subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", host,
                              remote], start_new_session=True,
-                            stdin=subprocess.PIPE)
-    proc.stdin.write((env.get("HOROVOD_SECRET", "") + "\n").encode())
-    proc.stdin.flush()
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+
+    def feed_secret_then_pump():
+        out = proc.stdout
+        line = b""
+        while True:  # wait for the echo-off marker (or early EOF)
+            ch = out.read(1)
+            if not ch:
+                return  # ssh died before the marker; supervisor reaps it
+            if ch == b"\n":
+                if _SSH_READY_MARKER in line:
+                    break
+                sys.stdout.buffer.write(line + b"\n")
+                sys.stdout.buffer.flush()
+                line = b""
+            else:
+                line += ch
+        try:
+            proc.stdin.write((env.get("HOROVOD_SECRET", "") + "\n").encode())
+            proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            return
+        while True:  # stream the worker's output to the launcher's stdout
+            chunk = out.read(4096)
+            if not chunk:
+                return
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.buffer.flush()
+
+    pump = threading.Thread(target=feed_secret_then_pump, daemon=True)
+    pump.start()
+    proc._hvd_pump_thread = pump  # joined by _drain_output at job end
     return proc
+
+
+def _drain_output(procs: List[subprocess.Popen], timeout: float = 5.0) -> None:
+    """Join ssh stdout pump threads so the tail of remote worker output is
+    flushed to the launcher's stdout before launch_command returns."""
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        t = getattr(p, "_hvd_pump_thread", None)
+        if t is not None:
+            t.join(max(0.1, deadline - time.monotonic()))
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
@@ -172,8 +222,10 @@ def launch_command(cmd: Sequence[str], np: int,
             bad = [c for c in codes if c not in (None, 0)]
             if bad:
                 _kill_all(procs)
+                _drain_output(procs)
                 return bad[0]
             if all(c == 0 for c in codes):
+                _drain_output(procs)
                 return 0
             time.sleep(0.05)
     except KeyboardInterrupt:

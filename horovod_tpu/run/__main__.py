@@ -1,34 +1,12 @@
 """CLI: ``python -m horovod_tpu.run -np N [-H hosts] cmd args...``
 
-The ``horovodrun`` analogue (the reference's documented launch was
-``mpirun -np N python train.py``, docs/running.md); this launcher owns
-placement and the Horovod environment itself — no MPI runtime.
+Same CLI as the installed ``hvdrun`` console script; the body lives in
+:mod:`horovod_tpu.run.launcher`.
 """
 
-from __future__ import annotations
-
-import argparse
 import sys
 
-from horovod_tpu.run import launch_command
-
-
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="horovod_tpu.run",
-        description="Launch an N-rank horovod_tpu job.")
-    parser.add_argument("-np", "--num-proc", type=int, required=True,
-                        help="total number of ranks")
-    parser.add_argument("-H", "--hosts", default=None,
-                        help="host1:slots,host2:slots (default: all local)")
-    parser.add_argument("command", nargs=argparse.REMAINDER,
-                        help="training command")
-    args = parser.parse_args(argv)
-    if not args.command:
-        parser.error("no command given")
-    cmd = args.command[1:] if args.command[0] == "--" else args.command
-    return launch_command(cmd, np=args.num_proc, hosts=args.hosts)
-
+from horovod_tpu.run.launcher import main
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,35 @@
+"""``hvdrun`` console entry: ``hvdrun -np N [-H hosts] cmd args...``
+
+The ``horovodrun`` analogue (the reference's documented launch was
+``mpirun -np N python train.py``, docs/running.md); this launcher owns
+placement and the Horovod environment itself — no MPI runtime. The
+``python -m horovod_tpu.run`` form (``__main__.py``) is the same CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from horovod_tpu.run import launch_command
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch an N-rank horovod_tpu job.")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="total number of ranks")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host1:slots,host2:slots (default: all local)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+    return launch_command(cmd, np=args.num_proc, hosts=args.hosts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

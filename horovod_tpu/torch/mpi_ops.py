@@ -95,6 +95,14 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
     """In-place asynchronous allreduce; returns a handle for
     poll/synchronize (reference mpi_ops.py:156-199)."""
     core = _require_core()
+    if average and not tensor.is_floating_point():
+        # In-place true division on an integral dtype raises an opaque
+        # torch error at completion time; fail up front with guidance
+        # (the reference documents average as float-only semantics).
+        raise ValueError(
+            f"allreduce with average=True is not supported for integer "
+            f"tensor dtype {tensor.dtype}; pass average=False (sum) or "
+            f"cast to a floating dtype first.")
     buf, copy_back = _prepare_inplace(tensor)
     arr = _as_numpy(buf)
     h = core.allreduce_async_(_next_name("allreduce", name), arr)

@@ -77,6 +77,10 @@ class Timeline:
             )
             self._writer.start()
 
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
     # -- infrastructure ----------------------------------------------------
 
     # Cap on named tracks so auto-named ops in long training loops cannot
@@ -167,18 +171,20 @@ class Timeline:
             }
         )
 
-    def start(self, tensor_name: str, op: str) -> None:
+    def start(self, tensor_name: str, op: str,
+              args: Optional[dict] = None) -> None:
         if not self._enabled:
             return
-        self._emit(
-            {
-                "name": op,
-                "ph": "B",
-                "pid": 0,
-                "tid": self._tid(tensor_name),
-                "ts": self._now_us(),
-            }
-        )
+        ev = {
+            "name": op,
+            "ph": "B",
+            "pid": 0,
+            "tid": self._tid(tensor_name),
+            "ts": self._now_us(),
+        }
+        if args:
+            ev["args"] = args
+        self._emit(ev)
 
     def activity_start(self, tensor_name: str, activity: str) -> None:
         if not self._enabled:

@@ -101,6 +101,39 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
         core.release(h)
         assert np.allclose(ok, float(size))
 
+    elif scenario == "autotune_sync":
+        # Rank-0's autotuned {cycle time, fusion threshold} must propagate
+        # to every rank via the broadcast ResponseList (reference
+        # SyncParams, parameter_manager.h:95-96,232). Start each rank with
+        # deliberately different knobs; after the tuner converges all
+        # ranks must report identical values.
+        import time
+
+        core.set_cycle_time_ms(0.2 + 0.1 * rank)
+        core.set_fusion_threshold((rank + 1) * (1 << 20))
+        core.enable_autotune("")
+        deadline = time.time() + 90
+        step = 0
+        converged = False
+        while time.time() < deadline and not converged:
+            for _ in range(25):
+                a = np.ones(2048, dtype=np.float32)
+                h = core.allreduce_async_(f"ats.{step}", a)
+                core.wait(h)
+                core.release(h)
+                step += 1
+            snap = np.array(
+                [[core.cycle_time_ms(), float(core.fusion_threshold())]],
+                dtype=np.float64)
+            h = core.allgather_async(f"params.{step}", snap)
+            core.wait(h)
+            out = core.take_result(h, np.float64, (2,))
+            # Every rank started with distinct hand-set knobs, and only
+            # rank 0 ever tunes, so all rows being equal is only possible
+            # if the sync overwrote the workers' values with rank-0's.
+            converged = bool((out == out[0]).all())
+        assert converged, "autotuned parameters never converged across ranks"
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

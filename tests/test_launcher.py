@@ -170,3 +170,34 @@ class TestCLI:
 
         with pytest.raises(LaunchError, match="slots"):
             launch_command(["true"], np=3, hosts="localhost:2")
+
+    def test_hvdrun_console_entry_resolves(self):
+        """pyproject's [project.scripts] hvdrun target must exist and run
+        (round-1 regression: it pointed at a nonexistent module, so an
+        installed wheel shipped a crashing script)."""
+        import re
+
+        try:
+            import tomllib
+
+            pyproject = tomllib.loads((REPO / "pyproject.toml").read_text())
+            target = pyproject["project"]["scripts"]["hvdrun"]
+        except ImportError:  # Python 3.10: no stdlib TOML parser
+            m = re.search(r'^hvdrun\s*=\s*"([^"]+)"',
+                          (REPO / "pyproject.toml").read_text(), re.M)
+            assert m, "hvdrun entry missing from pyproject.toml"
+            target = m.group(1)
+        mod_name, _, fn_name = target.partition(":")
+        import importlib
+
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, fn_name)  # AttributeError = broken entry point
+        assert callable(fn)
+        # And the entry actually launches a 1-rank job end-to-end.
+        code = subprocess.run(
+            [sys.executable, "-c",
+             f"import sys; from {mod_name} import {fn_name}; "
+             f"sys.exit({fn_name}(['-np', '1', '--', "
+             f"{sys.executable!r}, '-c', 'print(42)']))"],
+            env=_clean_env(), cwd=str(REPO), timeout=120).returncode
+        assert code == 0

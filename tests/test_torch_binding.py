@@ -90,6 +90,17 @@ class TestSingleProcess:
         hvd_torch.allreduce_(t)
         assert torch.allclose(t, torch.ones(5))
 
+    def test_allreduce_average_int_rejected(self, hvd_torch):
+        """average=True on an integer tensor must fail up front with
+        guidance, not with torch's opaque in-place-div error at completion
+        (round-1 advisory)."""
+        t = torch.ones(5, dtype=torch.int64)
+        with pytest.raises(ValueError, match="average=False"):
+            hvd_torch.allreduce(t, average=True)
+        # sum path still works
+        out = hvd_torch.allreduce(t, average=False)
+        assert (out == 1).all()
+
     def test_allreduce_inplace_noncontiguous(self, hvd_torch):
         t = torch.randn(4, 6).t()  # non-contiguous view
         assert not t.is_contiguous()
