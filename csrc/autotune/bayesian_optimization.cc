@@ -71,7 +71,7 @@ std::vector<double> BayesianOptimization::Suggest() {
   for (size_t i = 0; i < y_.size(); ++i) ynorm[i] = (y_[i] - mean) / sd;
 
   GaussianProcess gp;
-  if (!gp.Fit(x_, ynorm)) {
+  if (!gp.FitWithHyperparameters(x_, ynorm)) {
     std::vector<double> z(d);
     for (auto& v : z) v = unit(rng_);
     return Denormalize(z);

@@ -57,6 +57,7 @@ bool GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
   n_ = static_cast<int>(x.size());
   if (n_ == 0) return false;
   x_train_ = x;
+  y_train_ = y;
   chol_.assign(static_cast<size_t>(n_) * n_, 0.0);
   for (int i = 0; i < n_; ++i)
     for (int j = 0; j < n_; ++j)
@@ -71,6 +72,52 @@ bool GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
   CholeskyBackSub(chol_, n_, &alpha_);
   fitted_ = true;
   return true;
+}
+
+double GaussianProcess::LogMarginalLikelihood() const {
+  if (!fitted_) return -1e300;
+  double fit_term = 0.0;
+  for (int i = 0; i < n_; ++i) fit_term += y_train_[i] * alpha_[i];
+  double log_det_half = 0.0;  // sum log L_ii = 1/2 log det K
+  for (int i = 0; i < n_; ++i) log_det_half += std::log(chol_[i * n_ + i]);
+  constexpr double kLog2Pi = 1.8378770664093453;
+  return -0.5 * fit_term - log_det_half - 0.5 * n_ * kLog2Pi;
+}
+
+bool GaussianProcess::FitWithHyperparameters(
+    const std::vector<std::vector<double>>& x, const std::vector<double>& y) {
+  // Coordinate descent on a log-spaced grid, two rounds: with tens of
+  // samples in a unit box the likelihood surface is smooth enough that
+  // this lands on the same optimum basin the reference's L-BFGS did.
+  static const double kLengthScales[] = {0.05, 0.1, 0.2, 0.3, 0.5, 1.0, 2.0};
+  static const double kSignalVars[] = {0.25, 0.5, 1.0, 2.0, 4.0};
+  double best_lml = -1e300;
+  double best_ls = length_scale_, best_sv = signal_variance_;
+  for (int round = 0; round < 2; ++round) {
+    for (double ls : kLengthScales) {
+      length_scale_ = ls;
+      signal_variance_ = best_sv;
+      if (!Fit(x, y)) continue;
+      double lml = LogMarginalLikelihood();
+      if (lml > best_lml) {
+        best_lml = lml;
+        best_ls = ls;
+      }
+    }
+    for (double sv : kSignalVars) {
+      length_scale_ = best_ls;
+      signal_variance_ = sv;
+      if (!Fit(x, y)) continue;
+      double lml = LogMarginalLikelihood();
+      if (lml > best_lml) {
+        best_lml = lml;
+        best_sv = sv;
+      }
+    }
+  }
+  length_scale_ = best_ls;
+  signal_variance_ = best_sv;
+  return Fit(x, y);
 }
 
 void GaussianProcess::Predict(const std::vector<double>& x, double* mean,

@@ -1,10 +1,13 @@
 // RBF-kernel Gaussian-process regressor.
 //
 // Role parity with reference horovod/common/optim/gaussian_process.h:32-60
-// (RBF kernel, Cholesky solve). The reference used vendored Eigen + L-BFGS
-// hyperparameter fitting; this rebuild carries its own dense Cholesky (the
-// problem is 2-D with tens of samples — a 30x30 solve) and fixed, scale-
-// normalized hyperparameters, which removes both vendored dependencies.
+// (RBF kernel, Cholesky solve, hyperparameter fitting). The reference
+// maximized the log marginal likelihood with vendored Eigen + L-BFGS; this
+// rebuild carries its own dense Cholesky (the problem is 2-D with tens of
+// samples — a 30x30 solve) and fits {length scale, signal variance} by
+// coordinate descent on a log-spaced grid of the same objective
+// (FitWithHyperparameters), which removes both vendored dependencies while
+// keeping the adaptive-kernel behavior.
 #pragma once
 
 #include <vector>
@@ -24,6 +27,19 @@ class GaussianProcess {
   bool Fit(const std::vector<std::vector<double>>& x,
            const std::vector<double>& y);
 
+  // Fit with hyperparameter selection: coordinate descent over
+  // {length_scale, signal_variance} maximizing the log marginal
+  // likelihood (reference gaussian_process.h:32-60 did this with L-BFGS).
+  bool FitWithHyperparameters(const std::vector<std::vector<double>>& x,
+                              const std::vector<double>& y);
+
+  // Log marginal likelihood of the current fit:
+  // -1/2 y^T alpha - sum(log L_ii) - n/2 log(2 pi).
+  double LogMarginalLikelihood() const;
+
+  double length_scale() const { return length_scale_; }
+  double signal_variance() const { return signal_variance_; }
+
   // Posterior mean + variance at a point.
   void Predict(const std::vector<double>& x, double* mean,
                double* variance) const;
@@ -37,6 +53,7 @@ class GaussianProcess {
   double length_scale_, signal_variance_, noise_variance_;
   bool fitted_ = false;
   std::vector<std::vector<double>> x_train_;
+  std::vector<double> y_train_;         // kept for the likelihood
   std::vector<double> alpha_;           // K^-1 y
   std::vector<double> chol_;            // lower Cholesky factor, row major
   int n_ = 0;

@@ -6,11 +6,14 @@
 // exposed one pybind/ctypes symbol per (framework x dtype x op); this
 // rebuild passes a wire dtype id instead, collapsing the surface to one
 // symbol per op.
+#include <cmath>
 #include <cstring>
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
+#include "autotune/gaussian_process.h"
 #include "coordinator.h"
 
 using hvdtpu::Coordinator;
@@ -159,6 +162,32 @@ void hvdtpu_timeline_end() { GlobalCoordinator()->timeline().Shutdown(); }
 
 void hvdtpu_enable_autotune(const char* log_path) {
   GlobalCoordinator()->EnableAutotune(log_path ? log_path : "");
+}
+
+// Self-test for the GP hyperparameter fit (reference gaussian_process.h:
+// 32-60 fitted via L-BFGS; here coordinate descent on the same marginal
+// likelihood): the fitted length scale must adapt to the data — shorter
+// for a wiggly target than for a linear one — and the smooth fit must
+// interpolate. Returns 1 on success.
+int hvdtpu_gp_selftest() {
+  std::vector<std::vector<double>> xs;
+  std::vector<double> y_linear, y_wiggly;
+  for (int i = 0; i < 20; ++i) {
+    double t = i / 19.0;
+    xs.push_back({t});
+    y_linear.push_back(2.0 * t - 1.0);
+    y_wiggly.push_back(std::sin(12.0 * t));
+  }
+  hvdtpu::GaussianProcess lin, wig;
+  if (!lin.FitWithHyperparameters(xs, y_linear)) return 0;
+  if (!wig.FitWithHyperparameters(xs, y_wiggly)) return 0;
+  if (!(wig.length_scale() < lin.length_scale())) return 0;
+  double mean, var;
+  lin.Predict({0.5}, &mean, &var);
+  if (std::fabs(mean - 0.0) > 0.05) return 0;
+  wig.Predict({0.125}, &mean, &var);  // sin(1.5) ~ 0.997 between samples
+  if (std::fabs(mean - std::sin(12.0 * 0.125)) > 0.1) return 0;
+  return 1;
 }
 
 }  // extern "C"

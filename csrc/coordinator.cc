@@ -82,6 +82,10 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
       EnvDouble("HOROVOD_FUSION_THRESHOLD", 64.0 * 1024 * 1024));
   cycle_time_ms_ = EnvDouble("HOROVOD_CYCLE_TIME", 5.0);
   stall_check_disabled_ = std::getenv("HOROVOD_STALL_CHECK_DISABLE") != nullptr;
+  // Warning period override (HOROVOD_STALL_WARNING_TIME, seconds): kept in
+  // lockstep with the Python config surface (common/config.py) and makes
+  // the stall path testable without 60 s waits.
+  stall_warning_secs_ = EnvDouble("HOROVOD_STALL_WARNING_TIME", 60.0);
 
   Status s = transport_.Init(rank_, size_, coord_host, coord_port, timeout_ms);
   if (!s.ok()) return s;

@@ -44,9 +44,23 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
             return
         import jax
 
-        # Multi-host: the launcher (horovod_tpu.run) or the TPU runtime sets
-        # the coordinator env; jax.distributed is initialized there. We do
-        # not force it here so single-process usage stays zero-config.
+        # Multi-host: when the launcher provides a jax coordinator
+        # (HOROVOD_JAX_COORDINATOR, set by `hvdrun --jax`), join the jax
+        # distributed runtime BEFORE the first backend query so every
+        # process sees the global device set — the analogue of the
+        # reference joining MPI_COMM_WORLD at init (operations.cc:1724).
+        # TPU pods that pre-initialize via the runtime env need nothing
+        # here, and single-process usage stays zero-config.
+        jax_coord = os.environ.get("HOROVOD_JAX_COORDINATOR", "")
+        if jax_coord and os.environ.get("HOROVOD_SIZE"):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=jax_coord,
+                    num_processes=int(os.environ["HOROVOD_SIZE"]),
+                    process_id=int(os.environ.get("HOROVOD_RANK", "0")),
+                )
+            except RuntimeError:
+                pass  # already initialized (e.g. by the TPU runtime)
         state.config = Config.from_env()
         state.devices = list(devices) if devices is not None else list(jax.devices())
         state.process_index = jax.process_index()
@@ -79,6 +93,16 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
             enabled_rank=state.process_index == 0,
         )
 
+        if state.config.autotune:
+            # HOROVOD_AUTOTUNE on the SPMD lane: sweep the fusion threshold
+            # against measured step rate (reference parameter_manager.h:
+            # 211-217 scoring semantics; see horovod_tpu/jax/autotune.py).
+            from horovod_tpu.jax.autotune import StepAutotuner
+
+            state.autotuner = StepAutotuner(
+                state.config, log_path=state.config.autotune_log
+            )
+
         state.initialized = True
         atexit.register(shutdown)
 
@@ -92,6 +116,9 @@ def shutdown() -> None:
             return
         if state.timeline is not None:
             state.timeline.close()
+        if state.autotuner is not None:
+            state.autotuner.close()
+            state.autotuner = None
         if state.native is not None:
             state.native.shutdown()
             state.native = None

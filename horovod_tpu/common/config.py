@@ -66,11 +66,18 @@ class Config:
     # Stall detection (HOROVOD_STALL_CHECK_DISABLE).
     stall_check_disable: bool = False
     stall_warning_secs: float = DEFAULT_STALL_WARNING_SECS
-    # Hierarchical collectives: on TPU this selects two-level
-    # (ICI x DCN) mesh factorization rather than NCCL+MPI staging
-    # (reference semantics: operations.cc:1284-1436).
+    # Hierarchical collectives: on TPU this selects the explicit two-level
+    # ladder (reduce-scatter in the fast domain, cross-reduce, all-gather)
+    # rather than NCCL+MPI staging (reference semantics:
+    # operations.cc:1284-1436 allreduce, :929-1032 allgather).
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Fast-domain (ICI) size for the hierarchical ladder. 0 = auto: the
+    # chips-per-process count (the reference's local_comm split,
+    # operations.cc:1760-1797). TPU-native extension knob
+    # (HOROVOD_HIERARCHICAL_INNER_SIZE) so single-host jobs can pin the
+    # ICI/DCN boundary explicitly.
+    hierarchical_inner_size: int = 0
     # Log level (HOROVOD_LOG_LEVEL: trace|debug|info|warning|error|fatal).
     log_level: str = "warning"
     log_hide_time: bool = False
@@ -92,6 +99,9 @@ class Config:
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            hierarchical_inner_size=_env_int(
+                "HOROVOD_HIERARCHICAL_INNER_SIZE", 0
+            ),
             log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_hide_time=_env_bool("HOROVOD_LOG_HIDE_TIME"),
         )

@@ -1,0 +1,119 @@
+"""Step-time autotuning for the XLA/SPMD lane (HOROVOD_AUTOTUNE).
+
+The reference autotuner tuned {fusion threshold, cycle time} against
+bytes/sec scored over sampling windows (horovod/common/parameter_manager.h:
+35-43,149-217). On the compiled SPMD lane there is no cycle time — the only
+knob with a data-plane meaning is the gradient-bucket fusion threshold used
+by :mod:`horovod_tpu.jax.fusion` — and the honest objective is measured
+step wall-time, since bucketing trades ICI launch latency against
+concatenate/slice overhead inside one XLA program.
+
+Mechanism: :func:`horovod_tpu.parallel.spmd.spmd_fn` dispatch handles
+consult this tuner. Every ``window`` steps the tuner blocks on the step
+output (the only way to observe real device time under async dispatch),
+scores the current threshold in steps/sec, advances to the next candidate,
+and bumps ``generation`` — which makes every dispatch handle re-jit so the
+new threshold re-traces into a new bucket plan. Per candidate the first
+window is discarded as warmup (it pays the recompile), mirroring the
+reference's warmup-discard (parameter_manager.h:38-43). After one sweep the
+best threshold wins, ``converged`` flips, and the hot path never blocks
+again. Scores append to HOROVOD_AUTOTUNE_LOG in the same TSV layout as the
+native tuner (csrc/autotune/parameter_manager.cc).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+
+# Sweep space: "no fusion" plus power-of-two thresholds spanning the
+# reference's 0..64 MB range (parameter_manager.cc search space) one octave
+# past it, since TPU gradient sets can exceed 64 MB.
+DEFAULT_CANDIDATES = [0] + [1 << s for s in range(20, 28)]  # 1 MB .. 128 MB
+
+
+class StepAutotuner:
+    """Sweeps ``config.fusion_threshold`` against measured step rate."""
+
+    def __init__(
+        self,
+        config,
+        log_path: str = "",
+        candidates: Optional[Sequence[int]] = None,
+        window: int = 10,
+    ) -> None:
+        self.config = config
+        self.candidates: List[int] = list(
+            candidates if candidates is not None else DEFAULT_CANDIDATES
+        )
+        self.window = max(1, int(window))
+        self.generation = 1
+        self.converged = False
+        self.best_threshold = config.fusion_threshold
+        self.best_score = -1.0
+        self._idx = 0
+        self._warming = True
+        self._steps_in_window = 0
+        self._t0: Optional[float] = None
+        self._samples = 0
+        self._log = open(log_path, "w") if log_path else None
+        config.fusion_threshold = self.candidates[0]
+
+    # -- dispatch-side hooks ------------------------------------------------
+
+    def step_done(self) -> bool:
+        """Count one dispatched step; True when the caller must block on the
+        step output and call :meth:`end_window`."""
+        if self.converged:
+            return False
+        self._steps_in_window += 1
+        return self._steps_in_window >= self.window
+
+    def end_window(self) -> None:
+        """Score the window that just completed (caller has synced)."""
+        now = time.perf_counter()
+        self._steps_in_window = 0
+        if self._warming or self._t0 is None:
+            # Warmup window: paid the recompile for this candidate.
+            self._log_line("warmup", self.config.fusion_threshold, 0.0)
+            self._warming = False
+            self._t0 = now
+            return
+        score = self.window / (now - self._t0)  # steps/sec
+        self._log_line("sample", self.config.fusion_threshold, score)
+        if score > self.best_score:
+            self.best_score = score
+            self.best_threshold = self.config.fusion_threshold
+        self._idx += 1
+        if self._idx >= len(self.candidates):
+            self.config.fusion_threshold = self.best_threshold
+            self.converged = True
+            self.generation += 1
+            self._log_line("converged", self.best_threshold, self.best_score)
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+        else:
+            self.config.fusion_threshold = self.candidates[self._idx]
+            self.generation += 1
+            self._warming = True
+            self._t0 = now
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+    # -- logging ------------------------------------------------------------
+
+    def _log_line(self, kind: str, threshold: int, score: float) -> None:
+        self._samples += 1
+        if self._log is not None:
+            # Same TSV columns as the native tuner's log
+            # (csrc/autotune/parameter_manager.cc): sample index, kind,
+            # threshold bytes, cycle ms (n/a on this lane), score.
+            self._log.write(
+                f"{self._samples}\t{kind}\t{threshold}\t0.0\t{score}\n"
+            )
+            self._log.flush()

@@ -37,11 +37,16 @@ def process_allgather(x):
 
 
 def process_broadcast(x, root_rank: int):
-    """Every process receives process ``root_rank``'s value."""
+    """Every process receives process ``root_rank``'s value.
+
+    A true one-to-all broadcast for any root (``is_source`` selects the
+    root), matching MPI_Bcast's O(bytes) per-link cost (reference
+    operations.cc:1592-1612). Round-1 version allgathered for non-zero
+    roots — O(size x bytes) on DCN — which is the wrong shape at pod scale.
+    """
     from jax.experimental import multihost_utils
 
     x = jnp.asarray(x)
-    if root_rank == 0:
-        return multihost_utils.broadcast_one_to_all(x)
-    gathered = multihost_utils.process_allgather(x)
-    return gathered[root_rank]
+    return multihost_utils.broadcast_one_to_all(
+        x, is_source=jax.process_index() == root_rank
+    )

@@ -52,6 +52,18 @@ def _plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]
     return buckets
 
 
+def _hierarchical_inner(st, axis_size: int, enabled: bool) -> int:
+    """Fast-domain size for the two-level ladder, or 0 when the flat
+    collective should be used. Auto mode uses chips-per-process (the
+    reference's local/cross comm split, operations.cc:1760-1797)."""
+    if not enabled:
+        return 0
+    inner = st.config.hierarchical_inner_size or st.local_device_count
+    if 1 < inner < axis_size and axis_size % inner == 0:
+        return inner
+    return 0
+
+
 def fused_reduce(
     tensors,
     average: bool = True,
@@ -92,6 +104,16 @@ def fused_reduce(
     # reduction distributes over concatenation.
     if op is mpi_ops.Average or op is mpi_ops.Sum:
         reduce_fn = lax.psum
+        # HOROVOD_HIERARCHICAL_ALLREDUCE: route sum-reductions through the
+        # explicit two-level ladder (reference operations.cc:1284-1436) —
+        # reduce-scatter in the fast (ICI) domain, cross-reduce 1/inner of
+        # the bytes, all-gather back.
+        inner = _hierarchical_inner(st, n, st.config.hierarchical_allreduce)
+        if inner:
+            from horovod_tpu.parallel.mesh import hierarchical_allreduce_in_axis
+
+            def reduce_fn(v, ax, _inner=inner):
+                return hierarchical_allreduce_in_axis(v, ax, _inner)
     else:
         try:
             reduce_fn = mpi_ops._REDUCE_FNS[op]

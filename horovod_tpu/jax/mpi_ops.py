@@ -348,6 +348,17 @@ def allgather(tensor, name: Optional[str] = None):
     name = _normalize_name(name) if name else _auto_name("allgather", tensor)
 
     if axis is not None:
+        st = global_state()
+        if st.config.hierarchical_allgather:
+            # HOROVOD_HIERARCHICAL_ALLGATHER: two-phase gather (reference
+            # operations.cc:929-1032 — node-shared window, then cross-node
+            # stripes). Inner/outer factorization as in fused_reduce.
+            from horovod_tpu.jax.fusion import _hierarchical_inner
+            from horovod_tpu.parallel.mesh import hierarchical_allgather_in_axis
+
+            inner = _hierarchical_inner(st, _axis_size(axis), True)
+            if inner:
+                return hierarchical_allgather_in_axis(tensor, axis, inner)
         return lax.all_gather(tensor, axis, tiled=True)
 
     nproc, _ = _eager_world()

@@ -160,6 +160,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvdtpu_timeline_end.restype = None
     lib.hvdtpu_enable_autotune.argtypes = [c.c_char_p]
     lib.hvdtpu_enable_autotune.restype = None
+    lib.hvdtpu_gp_selftest.restype = c.c_int
     return lib
 
 

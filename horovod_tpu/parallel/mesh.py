@@ -81,6 +81,78 @@ def hierarchical_mesh(devices=None, inner: Optional[int] = None,
                      devices)
 
 
+def inner_groups(size: int, inner: int):
+    """Fast-domain (ICI) groups of a flat ``size`` axis: consecutive
+    chips share a group, mirroring the reference's shared-memory
+    local_comm split (operations.cc:1760-1797)."""
+    return [[o * inner + i for i in range(inner)]
+            for o in range(size // inner)]
+
+
+def outer_groups(size: int, inner: int):
+    """Slow-domain (DCN) groups: one per inner index, striding across the
+    fast domains — the reference's per-local-rank cross_comm."""
+    return [[o * inner + i for o in range(size // inner)]
+            for i in range(inner)]
+
+
+def hierarchical_allreduce_in_axis(x, axis: str, inner: int,
+                                   average: bool = False):
+    """Two-level allreduce INSIDE a flat 1-D SPMD axis, via
+    ``axis_index_groups`` — no second mesh axis needed.
+
+    Same ladder as the reference's hierarchical path (operations.cc:
+    1284-1436): reduce-scatter within the fast (ICI) group, allreduce the
+    1/inner shard across the slow (DCN) group, all-gather within the fast
+    group. The cross-domain phase moves size/inner bytes per chip — the
+    bandwidth property the reference's design bought.
+    """
+    from jax import lax
+    import jax.numpy as jnp
+
+    size = lax.axis_size(axis)
+    if inner <= 1 or inner >= size or size % inner != 0:
+        out = lax.psum(x, axis)
+        return out / size if average else out
+    ig = inner_groups(size, inner)
+    og = outer_groups(size, inner)
+    orig_shape = x.shape
+    n = x.size
+    pad = (-n) % inner
+    flat = x.reshape(-1)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shards = flat.reshape(inner, -1)
+    my_shard = lax.psum_scatter(shards, axis, scatter_dimension=0,
+                                axis_index_groups=ig, tiled=False)
+    my_shard = lax.psum(my_shard, axis, axis_index_groups=og)
+    full = lax.all_gather(my_shard, axis, axis=0,
+                          axis_index_groups=ig).reshape(-1)
+    if pad:
+        full = full[:n]
+    out = full.reshape(orig_shape)
+    if average:
+        out = out / size
+    return out
+
+
+def hierarchical_allgather_in_axis(x, axis: str, inner: int):
+    """Two-phase allgather inside a flat 1-D SPMD axis (reference
+    operations.cc:929-1032: node-local gather into a shared window, then
+    cross-node exchange). Phase 1 gathers within the fast group; phase 2
+    exchanges whole fast-group blocks across the slow group, yielding the
+    same rank-major concatenation a flat all_gather produces."""
+    from jax import lax
+
+    size = lax.axis_size(axis)
+    if inner <= 1 or inner >= size or size % inner != 0:
+        return lax.all_gather(x, axis, tiled=True)
+    block = lax.all_gather(x, axis, tiled=True,
+                           axis_index_groups=inner_groups(size, inner))
+    return lax.all_gather(block, axis, tiled=True,
+                          axis_index_groups=outer_groups(size, inner))
+
+
 def hierarchical_allreduce(x, outer_axis: str = "dcn",
                            inner_axis: str = "ici", average: bool = False):
     """Two-phase allreduce over a hierarchical mesh, inside shard_map.

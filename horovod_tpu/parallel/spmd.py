@@ -95,33 +95,80 @@ def spmd_fn(
     )
     if not jit:
         return shmapped
-    compiled = jax.jit(shmapped, donate_argnums=donate_argnums)
 
     track = getattr(fn, "__name__", "spmd_fn")
     compiled_once = [False]
 
+    def _globalize(args):
+        """Multi-host entry: each process passes its HOST-LOCAL shard
+        (the Horovod programming model — every process loads its own
+        slice of the batch); assemble them into global jax.Arrays over
+        the full mesh. Single-process jobs skip this entirely."""
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.host_local_array_to_global_array(
+            tuple(args), mesh, in_specs
+        )
+
+    def _localize(out):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.global_array_to_host_local_array(
+            out, mesh, out_specs
+        )
+    # Box the jit handle so the HOROVOD_AUTOTUNE tuner can force a re-trace
+    # (a fresh jit wrapper) when it changes the fusion threshold — the
+    # threshold is read at trace time by horovod_tpu.jax.fusion, so a new
+    # bucket plan needs a new program. built_gen tracks which tuner
+    # generation this handle's program was traced under.
+    compiled_box = [jax.jit(shmapped, donate_argnums=donate_argnums)]
+    built_gen = [None]
+
     @functools.wraps(fn)
     def dispatch(*args, **kwargs):
         st = _state.global_state()
+        tuner = getattr(st, "autotuner", None)
+        if tuner is not None and not tuner.converged:
+            if built_gen[0] is None:
+                built_gen[0] = tuner.generation  # first build already fresh
+            elif built_gen[0] != tuner.generation:
+                compiled_box[0] = jax.jit(
+                    shmapped, donate_argnums=donate_argnums
+                )
+                built_gen[0] = tuner.generation
+                compiled_once[0] = False
+
+        multi_host = st.process_count > 1
+        if multi_host:
+            args = _globalize(args)
+
         tl = getattr(st, "timeline", None)
         if tl is None or not tl.enabled:
+            out = compiled_box[0](*args, **kwargs)
             compiled_once[0] = True
-            return compiled(*args, **kwargs)
-        from horovod_tpu.utils import timeline as _tl_names
+        else:
+            from horovod_tpu.utils import timeline as _tl_names
 
-        # The first dispatch blocks through trace+compile (a real span);
-        # later spans time only the async host dispatch.
-        act = (_tl_names.XLA_EXECUTE if compiled_once[0]
-               else _tl_names.XLA_COMPILE)
-        span = "host_dispatch" if compiled_once[0] else "trace+compile"
-        tl.start(track, act, args={"span": span})
-        try:
-            return compiled(*args, **kwargs)
-        finally:
-            tl.end(track, act)
-            compiled_once[0] = True
+            # The first dispatch blocks through trace+compile (a real
+            # span); later spans time only the async host dispatch.
+            act = (_tl_names.XLA_EXECUTE if compiled_once[0]
+                   else _tl_names.XLA_COMPILE)
+            span = "host_dispatch" if compiled_once[0] else "trace+compile"
+            tl.start(track, act, args={"span": span})
+            try:
+                out = compiled_box[0](*args, **kwargs)
+            finally:
+                tl.end(track, act)
+                compiled_once[0] = True
 
-    dispatch._compiled = compiled  # escape hatch for AOT (.lower) users
+        if tuner is not None and tuner.step_done():
+            jax.block_until_ready(out)  # observe real device time
+            tuner.end_window()
+        if multi_host:
+            out = _localize(out)
+        return out
+
+    dispatch._compiled = compiled_box[0]  # escape hatch for AOT (.lower) users
     return dispatch
 
 

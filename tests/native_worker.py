@@ -134,6 +134,23 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
             converged = bool((out == out[0]).all())
         assert converged, "autotuned parameters never converged across ranks"
 
+    elif scenario == "stall":
+        # Rank 1 holds back its request so rank 0's stall checker
+        # (coordinator.cc CheckForStalled, parity with reference
+        # operations.cc:1625-1672) must warn, then completes the
+        # collective so the job still finishes cleanly. The test launcher
+        # sets HOROVOD_STALL_WARNING_TIME low and asserts the warning text
+        # on rank 0's stderr.
+        import time
+
+        if rank == 1:
+            time.sleep(3.0)
+        a = np.ones(8, dtype=np.float32)
+        h = core.allreduce_async_("stalled_t", a)
+        core.wait(h)
+        core.release(h)
+        assert np.allclose(a, float(size))
+
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 

@@ -174,6 +174,33 @@ class TestMultiProcess:
         _spawn(2, "errors")
 
 
+class TestStallDetection:
+    def test_stall_warning_emitted_and_job_recovers(self):
+        """A rank that holds back one collective must provably produce the
+        rank-0 stall warning naming the missing rank (reference
+        CheckForStalledTensors, operations.cc:1625-1672), and the job must
+        still complete once the straggler arrives."""
+        port = _free_port()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("JAX_PLATFORMS", None)
+        env["HOROVOD_STALL_WARNING_TIME"] = "0.5"
+        procs = []
+        for rank in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, str(WORKER), str(rank), "2", str(port),
+                 "stall"],
+                env=env, cwd=str(REPO),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outs = []
+        for rank, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            outs.append(err.decode())
+            assert p.returncode == 0, f"rank {rank}: {err.decode()[-2000:]}"
+        assert "waiting for remainder of ranks" in outs[0], outs[0][-2000:]
+        assert "missing ranks: 1" in outs[0], outs[0][-2000:]
+
+
 class TestTransportAuth:
     """The TCP transport authenticates every connection with an
     HMAC-SHA256 challenge-response keyed by HOROVOD_SECRET (csrc/auth.cc),
@@ -248,6 +275,16 @@ class TestAutotune:
         """Rank-0's tuned {cycle time, fusion threshold} reach every rank
         (reference SyncParams semantics, parameter_manager.h:95-96,232)."""
         _spawn(2, "autotune_sync", timeout=150)
+
+    def test_gp_hyperparameter_fit_adapts(self):
+        """The GP now fits {length scale, signal variance} by maximizing
+        the log marginal likelihood (reference gaussian_process.h:32-60);
+        the native self-test checks the kernel adapts to data roughness
+        and still interpolates."""
+        from horovod_tpu.native import load_library
+
+        lib = load_library()
+        assert lib.hvdtpu_gp_selftest() == 1
 
     def test_autotune_log_and_convergence(self, tmp_path):
         from horovod_tpu.native import NativeCore
