@@ -53,14 +53,20 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
         # here, and single-process usage stays zero-config.
         jax_coord = os.environ.get("HOROVOD_JAX_COORDINATOR", "")
         if jax_coord and os.environ.get("HOROVOD_SIZE"):
-            try:
+            # Skip only when the distributed runtime is ALREADY up (e.g.
+            # the TPU pod runtime); a connect failure must propagate —
+            # swallowing it would leave this rank world-size 1 while its
+            # peers block on the barrier, with zero diagnostics.
+            already_up = (
+                getattr(jax.distributed.global_state, "client", None)
+                is not None
+            )
+            if not already_up:
                 jax.distributed.initialize(
                     coordinator_address=jax_coord,
                     num_processes=int(os.environ["HOROVOD_SIZE"]),
                     process_id=int(os.environ.get("HOROVOD_RANK", "0")),
                 )
-            except RuntimeError:
-                pass  # already initialized (e.g. by the TPU runtime)
         state.config = Config.from_env()
         state.devices = list(devices) if devices is not None else list(jax.devices())
         state.process_index = jax.process_index()
@@ -99,8 +105,16 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
             # 211-217 scoring semantics; see horovod_tpu/jax/autotune.py).
             from horovod_tpu.jax.autotune import StepAutotuner
 
+            # Log on process 0 only (the reference gated tuner logging to
+            # the coordinator rank); every process still RUNS the tuner so
+            # generations stay in lockstep.
             state.autotuner = StepAutotuner(
-                state.config, log_path=state.config.autotune_log
+                state.config,
+                log_path=(
+                    state.config.autotune_log
+                    if state.process_index == 0
+                    else ""
+                ),
             )
 
         state.initialized = True

@@ -64,6 +64,8 @@ from horovod_tpu.jax.optimizer import (
     grad,
     value_and_grad,
 )
+from horovod_tpu.jax import zero
+from horovod_tpu.jax.zero import sharded_distributed_optimizer
 from horovod_tpu.parallel.spmd import spmd, spmd_fn, spmd_run
 
 # TF-parity aliases (reference tensorflow/__init__.py:95-115).
@@ -119,4 +121,6 @@ __all__ = [
     "spmd",
     "spmd_fn",
     "spmd_run",
+    "zero",
+    "sharded_distributed_optimizer",
 ]

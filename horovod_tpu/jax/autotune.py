@@ -57,10 +57,22 @@ class StepAutotuner:
         self._steps_in_window = 0
         self._t0: Optional[float] = None
         self._samples = 0
+        self._owner = None
         self._log = open(log_path, "w") if log_path else None
         config.fusion_threshold = self.candidates[0]
 
     # -- dispatch-side hooks ------------------------------------------------
+
+    def claim(self, handle) -> bool:
+        """Bind the tuner to ONE dispatch handle — the first to dispatch
+        while tuning. Only the owner's steps are counted/scored; a second
+        SPMD handle in the loop (eval step, metric reduction) would
+        otherwise pollute the steps/sec score with a different program.
+        Ownership is deterministic across processes because dispatch order
+        is program order."""
+        if self._owner is None:
+            self._owner = handle
+        return self._owner is handle
 
     def step_done(self) -> bool:
         """Count one dispatched step; True when the caller must block on the
@@ -87,10 +99,19 @@ class StepAutotuner:
             self.best_threshold = self.config.fusion_threshold
         self._idx += 1
         if self._idx >= len(self.candidates):
+            overridden = self._sync_winner()
             self.config.fusion_threshold = self.best_threshold
             self.converged = True
             self.generation += 1
-            self._log_line("converged", self.best_threshold, self.best_score)
+            # When process 0's winner overrode the local one, the local
+            # best_score was measured for a DIFFERENT threshold — logging
+            # it against the adopted threshold would be a lie.
+            if overridden:
+                self._log_line("converged_synced", self.best_threshold, 0.0)
+            else:
+                self._log_line(
+                    "converged", self.best_threshold, self.best_score
+                )
             if self._log is not None:
                 self._log.close()
                 self._log = None
@@ -99,6 +120,32 @@ class StepAutotuner:
             self.generation += 1
             self._warming = True
             self._t0 = now
+
+    def _sync_winner(self) -> bool:
+        """Multi-host: adopt process 0's winner so every process re-traces
+        the SAME bucket plan. Local timing noise can rank candidates
+        differently per host; divergent plans would lower different
+        collective sequences into the "same" SPMD program. The reference
+        broadcast tuned params from rank 0 for the same reason
+        (horovod/common/parameter_manager.h:95-96,232). Returns True when
+        the local winner was overridden."""
+        from horovod_tpu.common.state import global_state
+
+        st = global_state()
+        if st.process_count <= 1:
+            return False
+        import jax.numpy as jnp
+
+        from horovod_tpu.jax import eager
+
+        won = int(
+            eager.process_broadcast(
+                jnp.asarray([self.best_threshold], jnp.int32), 0
+            )[0]
+        )
+        overridden = won != self.best_threshold
+        self.best_threshold = won
+        return overridden
 
     def close(self) -> None:
         if self._log is not None:

@@ -1,0 +1,295 @@
+"""ZeRO-1 optimizer-state sharding over the data-parallel axis.
+
+The reference framework replicates optimizer state on every rank and
+allreduces full gradients (horovod/torch/__init__.py:95-151). On a TPU
+mesh the same bytes can carry more information: a reduce-scatter delivers
+each rank the *sum* of 1/N of the gradient for half the cost of a full
+allreduce, each rank updates only its 1/N slice of the optimizer state,
+and an all-gather of the updated slice completes the step. Total wire
+traffic per step is identical to one allreduce (reduce-scatter +
+all-gather is exactly how a ring allreduce decomposes), but optimizer
+state memory and update FLOPs drop by the axis size. This is the ZeRO
+stage-1 partitioning (Rajbhandari et al., 2020) expressed as XLA
+collectives; the reference has no counterpart (it predates ZeRO), so this
+is a TPU-first extension, not a parity item.
+
+Design (idiomatic shard_map, no runtime coordination):
+
+* ``sharded_distributed_optimizer(opt)`` is an ``optax``
+  GradientTransformation, drop-in where :func:`DistributedOptimizer` fits.
+* ``init`` (outside the SPMD region) builds the optimizer state over ONE
+  flat padded vector per parameter dtype — its leaves have *global* shape
+  ``(pad,)``. Fed into the training step with ``P("hvd")`` partition
+  specs, shard_map gives each rank its ``(pad/N,)`` slice: the state is
+  physically sharded across chips, never materialized whole on any one.
+* ``update`` (inside the SPMD region): flatten grads per dtype,
+  ``lax.psum_scatter`` (the reduce-scatter phase of the ring), update the
+  local shard with the wrapped optimizer, ``lax.all_gather`` the updated
+  slice back to full parameter updates.
+* :func:`state_partition_specs` derives the ``P("hvd")``-vs-replicated
+  spec tree for a state containing :class:`ZeroState` nodes, so wiring
+  the sharding into ``spmd_fn(in_specs=..., out_specs=...)`` is one call.
+
+Constraint: the wrapped optimizer must be *elementwise* (sgd, momentum,
+adam, adamw, rmsprop, ...). Transforms that mix information across
+parameters (``clip_by_global_norm``, layer-wise trust ratios) would see
+only the local shard; compose those *outside* this wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.state import current_spmd_axis, global_state
+
+
+class ZeroState:
+    """Optimizer state for the sharded optimizer.
+
+    ``inner`` is the wrapped optimizer's state over ``{dtype_key: flat}``
+    vectors; ``pads`` maps dtype key -> padded global flat length (static
+    metadata, carried in the pytree structure so partition-spec derivation
+    and donation both see it).
+    """
+
+    def __init__(self, inner: Any, pads: Dict[str, int]):
+        self.inner = inner
+        self.pads = dict(pads)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"ZeroState(pads={self.pads}, inner={self.inner!r})"
+
+
+jax.tree_util.register_pytree_node(
+    ZeroState,
+    lambda s: ((s.inner,), tuple(sorted(s.pads.items()))),
+    lambda aux, children: ZeroState(children[0], dict(aux)),
+)
+
+
+def _dtype_key(dt) -> str:
+    return str(jnp.dtype(dt))
+
+
+def _group_by_dtype(leaves) -> Dict[str, List[int]]:
+    """Leaf indices grouped by dtype, insertion-ordered within a group."""
+    groups: Dict[str, List[int]] = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(_dtype_key(leaf.dtype), []).append(i)
+    return groups
+
+
+def _pad_to(total: int, n: int) -> int:
+    return ((total + n - 1) // n) * n
+
+
+def _flatten_group(leaves, idxs, pad: int):
+    flat = (
+        jnp.concatenate([leaves[i].ravel() for i in idxs])
+        if len(idxs) > 1
+        else leaves[idxs[0]].ravel()
+    )
+    if flat.size < pad:
+        flat = jnp.pad(flat, (0, pad - flat.size))
+    return flat
+
+
+def _split_group(flat, leaves, idxs, out: list) -> None:
+    offset = 0
+    for i in idxs:
+        sz = leaves[i].size
+        out[i] = flat[offset : offset + sz].reshape(leaves[i].shape)
+        offset += sz
+
+
+def sharded_distributed_optimizer(
+    optimizer: optax.GradientTransformation,
+    average: bool = True,
+    axis_name: str = "hvd",
+    compression=None,
+) -> optax.GradientTransformation:
+    """Wrap ``optimizer`` with ZeRO-1 sharding over the ``axis_name`` mesh
+    axis. See the module docstring for semantics.
+
+    ``compression`` (e.g. ``Compression.fp16``) applies to the
+    reduce-scatter wire, the analogue of the reference compressing the
+    allreduce wire (horovod/tensorflow/compression.py:46-64); the
+    all-gather of updates stays in the update dtype.
+
+    With one rank this degrades to a flat-vector local update (identical
+    results to the unwrapped optimizer); the multi-process eager lane is
+    unsupported (the SPMD lane is where sharding pays). Multi-host jobs
+    must build the training step with ``spmd_fn(..., host_local=False)``
+    and carry global jax.Arrays — the state's flat vectors are global,
+    not per-host shards, and update() rejects the default host-local
+    conversion with a clear error.
+    """
+    from horovod_tpu.jax.compression import Compression
+
+    if compression is None:
+        compression = Compression.none
+
+    def init_fn(params):
+        st = global_state()
+        st.require_init()
+        n = basics.size()
+        leaves = jax.tree_util.tree_leaves(params)
+        groups = _group_by_dtype(leaves)
+        pads = {
+            key: _pad_to(sum(leaves[i].size for i in idxs), n)
+            for key, idxs in groups.items()
+        }
+        # Global-shaped flat zeros; sharded physically by the P(axis) specs
+        # the caller attaches (state_partition_specs).
+        flats = {
+            key: jnp.zeros((pads[key],), dtype=jnp.dtype(key))
+            for key in sorted(groups)
+        }
+        return ZeroState(optimizer.init(flats), pads)
+
+    def update_fn(updates, state: ZeroState, params=None):
+        axis = current_spmd_axis()
+        st = global_state()
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        groups = _group_by_dtype(leaves)
+        if set(state.pads) != set(groups):
+            raise ValueError(
+                f"gradient dtypes {sorted(groups)} do not match the dtypes "
+                f"this optimizer state was initialized with "
+                f"{sorted(state.pads)}"
+            )
+        p_leaves = (
+            jax.tree_util.tree_leaves(params) if params is not None else None
+        )
+
+        if axis is None:
+            if st.process_count > 1:
+                raise NotImplementedError(
+                    "sharded_distributed_optimizer requires the SPMD lane "
+                    "(hvd.spmd_run/spmd_fn); the multi-process eager lane "
+                    "keeps optimizer state replicated — use "
+                    "DistributedOptimizer there."
+                )
+            n = 1
+        else:
+            if st.process_count > 1 and getattr(
+                st, "dispatch_host_local", True
+            ):
+                raise ValueError(
+                    "ZeRO optimizer state holds GLOBAL-shaped flat vectors, "
+                    "but this multi-host spmd_fn was built with the default "
+                    "host_local=True, which would treat them as per-host "
+                    "shards and concatenate them. Build the training step "
+                    "with hvd.spmd_fn(..., host_local=False) and keep "
+                    "global jax.Arrays across steps."
+                )
+            axis = axis_name  # shard over OUR axis (may differ from the
+            # harness axis on a multi-axis mesh)
+            n = lax.axis_size(axis)
+
+        g_shards: Dict[str, Any] = {}
+        p_shards: Optional[Dict[str, Any]] = {} if p_leaves is not None else None
+        for key in sorted(groups):
+            idxs = groups[key]
+            pad = state.pads[key]
+            flat_g = _flatten_group(leaves, idxs, pad)
+            if axis is not None and n > 1:
+                # Reduce-scatter: this rank receives the cross-rank SUM of
+                # its 1/n slice (the first half of a ring allreduce). The
+                # wire is compressed; the shard is decompressed locally.
+                wire, cctx = compression.compress(flat_g)
+                g_shard = lax.psum_scatter(
+                    wire, axis, scatter_dimension=0, tiled=True
+                )
+                g_shard = compression.decompress(g_shard, cctx)
+            else:
+                g_shard = flat_g
+            if average and n > 1:
+                g_shard = g_shard / n
+            g_shards[key] = g_shard
+            if p_leaves is not None:
+                flat_p = _flatten_group(p_leaves, idxs, pad)
+                if axis is not None and n > 1:
+                    shard = pad // n
+                    idx = lax.axis_index(axis)
+                    flat_p = lax.dynamic_slice_in_dim(
+                        flat_p, idx * shard, shard
+                    )
+                p_shards[key] = flat_p
+
+        upd_shards, new_inner = optimizer.update(
+            g_shards, state.inner, p_shards
+        )
+
+        out: list = [None] * len(leaves)
+        for key in sorted(groups):
+            idxs = groups[key]
+            upd = upd_shards[key]
+            if axis is not None and n > 1:
+                # All-gather the updated slice (the second half of the
+                # ring); every rank reconstructs the full update vector.
+                upd = lax.all_gather(upd, axis, tiled=True)
+            _split_group(upd, leaves, idxs, out)
+        new_updates = jax.tree_util.tree_unflatten(
+            treedef,
+            [o.astype(l.dtype) for o, l in zip(out, leaves)],
+        )
+        return new_updates, ZeroState(new_inner, state.pads)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def state_partition_specs(opt_state, axis_name: str = "hvd"):
+    """Partition specs for a (possibly nested) optimizer state containing
+    :class:`ZeroState` nodes: the flat sharded vectors get ``P(axis)``,
+    everything else (scalar counts, non-ZeRO states) stays replicated.
+
+    Use for both ``in_specs`` and ``out_specs`` of the training step::
+
+        spec = TrainState(params=P(), batch_stats=P(), step=P(),
+                          opt_state=zero.state_partition_specs(opt_state))
+    """
+
+    def spec_for(node):
+        if isinstance(node, ZeroState):
+            pads = set(node.pads.values())
+            inner = jax.tree_util.tree_map(
+                lambda l: (
+                    P(axis_name)
+                    if getattr(l, "ndim", None) == 1 and l.shape[0] in pads
+                    else P()
+                ),
+                node.inner,
+            )
+            return ZeroState(inner, node.pads)
+        return P()
+
+    return jax.tree_util.tree_map(
+        spec_for, opt_state, is_leaf=lambda n: isinstance(n, ZeroState)
+    )
+
+
+def shard_info(opt_state) -> Dict[str, Tuple[int, int]]:
+    """{dtype_key: (global_padded_len, per_rank_len)} for every ZeroState
+    found in ``opt_state`` (merged); introspection/testing helper."""
+    n = basics.size()
+    info: Dict[str, Tuple[int, int]] = {}
+
+    def visit(node):
+        if isinstance(node, ZeroState):
+            for key, pad in node.pads.items():
+                info[key] = (pad, pad // n)
+        return node
+
+    jax.tree_util.tree_map(
+        visit, opt_state, is_leaf=lambda x: isinstance(x, ZeroState)
+    )
+    return info

@@ -22,6 +22,7 @@ from horovod_tpu.models.train import (
     cross_entropy_loss,
     make_eval_step,
     make_train_step,
+    state_partition_specs,
 )
 from horovod_tpu.models.transformer import TransformerBlock, TransformerLM
 from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
@@ -71,4 +72,5 @@ __all__ = [
     "cross_entropy_loss",
     "make_eval_step",
     "make_train_step",
+    "state_partition_specs",
 ]

@@ -62,17 +62,34 @@ def create_train_state(
     distributed: bool = True,
     compression=Compression.none,
     backward_passes_per_step: int = 1,
+    zero: bool = False,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params/batch_stats and the (wrapped) optimizer state.
 
     ``distributed=True`` wraps ``optimizer`` in :func:`DistributedOptimizer`
     — the one-line change the reference advertised
     (reference README.md:96-141).
+
+    ``zero=True`` uses ZeRO-1 optimizer-state sharding instead
+    (:mod:`horovod_tpu.jax.zero`): same wire bytes, optimizer state and
+    update FLOPs divided by the axis size. Feed the resulting state through
+    the step with :func:`state_partition_specs` so the opt-state leaves are
+    physically sharded.
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", FrozenDict())
-    if distributed:
+    if zero:
+        from horovod_tpu.jax.zero import sharded_distributed_optimizer
+
+        optimizer = sharded_distributed_optimizer(
+            optimizer, compression=compression
+        )
+        if backward_passes_per_step > 1:
+            optimizer = optax.MultiSteps(
+                optimizer, every_k_schedule=backward_passes_per_step
+            ).gradient_transformation()
+    elif distributed:
         optimizer = DistributedOptimizer(
             optimizer,
             compression=compression,
@@ -137,6 +154,23 @@ def make_train_step(model, optimizer: optax.GradientTransformation, average_loss
         return new_state, {"loss": loss, "accuracy": accuracy}
 
     return train_step
+
+
+def state_partition_specs(state: TrainState):
+    """Partition-spec pytree for a :class:`TrainState`: everything
+    replicated except ZeRO-sharded optimizer-state vectors (which get
+    ``P("hvd")``). Pass as both ``in_specs`` and the state half of
+    ``out_specs`` when training with ``create_train_state(..., zero=True)``."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.jax import zero as _zero
+
+    return TrainState(
+        params=P(),
+        batch_stats=P(),
+        opt_state=_zero.state_partition_specs(state["opt_state"]),
+        step=P(),
+    )
 
 
 def make_eval_step(model):

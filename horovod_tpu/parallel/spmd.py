@@ -51,8 +51,19 @@ def spmd_fn(
     check_vma: bool = False,
     jit: bool = True,
     donate_argnums=(),
+    host_local: bool = True,
 ):
     """Build (once) the compiled SPMD form of ``fn``.
+
+    ``host_local`` (multi-host only): when True (default, the Horovod
+    programming model) every process passes its host-local input shard and
+    receives host-local outputs — each dispatch converts to/from global
+    jax.Arrays. That round-trip reshards the ENTIRE argument list every
+    step and breaks the donation chain for carried state; training loops
+    that thread a large state through consecutive calls should pass
+    ``host_local=False`` and keep global, already-sharded jax.Arrays
+    (outputs feed back in unchanged), paying the conversion only at the
+    loop boundary.
 
     Returns ``jit(shard_map(fn'))`` where ``fn'`` activates the "hvd"
     collective axis for :mod:`horovod_tpu.jax.mpi_ops` at trace time. Build
@@ -78,21 +89,30 @@ def spmd_fn(
     """
     mesh = mesh or _default_mesh()
 
-    @functools.wraps(fn)
-    def wrapped(*inner):
-        token = _state.set_spmd_axis(axis_name)
-        try:
-            return fn(*inner)
-        finally:
-            _state.reset_spmd_axis(token)
+    def _build_shmapped():
+        """A FRESH wrapper object per build: jax's tracing caches key on
+        callable identity, so re-jitting the same shard_map object would
+        silently reuse the old traced program — a rebuild must start from
+        a new chain for a changed fusion threshold to re-trace into a new
+        bucket plan."""
 
-    shmapped = jax.shard_map(
-        wrapped,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        check_vma=check_vma,
-    )
+        @functools.wraps(fn)
+        def wrapped(*inner):
+            token = _state.set_spmd_axis(axis_name)
+            try:
+                return fn(*inner)
+            finally:
+                _state.reset_spmd_axis(token)
+
+        return jax.shard_map(
+            wrapped,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+    shmapped = _build_shmapped()
     if not jit:
         return shmapped
 
@@ -128,17 +148,27 @@ def spmd_fn(
     def dispatch(*args, **kwargs):
         st = _state.global_state()
         tuner = getattr(st, "autotuner", None)
-        if tuner is not None and not tuner.converged:
+        # Re-jit whenever the tuner's generation moved — including the FINAL
+        # bump that accompanies convergence, which is what applies the
+        # winning threshold (converged flips and generation increments in
+        # the same end_window call; gating this on `not converged` would
+        # leave the last swept candidate's bucket plan in place forever).
+        if tuner is not None and built_gen[0] != tuner.generation:
             if built_gen[0] is None:
                 built_gen[0] = tuner.generation  # first build already fresh
-            elif built_gen[0] != tuner.generation:
+            else:
                 compiled_box[0] = jax.jit(
-                    shmapped, donate_argnums=donate_argnums
+                    _build_shmapped(), donate_argnums=donate_argnums
                 )
                 built_gen[0] = tuner.generation
                 compiled_once[0] = False
+                dispatch._compiled = compiled_box[0]
 
-        multi_host = st.process_count > 1
+        multi_host = host_local and st.process_count > 1
+        # Visible to trace-time consumers (e.g. the ZeRO optimizer, whose
+        # global-shaped state vectors are NOT host-local shards and must
+        # reject the default conversion on multi-host).
+        st.dispatch_host_local = host_local
         if multi_host:
             args = _globalize(args)
 
@@ -161,7 +191,12 @@ def spmd_fn(
                 tl.end(track, act)
                 compiled_once[0] = True
 
-        if tuner is not None and tuner.step_done():
+        if (
+            tuner is not None
+            and not tuner.converged
+            and tuner.claim(dispatch)
+            and tuner.step_done()
+        ):
             jax.block_until_ready(out)  # observe real device time
             tuner.end_window()
         if multi_host:

@@ -66,6 +66,49 @@ def test_step_autotuner_sweeps_and_converges(hvd, tmp_path):
         st.config.fusion_threshold = saved_threshold
 
 
+def test_winner_applied_to_dispatch_after_convergence(hvd):
+    """Regression: convergence bumps the generation one final time, and the
+    dispatch handle must re-jit on that bump — otherwise the LAST swept
+    candidate's bucket plan (not the winner's) runs for the rest of the
+    job, and the stale ``_compiled`` escape hatch lies about it."""
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.autotune import StepAutotuner
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    st = global_state()
+    saved_threshold = st.config.fusion_threshold
+    tuner = StepAutotuner(st.config, candidates=[0, 64 << 20], window=1)
+    st.autotuner = tuner
+    try:
+        thresholds_seen = []
+
+        def step(x, y):
+            # Record the threshold active at TRACE time: one entry per
+            # (re)trace, so the list is the program history.
+            thresholds_seen.append(st.config.fusion_threshold)
+            a, b = fused_reduce([x, y], average=False)
+            return a + 1.0, b + 1.0
+
+        run = hvd.spmd_fn(step, in_specs=(P(), P()), out_specs=(P(), P()))
+        handle_before = run._compiled
+        x = jnp.ones((64,), jnp.float32)
+        y = jnp.ones((32,), jnp.float32)
+        for _ in range(20):
+            x, y = run(x, y)
+            if tuner.converged:
+                break
+        # One more dispatch AFTER convergence triggers the final re-jit.
+        x, y = run(x, y)
+        assert tuner.converged
+        # The last trace happened under the winning threshold.
+        assert thresholds_seen[-1] == tuner.best_threshold
+        # And the escape hatch tracks the live handle.
+        assert run._compiled is not handle_before
+    finally:
+        st.autotuner = None
+        st.config.fusion_threshold = saved_threshold
+
+
 def test_tuner_changes_bucket_plan(hvd):
     """The swept knob must actually change the traced program's bucket
     plan: threshold 0 gives one collective per tensor, a large threshold

@@ -1,0 +1,293 @@
+"""ZeRO-1 sharded optimizer: equivalence with the flat DistributedOptimizer
+path, physical sharding of the state, padding edge cases, and the
+end-to-end ``create_train_state(zero=True)`` story.
+
+The reference has no ZeRO (it predates it); the correctness oracle is the
+repo's own flat lane — reduce-scatter + shard-update + all-gather must give
+bit-compatible results with allreduce + replicated-update, because that is
+literally the same ring decomposed (see horovod_tpu/jax/zero.py docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax import zero
+from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w1": jax.random.normal(k, (13, 7), jnp.float32),
+        "b1": jnp.zeros((7,), jnp.float32),
+        "w2": jax.random.normal(jax.random.fold_in(k, 1), (7, 3), jnp.float32),
+    }
+
+
+def _per_rank_grads(n):
+    """(n, ...)-leading stack of per-rank gradient pytrees."""
+    k = jax.random.PRNGKey(42)
+    p = _params()
+    return {
+        name: jax.random.normal(jax.random.fold_in(k, i), (n,) + leaf.shape, leaf.dtype)
+        for i, (name, leaf) in enumerate(sorted(p.items()))
+    }
+
+
+def _run_steps(optimizer, opt_specs, params, grads_stack, n_steps=3):
+    """Run ``n_steps`` updates under SPMD; grads arrive sharded by rank."""
+    opt_state = optimizer.init(params)
+
+    def step(params, opt_state, g):
+        g = jax.tree_util.tree_map(lambda t: t[0], g)  # drop the rank dim
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    fn = hvd.spmd_fn(
+        step,
+        in_specs=(P(), opt_specs, P("hvd")),
+        out_specs=(P(), opt_specs),
+    )
+    for _ in range(n_steps):
+        params, opt_state = fn(params, opt_state, grads_stack)
+    return params, opt_state
+
+
+class TestZeroEquivalence:
+    def test_adam_matches_flat(self, hvd):
+        n = hvd.size()
+        params = _params()
+        grads = _per_rank_grads(n)
+
+        flat_opt = DistributedOptimizer(optax.adam(1e-2))
+        p_flat, _ = _run_steps(flat_opt, P(), params, grads)
+
+        z_opt = hvd.sharded_distributed_optimizer(optax.adam(1e-2))
+        z_specs = zero.state_partition_specs(z_opt.init(params))
+        p_zero, _ = _run_steps(z_opt, z_specs, params, grads)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+            ),
+            p_flat,
+            p_zero,
+        )
+
+    def test_adamw_params_dependent_matches_flat(self, hvd):
+        """adamw reads params (weight decay): exercises the param-shard
+        slice path."""
+        n = hvd.size()
+        params = _params()
+        grads = _per_rank_grads(n)
+
+        flat_opt = DistributedOptimizer(optax.adamw(1e-2, weight_decay=0.1))
+        p_flat, _ = _run_steps(flat_opt, P(), params, grads)
+
+        z_opt = hvd.sharded_distributed_optimizer(
+            optax.adamw(1e-2, weight_decay=0.1)
+        )
+        z_specs = zero.state_partition_specs(z_opt.init(params))
+        p_zero, _ = _run_steps(z_opt, z_specs, params, grads)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+            ),
+            p_flat,
+            p_zero,
+        )
+
+    def test_momentum_non_divisible_total(self, hvd):
+        """Total param count (13*7 + 7 + 7*3 = 119) is not divisible by 8:
+        the padded tail must not perturb results."""
+        n = hvd.size()
+        assert (13 * 7 + 7 + 7 * 3) % n != 0
+        params = _params()
+        grads = _per_rank_grads(n)
+
+        flat_opt = DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        p_flat, _ = _run_steps(flat_opt, P(), params, grads)
+
+        z_opt = hvd.sharded_distributed_optimizer(optax.sgd(0.1, momentum=0.9))
+        z_specs = zero.state_partition_specs(z_opt.init(params))
+        p_zero, _ = _run_steps(z_opt, z_specs, params, grads)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            ),
+            p_flat,
+            p_zero,
+        )
+
+
+class TestZeroSharding:
+    def test_state_physically_sharded(self, hvd):
+        """After a step, the momentum vectors live sharded over the mesh:
+        each device holds pad/n elements, not the whole vector."""
+        n = hvd.size()
+        params = _params()
+        z_opt = hvd.sharded_distributed_optimizer(optax.adam(1e-2))
+        state0 = z_opt.init(params)
+        info = zero.shard_info(state0)
+        (pad, per_rank) = info["float32"]
+        total = sum(l.size for l in jax.tree_util.tree_leaves(params))
+        assert pad == ((total + n - 1) // n) * n
+        assert per_rank * n == pad
+
+        specs = zero.state_partition_specs(state0)
+        _, state1 = _run_steps(z_opt, specs, params, _per_rank_grads(n), n_steps=1)
+
+        sharded_leaves = [
+            l
+            for l in jax.tree_util.tree_leaves(state1)
+            if getattr(l, "ndim", 0) == 1 and l.shape[0] == pad
+        ]
+        assert sharded_leaves, "no sharded momentum vectors found"
+        for leaf in sharded_leaves:
+            shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+            assert shard_shapes == {(per_rank,)}, (
+                f"state leaf not sharded: {shard_shapes}"
+            )
+
+    def test_spec_tree_marks_only_flat_vectors(self, hvd):
+        params = _params()
+        z_opt = hvd.sharded_distributed_optimizer(optax.adam(1e-2))
+        state = z_opt.init(params)
+        specs = zero.state_partition_specs(state)
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        # adam: count (replicated) + mu + nu (sharded)
+        assert spec_leaves.count(P("hvd")) == 2
+        assert spec_leaves.count(P()) == 1
+
+    def test_single_rank_degrades_to_plain_optimizer(self, hvd):
+        """Outside SPMD with one process, zero == the unwrapped optimizer."""
+        params = _params()
+        g = jax.tree_util.tree_map(jnp.ones_like, params)
+
+        plain = optax.adam(1e-2)
+        ps = plain.init(params)
+        u_plain, _ = plain.update(g, ps, params)
+
+        z = hvd.sharded_distributed_optimizer(optax.adam(1e-2))
+        zs = z.init(params)
+        u_zero, _ = z.update(g, zs, params)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            u_plain,
+            u_zero,
+        )
+
+    def test_fp16_compressed_wire(self, hvd):
+        """Compression applies to the reduce-scatter wire: results stay
+        within fp16 quantization of the uncompressed path."""
+        n = hvd.size()
+        params = _params()
+        grads = _per_rank_grads(n)
+
+        exact = hvd.sharded_distributed_optimizer(optax.sgd(0.1))
+        specs = zero.state_partition_specs(exact.init(params))
+        p_exact, _ = _run_steps(exact, specs, params, grads, n_steps=1)
+
+        from horovod_tpu.jax.compression import Compression
+
+        comp = hvd.sharded_distributed_optimizer(
+            optax.sgd(0.1), compression=Compression.fp16
+        )
+        p_comp, _ = _run_steps(comp, specs, params, grads, n_steps=1)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=0, atol=5e-3
+            ),
+            p_exact,
+            p_comp,
+        )
+
+    def test_dtype_mismatch_rejected(self, hvd):
+        params = _params()
+        z = hvd.sharded_distributed_optimizer(optax.sgd(0.1))
+        zs = z.init(params)
+        bad = jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.bfloat16), params
+        )
+        with pytest.raises(ValueError, match="dtypes"):
+            z.update(bad, zs, params)
+
+
+class TestZeroTrainState:
+    def test_create_train_state_zero_end_to_end(self, hvd):
+        """Full story: create_train_state(zero=True) + make_train_step +
+        state_partition_specs trains and the loss is finite."""
+        from horovod_tpu import models
+
+        n = hvd.size()
+        model = models.MNISTNet()
+        rng = jax.random.PRNGKey(0)
+        sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        state, optimizer = models.create_train_state(
+            rng, model, optax.adam(1e-3), sample, zero=True
+        )
+        step = models.make_train_step(model, optimizer)
+        spec = models.state_partition_specs(state)
+
+        batch = {
+            "image": jax.random.normal(rng, (2 * n, 28, 28, 1), jnp.float32),
+            "label": jax.random.randint(rng, (2 * n,), 0, 10),
+        }
+        fn = hvd.spmd_fn(
+            step, in_specs=(spec, P("hvd")), out_specs=(spec, P())
+        )
+        state, metrics = fn(state, batch)
+        state, metrics = fn(state, batch)
+        assert int(state["step"]) == 2
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_zero_vs_flat_training_equivalence(self, hvd):
+        """The same model trained 3 steps with flat DP vs ZeRO lands on the
+        same weights."""
+        from horovod_tpu import models
+
+        n = hvd.size()
+        rng = jax.random.PRNGKey(7)
+        sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        batch = {
+            "image": jax.random.normal(rng, (2 * n, 28, 28, 1), jnp.float32),
+            "label": jax.random.randint(rng, (2 * n,), 0, 10),
+        }
+
+        def train(zero_flag):
+            model = models.MNISTNet()
+            state, optimizer = models.create_train_state(
+                jax.random.PRNGKey(0), model, optax.sgd(0.05, momentum=0.9),
+                sample, zero=zero_flag,
+            )
+            step = models.make_train_step(model, optimizer)
+            spec = models.state_partition_specs(state) if zero_flag else P()
+            fn = hvd.spmd_fn(
+                step, in_specs=(spec, P("hvd")), out_specs=(spec, P())
+            )
+            for _ in range(3):
+                state, _ = fn(state, batch)
+            return state["params"]
+
+        p_flat = train(False)
+        p_zero = train(True)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-5, atol=1e-6
+            ),
+            p_flat,
+            p_zero,
+        )
