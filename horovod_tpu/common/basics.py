@@ -27,10 +27,11 @@ from horovod_tpu.common.state import current_spmd_axis, global_state
 def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
     """Initialize the framework.
 
-    ``comm`` optionally restricts the job to a subset of processes, mirroring
-    ``horovod_init(ranks, nranks)`` (reference operations.cc:1728-1746). On
-    TPU the device set is fixed by the slice topology, so a subset is only
-    honored for process-level eager collectives.
+    ``comm`` optionally restricts the job to a subset of ranks, mirroring
+    ``horovod_init(ranks, nranks)`` (reference operations.cc:1728-1746).
+    Ranks are chips on the SPMD lane, so ``comm=[0, 2]`` builds the
+    "hvd" mesh from chips 0 and 2 of the global device order and
+    ``size()`` becomes 2.
 
     ``devices`` optionally restricts the mesh to an explicit device list
     (TPU extension; the chip-level analogue of the ranks subset).
@@ -69,9 +70,36 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
                 )
         state.config = Config.from_env()
         state.devices = list(devices) if devices is not None else list(jax.devices())
+        if comm is not None:
+            # Ranks are chips on the SPMD lane, so the reference's
+            # rank-subset semantics (horovod_init(ranks, nranks),
+            # operations.cc:1728-1746) map to subsetting the mesh device
+            # list: hvd.init(comm=[0, 2]) builds a 2-chip job from chips
+            # 0 and 2 of the global order.
+            bad = [r for r in comm if not 0 <= r < len(state.devices)]
+            if bad:
+                raise InvalidArgumentError(
+                    f"comm ranks {bad} out of range for "
+                    f"{len(state.devices)} devices"
+                )
+            state.devices = [state.devices[r] for r in comm]
+            if jax.process_count() > 1 and not any(
+                getattr(d, "process_index", 0) == jax.process_index()
+                for d in state.devices
+            ):
+                # A process owning NO chip of the subset has no rank; two
+                # such processes would otherwise both report rank 0 and
+                # double-run every rank-0-gated action (checkpoint writes,
+                # logs). Exclude the process at launch instead.
+                raise InvalidArgumentError(
+                    "hvd.init(comm=...) selected no chips owned by this "
+                    "process; multi-host subsets must cover every "
+                    "participating process (exclude the others at the "
+                    "launcher level)."
+                )
         state.process_index = jax.process_index()
         state.process_count = jax.process_count()
-        if devices is not None:
+        if devices is not None or comm is not None:
             local_indices = [
                 i
                 for i, d in enumerate(state.devices)

@@ -19,12 +19,14 @@ from flax import serialization
 from horovod_tpu.flax.callbacks import (
     BroadcastGlobalVariablesCallback,
     Callback,
+    CheckpointCallback,
     LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
     get_hyperparam,
     set_hyperparam,
 )
+from horovod_tpu.flax.checkpoint import CheckpointManager
 from horovod_tpu.jax.optimizer import (
     DistributedOptimizer,
     broadcast_parameters,
@@ -165,6 +167,8 @@ __all__ = [
     "DistributedOptimizer",
     "save_model",
     "load_model",
+    "CheckpointManager",
+    "CheckpointCallback",
     "get_hyperparam",
     "set_hyperparam",
 ]

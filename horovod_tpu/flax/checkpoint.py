@@ -1,0 +1,97 @@
+"""Orbax-backed checkpointing — the TPU-native checkpoint/resume path.
+
+The reference delegated checkpointing to frameworks and contributed the
+*discipline*: write on rank 0 only, restore then re-broadcast (reference
+README.md:113-115, _keras/__init__.py:93-109, torch/__init__.py:232-348).
+:func:`horovod_tpu.flax.save_model` / ``load_model`` reproduce exactly
+that. This module is the path that discipline cannot reach: on pods the
+train state may be *sharded* (ZeRO optimizer vectors, TP weights) and
+larger than any single host, so "rank 0 writes everything" stops being
+possible. Orbax writes each array shard from the process that owns it,
+commits atomically, and restores arrays directly to their target
+shardings — no gather, no re-broadcast.
+
+Usage::
+
+    ckpt = hvd_flax.CheckpointManager("/ckpts", max_to_keep=3)
+    for epoch in ...:
+        ...
+        ckpt.save(step, state)            # async; shards written in place
+    # resume (all processes):
+    step = ckpt.latest_step()
+    if step is not None:
+        state = ckpt.restore(step, state) # restored WITH its shardings
+    ckpt.close()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+class CheckpointManager:
+    """Thin veneer over ``orbax.checkpoint.CheckpointManager`` wired to
+    horovod_tpu semantics: every process participates (required for
+    sharded state), saves are atomic, old steps are garbage-collected."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        directory = os.path.abspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> bool:
+        """Save ``state`` (any pytree of arrays, sharded or replicated)
+        under ``step``. Returns whether a save was performed (the manager
+        may skip per its policy)."""
+        return self._mngr.save(
+            int(step), args=self._ocp.args.StandardSave(state)
+        )
+
+    def restore(self, step: Optional[int] = None, template: Any = None):
+        """Restore ``step`` (default: latest). ``template`` — a concrete
+        or abstract (ShapeDtypeStruct) pytree — pins structure, dtypes and
+        target shardings; sharded leaves come back sharded."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self._mngr.directory}"
+                )
+        args = (
+            self._ocp.args.StandardRestore(template)
+            if template is not None
+            else self._ocp.args.StandardRestore()
+        )
+        return self._mngr.restore(int(step), args=args)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        """Block until outstanding async saves are committed."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -44,9 +44,13 @@ class StepAutotuner:
         window: int = 10,
     ) -> None:
         self.config = config
-        self.candidates: List[int] = list(
-            candidates if candidates is not None else DEFAULT_CANDIDATES
-        )
+        cand = list(candidates if candidates is not None else DEFAULT_CANDIDATES)
+        # Sweep the CURRENT (default) threshold first: if tuning ever
+        # stalls (e.g. no handle keeps dispatching), the job is left at
+        # the untuned default rather than at an arbitrary candidate.
+        self.candidates: List[int] = [config.fusion_threshold] + [
+            c for c in cand if c != config.fusion_threshold
+        ]
         self.window = max(1, int(window))
         self.generation = 1
         self.converged = False
@@ -58,21 +62,36 @@ class StepAutotuner:
         self._t0: Optional[float] = None
         self._samples = 0
         self._owner = None
+        self._owner_idle = 0
         self._log = open(log_path, "w") if log_path else None
         config.fusion_threshold = self.candidates[0]
 
     # -- dispatch-side hooks ------------------------------------------------
 
     def claim(self, handle) -> bool:
-        """Bind the tuner to ONE dispatch handle — the first to dispatch
-        while tuning. Only the owner's steps are counted/scored; a second
-        SPMD handle in the loop (eval step, metric reduction) would
-        otherwise pollute the steps/sec score with a different program.
-        Ownership is deterministic across processes because dispatch order
-        is program order."""
-        if self._owner is None:
+        """Bind the tuner to ONE dispatch handle at a time. Only the
+        owner's steps are counted/scored; a second SPMD handle in the loop
+        (eval step, metric reduction) would otherwise pollute the
+        steps/sec score with a different program. If the owner stops
+        dispatching (a warmup/eval handle that claimed first, a rebuilt
+        step), ownership hands off to the active handle after 3 windows
+        of owner inactivity and the partial window restarts — the sweep
+        can slow down but never stalls. Both claim and handoff follow
+        dispatch order, which is program order, so every process makes
+        identical decisions."""
+        if self._owner is None or handle is self._owner:
             self._owner = handle
-        return self._owner is handle
+            self._owner_idle = 0
+            return True
+        self._owner_idle += 1
+        if self._owner_idle > 3 * self.window:
+            self._owner = handle
+            self._owner_idle = 0
+            self._steps_in_window = 0
+            self._warming = True
+            self._t0 = None
+            return True
+        return False
 
     def step_done(self) -> bool:
         """Count one dispatched step; True when the caller must block on the
@@ -99,19 +118,14 @@ class StepAutotuner:
             self.best_threshold = self.config.fusion_threshold
         self._idx += 1
         if self._idx >= len(self.candidates):
-            overridden = self._sync_winner()
+            self._sync_winner()
             self.config.fusion_threshold = self.best_threshold
             self.converged = True
             self.generation += 1
-            # When process 0's winner overrode the local one, the local
-            # best_score was measured for a DIFFERENT threshold — logging
-            # it against the adopted threshold would be a lie.
-            if overridden:
-                self._log_line("converged_synced", self.best_threshold, 0.0)
-            else:
-                self._log_line(
-                    "converged", self.best_threshold, self.best_score
-                )
+            # Only process 0 has a log (basics gates log_path), and
+            # process 0 is the sync root, so its winner — and therefore
+            # this score — is always its own measurement.
+            self._log_line("converged", self.best_threshold, self.best_score)
             if self._log is not None:
                 self._log.close()
                 self._log = None

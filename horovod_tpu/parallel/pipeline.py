@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp"):
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp",
+                   remat: bool = False):
     """Run a P-stage pipeline over microbatches inside shard_map.
 
     Args:
@@ -31,10 +32,19 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp"):
         ``P("pp")`` in_specs; shard_map strips the leading axis — if the
         per-chip view keeps a leading singleton, it is squeezed).
       x: this call's microbatch stack [M, ...micro_shape] (replicated).
+      remat: rematerialize each stage application in the backward pass
+        (``jax.checkpoint``). Under autodiff the schedule stores one
+        activation per tick; remat drops the intra-stage intermediates
+        and recomputes them, cutting pipeline activation memory to
+        ~O(ticks x activation) — the TPU-idiomatic answer to 1F1B's
+        memory goal (trade FLOPs for HBM, keep the one-program SPMD
+        schedule).
 
     Returns [M, ...out_shape]: outputs of the final stage, replicated via
     a final broadcast psum so every chip returns the same value.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     size = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     M = x.shape[0]

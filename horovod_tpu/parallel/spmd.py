@@ -99,9 +99,18 @@ def spmd_fn(
         @functools.wraps(fn)
         def wrapped(*inner):
             token = _state.set_spmd_axis(axis_name)
+            st = _state.global_state()
+            # Expose THIS handle's host_local mode for the duration of the
+            # trace (runs at trace time, so any trace path — dispatch or
+            # the AOT ._compiled.lower() escape hatch — sees the right
+            # value; trace-time consumers like the ZeRO optimizer use it
+            # to reject the default host-local conversion on multi-host).
+            saved_hl = getattr(st, "dispatch_host_local", True)
+            st.dispatch_host_local = host_local
             try:
                 return fn(*inner)
             finally:
+                st.dispatch_host_local = saved_hl
                 _state.reset_spmd_axis(token)
 
         return jax.shard_map(
@@ -165,10 +174,6 @@ def spmd_fn(
                 dispatch._compiled = compiled_box[0]
 
         multi_host = host_local and st.process_count > 1
-        # Visible to trace-time consumers (e.g. the ZeRO optimizer, whose
-        # global-shaped state vectors are NOT host-local shards and must
-        # reject the default conversion on multi-host).
-        st.dispatch_host_local = host_local
         if multi_host:
             args = _globalize(args)
 

@@ -109,6 +109,38 @@ def test_winner_applied_to_dispatch_after_convergence(hvd):
         st.config.fusion_threshold = saved_threshold
 
 
+def test_owner_handoff_when_first_handle_goes_idle(hvd):
+    """Regression: a warmup/eval handle that dispatches first must not pin
+    the tuner forever — after 3 windows of owner inactivity, ownership
+    hands off to the active handle and the sweep completes."""
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.autotune import StepAutotuner
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    st = global_state()
+    saved_threshold = st.config.fusion_threshold
+    tuner = StepAutotuner(st.config, candidates=[0, 64 << 20], window=1)
+    st.autotuner = tuner
+    try:
+        def step(x):
+            return fused_reduce([x], average=False)[0] * 0.5
+
+        warmup = hvd.spmd_fn(step, in_specs=P(), out_specs=P())
+        x = jnp.ones((16,), jnp.float32)
+        warmup(x)  # claims the tuner, then never dispatches again
+
+        train = hvd.spmd_fn(step, in_specs=P(), out_specs=P())
+        for _ in range(30):
+            x = train(x)
+            if tuner.converged:
+                break
+        assert tuner.converged, "tuner stalled on an idle owner"
+        assert st.config.fusion_threshold == tuner.best_threshold
+    finally:
+        st.autotuner = None
+        st.config.fusion_threshold = saved_threshold
+
+
 def test_tuner_changes_bucket_plan(hvd):
     """The swept knob must actually change the traced program's bucket
     plan: threshold 0 gives one collective per tensor, a large threshold

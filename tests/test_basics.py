@@ -52,6 +52,50 @@ def test_mesh_axis(hvd):
     assert hvd.mesh().shape["hvd"] == 8
 
 
+def test_comm_subset_builds_sub_mesh():
+    """hvd.init(comm=[ranks]) restricts the job to those chips (reference
+    horovod_init(ranks, nranks), operations.cc:1728-1746): size shrinks,
+    the mesh holds exactly the subset, collectives span only it. Fresh
+    process because init is once-per-process."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import horovod_tpu.jax as hvd
+
+hvd.init(comm=[0, 2, 4, 6])
+assert hvd.size() == 4, hvd.size()
+assert [d.id for d in hvd.mesh().devices.ravel()] == [0, 2, 4, 6]
+out = hvd.spmd_run(lambda x: hvd.allreduce(x, average=False),
+                   jnp.ones((3,), jnp.float32))
+assert float(out[0]) == 4.0, out  # spans 4 chips, not 8
+try:
+    import horovod_tpu.common.basics as b
+    b.shutdown()
+    hvd.init(comm=[0, 99])
+except Exception as e:
+    assert "out of range" in str(e), e
+    print("COMM_SUBSET_OK")
+"""
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          cwd=str(repo), capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "COMM_SUBSET_OK" in proc.stdout
+
+
 def test_require_init():
     from horovod_tpu.common.state import GlobalState
 
