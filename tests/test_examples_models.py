@@ -33,6 +33,21 @@ def _run_example(script, *args, timeout=600, extra_env=None):
     return proc
 
 
+def _run_via_launcher(script, *args, np_ranks=2, timeout=600):
+    """Run an example under ``python -m horovod_tpu.run -np N``."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_ranks),
+         sys.executable, str(EXAMPLES / script), *args],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
 class TestModelZoo:
     @pytest.mark.parametrize("name,shape", [
         ("vgg11", (2, 32, 32, 3)),
@@ -151,14 +166,23 @@ class TestExamples:
         _run_example("long_context_ring_attention.py", "--smoke")
 
     def test_torch_mnist_via_launcher(self):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
-        env.pop("JAX_PLATFORMS", None)
-        env["HOROVOD_CYCLE_TIME"] = "1"
-        proc = subprocess.run(
-            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
-             sys.executable, str(EXAMPLES / "torch_mnist.py"),
-             "--epochs", "4", "--batch-size", "32", "--train-size", "2048"],
-            env=env, cwd=str(REPO), capture_output=True, text=True,
-            timeout=600)
-        assert proc.returncode == 0, proc.stderr[-2000:]
+        _run_via_launcher("torch_mnist.py", "--epochs", "4",
+                          "--batch-size", "32", "--train-size", "2048")
+
+    def test_torch_synthetic_benchmark_via_launcher(self):
+        """The torch-lane yardstick (reference
+        examples/pytorch_synthetic_benchmark.py protocol) runs under the
+        launcher and reports a positive throughput."""
+        proc = _run_via_launcher(
+            "torch_synthetic_benchmark.py", "--num-iters", "2",
+            "--num-batches-per-iter", "2", "--num-warmup-batches", "1")
+        assert float(proc.stdout.strip().splitlines()[-1]) > 0
+
+    def test_jax_transformer_zero_smoke(self, tmp_path):
+        """ZeRO + orbax checkpoint LM example trains (loss falls) and a
+        second invocation resumes from the saved step."""
+        _run_example("jax_transformer_zero.py", "--smoke",
+                     "--ckpt-dir", str(tmp_path / "zck"))
+        # Second run resumes at steps==latest and exits cleanly.
+        _run_example("jax_transformer_zero.py", "--smoke",
+                     "--ckpt-dir", str(tmp_path / "zck"))
