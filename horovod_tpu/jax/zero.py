@@ -34,6 +34,13 @@ Constraint: the wrapped optimizer must be *elementwise* (sgd, momentum,
 adam, adamw, rmsprop, ...). Transforms that mix information across
 parameters (``clip_by_global_norm``, layer-wise trust ratios) would see
 only the local shard; compose those *outside* this wrapper.
+
+Note on ZeRO stage 2 (gradient-shard persistence): under XLA the full
+gradient exists only transiently inside the one-step program — XLA frees
+the flat gradient buffer after the reduce-scatter consumes it, and
+nothing persists between steps except params and the (sharded) optimizer
+state. Stage 2's benefit over stage 1 is therefore automatic here; there
+is no resident gradient buffer to shard.
 """
 
 from __future__ import annotations
