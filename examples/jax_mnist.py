@@ -101,13 +101,27 @@ def main():
             f"chips) exceeds --train-size {args.train_size}; lower the "
             "batch size or enlarge the dataset")
 
+    from jax.sharding import NamedSharding
+
+    from horovod_tpu import data as hvd_data
+
+    # Each PROCESS iterates its own slice of every global batch
+    # (iterate_sharded defaults to the process topology), with one
+    # host->device transfer in flight while the previous step computes.
+    # Single-process jobs scatter batches straight to their mesh layout;
+    # multi-host keeps host-local arrays (spmd dispatch assembles them).
+    per_process_batch = global_batch // hvd.process_count()
+    batch_sharding = (
+        NamedSharding(hvd.mesh(), P("hvd"))
+        if hvd.process_count() == 1 else None
+    )
     for epoch in range(args.epochs):
         t0 = time.time()
-        perm = np.random.RandomState(epoch).permutation(args.train_size)
-        for s in range(steps_per_epoch):
-            idx = perm[s * global_batch:(s + 1) * global_batch]
-            batch = {"image": jnp.asarray(images[idx]),
-                     "label": jnp.asarray(labels[idx])}
+        epoch_batches = hvd_data.iterate_sharded(
+            {"image": images, "label": labels}, per_process_batch,
+            epoch=epoch)
+        for batch in hvd_data.prefetch_to_device(
+                epoch_batches, size=2, sharding=batch_sharding):
             state, metrics = run_train(state, batch)
         test_metrics = run_eval(state, {
             "image": jnp.asarray(test_images),

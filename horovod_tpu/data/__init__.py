@@ -1,0 +1,24 @@
+"""Data sharding + device prefetch utilities.
+
+The reference delegated input pipelines to the frameworks and its
+examples leaned on ``torch.utils.data.distributed.DistributedSampler``
+(reference examples/pytorch_mnist.py) — every rank reads a disjoint
+1/size slice, reshuffled per epoch. This module is that piece for the
+jax lanes, plus the device-feeding half that matters on TPU: keeping
+the next batch's host→device transfer in flight while the current step
+runs, so input never serializes with compute.
+"""
+
+from horovod_tpu.data.sharding import (
+    DistributedSampler,
+    iterate_sharded,
+    shard_indices,
+)
+from horovod_tpu.data.prefetch import prefetch_to_device
+
+__all__ = [
+    "DistributedSampler",
+    "shard_indices",
+    "iterate_sharded",
+    "prefetch_to_device",
+]

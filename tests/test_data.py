@@ -1,0 +1,112 @@
+"""Data sharding + prefetch: partition exactness, epoch reshuffle
+determinism, padding/drop semantics, and prefetch equivalence.
+
+Reference behavior model: torch DistributedSampler as used by the
+reference's examples (disjoint per-rank slices, per-epoch reshuffle,
+padding so all ranks see equal batch counts).
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu import data
+
+
+class TestShardIndices:
+    def test_partition_is_exact_cover(self, hvd):
+        n, size = 103, 8
+        all_idx = np.concatenate([
+            data.shard_indices(n, epoch=0, rank=r, size=size)
+            for r in range(size)
+        ])
+        # Padded cover: every sample appears; pad repeats are the only dups.
+        assert set(all_idx.tolist()) == set(range(n))
+        assert len(all_idx) == 104  # padded to a multiple of 8
+
+    def test_drop_remainder_is_disjoint_subset(self, hvd):
+        n, size = 103, 8
+        shards = [
+            data.shard_indices(n, rank=r, size=size, drop_remainder=True)
+            for r in range(size)
+        ]
+        flat = np.concatenate(shards)
+        assert len(flat) == len(set(flat.tolist())) == (103 // 8) * 8
+        assert all(len(s) == 103 // 8 for s in shards)
+
+    def test_epoch_reshuffles_deterministically(self, hvd):
+        a0 = data.shard_indices(64, epoch=0, rank=1, size=4)
+        a0b = data.shard_indices(64, epoch=0, rank=1, size=4)
+        a1 = data.shard_indices(64, epoch=1, rank=1, size=4)
+        np.testing.assert_array_equal(a0, a0b)
+        assert not np.array_equal(a0, a1)
+
+    def test_no_shuffle_is_strided(self, hvd):
+        idx = data.shard_indices(8, rank=1, size=4, shuffle=False)
+        np.testing.assert_array_equal(idx, [1, 5])
+
+    def test_tiny_dataset_pads_equally(self, hvd):
+        """n < size: every rank still gets the same shard length (a
+        ragged epoch would deadlock the step's collectives)."""
+        shards = [data.shard_indices(3, rank=r, size=8) for r in range(8)]
+        assert {len(s) for s in shards} == {1}
+        assert set(np.concatenate(shards).tolist()) == {0, 1, 2}
+        sampler = data.DistributedSampler(3, rank=7, size=8)
+        assert len(sampler) == len(list(sampler)) == 1
+
+    def test_bad_rank_rejected(self, hvd):
+        with pytest.raises(ValueError, match="out of range"):
+            data.shard_indices(8, rank=4, size=4)
+
+
+class TestDistributedSampler:
+    def test_torch_sampler_api(self, hvd):
+        s = data.DistributedSampler(10, rank=0, size=4)
+        assert len(s) == 3  # ceil(10/4)
+        first = list(s)
+        s.set_epoch(1)
+        assert first != list(s)
+        assert len(first) == 3
+
+    def test_defaults_to_process_topology(self, hvd):
+        s = data.DistributedSampler(16)
+        # Single-process job: the sampler covers everything.
+        assert sorted(list(s)) == list(range(16))
+
+
+class TestIterateSharded:
+    def test_batches_cover_shard(self, hvd):
+        arrays = {"x": np.arange(32).reshape(32, 1), "y": np.arange(32)}
+        batches = list(data.iterate_sharded(
+            arrays, batch_size=3, rank=0, size=2, shuffle=False))
+        assert len(batches) == 5  # floor(16/3)
+        for b in batches:
+            np.testing.assert_array_equal(b["x"].ravel(), b["y"])
+
+    def test_length_mismatch_rejected(self, hvd):
+        with pytest.raises(ValueError, match="lengths differ"):
+            next(data.iterate_sharded(
+                {"x": np.zeros(4), "y": np.zeros(5)}, batch_size=2))
+
+
+class TestPrefetch:
+    def test_yields_everything_in_order(self, hvd):
+        items = [{"x": np.full((2,), i)} for i in range(7)]
+        out = list(data.prefetch_to_device(items, size=3))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b["x"]), [i, i])
+
+    def test_sharded_prefetch_lands_on_mesh(self, hvd):
+        mesh = hvd.mesh()
+        sharding = NamedSharding(mesh, P("hvd"))
+        items = [{"x": np.arange(16.0)} for _ in range(3)]
+        out = list(data.prefetch_to_device(items, sharding=sharding))
+        assert len(out) == 3
+        leaf = out[0]["x"]
+        assert {s.data.shape for s in leaf.addressable_shards} == {(2,)}
+
+    def test_bad_size_rejected(self, hvd):
+        with pytest.raises(ValueError, match=">= 1"):
+            next(data.prefetch_to_device([], size=0))
