@@ -51,14 +51,9 @@ double BayesianOptimization::ExpectedImprovement(
   return imp * NormalCdf(u) + sigma * NormalPdf(u);
 }
 
-std::vector<double> BayesianOptimization::Suggest() {
-  std::uniform_real_distribution<double> unit(0.0, 1.0);
-  size_t d = bounds_.size();
-  if (x_.size() < 3) {
-    std::vector<double> z(d);
-    for (auto& v : z) v = unit(rng_);
-    return Denormalize(z);
-  }
+bool BayesianOptimization::FitStandardized(GaussianProcess* gp,
+                                           double* best) const {
+  if (y_.empty()) return false;
   // Normalize targets so the unit-variance GP prior fits.
   double mean = 0.0;
   for (double y : y_) mean += y;
@@ -69,14 +64,44 @@ std::vector<double> BayesianOptimization::Suggest() {
   if (sd < 1e-12) sd = 1.0;
   std::vector<double> ynorm(y_.size());
   for (size_t i = 0; i < y_.size(); ++i) ynorm[i] = (y_[i] - mean) / sd;
+  if (!gp->FitWithHyperparameters(x_, ynorm)) return false;
+  *best = *std::max_element(ynorm.begin(), ynorm.end());
+  return true;
+}
 
+int BayesianOptimization::SuggestAmong(
+    const std::vector<std::vector<double>>& candidates) {
+  if (candidates.empty() || x_.size() < 2) return -1;
   GaussianProcess gp;
-  if (!gp.FitWithHyperparameters(x_, ynorm)) {
+  double best;
+  if (!FitStandardized(&gp, &best)) return -1;
+  int best_idx = -1;
+  double best_ei = -1.0;
+  for (size_t i = 0; i < candidates.size(); ++i) {
+    double ei = ExpectedImprovement(Normalize(candidates[i]), gp, best);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_idx = static_cast<int>(i);
+    }
+  }
+  return best_idx;
+}
+
+std::vector<double> BayesianOptimization::Suggest() {
+  std::uniform_real_distribution<double> unit(0.0, 1.0);
+  size_t d = bounds_.size();
+  if (x_.size() < 3) {
     std::vector<double> z(d);
     for (auto& v : z) v = unit(rng_);
     return Denormalize(z);
   }
-  double best = *std::max_element(ynorm.begin(), ynorm.end());
+  GaussianProcess gp;
+  double best;
+  if (!FitStandardized(&gp, &best)) {
+    std::vector<double> z(d);
+    for (auto& v : z) v = unit(rng_);
+    return Denormalize(z);
+  }
   std::vector<double> best_z(d);
   double best_ei = -1.0;
   for (int trial = 0; trial < 512; ++trial) {

@@ -25,12 +25,19 @@ class BayesianOptimization {
   void AddSample(const std::vector<double>& x, double y);
   // Next point to probe (denormalized). Random until >= 3 samples.
   std::vector<double> Suggest();
+  // Index of the DISCRETE candidate (denormalized coords) maximizing
+  // expected improvement, or -1 when the surrogate cannot be fit
+  // (< 2 samples / non-PD kernel). Serves sweeps over fixed candidate
+  // sets (the jax-lane fusion-threshold tuner via hvdtpu_ei_next).
+  int SuggestAmong(const std::vector<std::vector<double>>& candidates);
   size_t num_samples() const { return x_.size(); }
   void Clear();
 
  private:
   std::vector<double> Normalize(const std::vector<double>& x) const;
   std::vector<double> Denormalize(const std::vector<double>& z) const;
+  // Standardize targets and fit the GP; best <- max standardized y.
+  bool FitStandardized(GaussianProcess* gp, double* best) const;
   double ExpectedImprovement(const std::vector<double>& z,
                              const GaussianProcess& gp, double best) const;
 

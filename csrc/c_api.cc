@@ -6,6 +6,7 @@
 // exposed one pybind/ctypes symbol per (framework x dtype x op); this
 // rebuild passes a wire dtype id instead, collapsing the surface to one
 // symbol per op.
+#include <algorithm>
 #include <cmath>
 #include <cstring>
 #include <mutex>
@@ -13,6 +14,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "autotune/bayesian_optimization.h"
 #include "autotune/gaussian_process.h"
 #include "coordinator.h"
 
@@ -162,6 +164,37 @@ void hvdtpu_timeline_end() { GlobalCoordinator()->timeline().Shutdown(); }
 
 void hvdtpu_enable_autotune(const char* log_path) {
   GlobalCoordinator()->EnableAutotune(log_path ? log_path : "");
+}
+
+// EI-guided next-candidate selection over a 1-D discrete sweep. The
+// jax-lane fusion-threshold tuner drives this through ctypes so the
+// SPMD lane's autotuning uses the SAME GP/EI machinery as the native
+// coordinator (reference bayesian_optimization.h:31-44 acquisition).
+// xs/ys: n observed (position, score) pairs; cands: n_cands positions
+// to rank. Returns the index of the candidate maximizing expected
+// improvement, or -1 on degenerate input / non-PD kernel.
+int hvdtpu_ei_next(const double* xs, const double* ys, int n,
+                   const double* cands, int n_cands, double xi) {
+  if (xs == nullptr || ys == nullptr || cands == nullptr || n < 2 ||
+      n_cands < 1) {
+    return -1;
+  }
+  double lo = xs[0], hi = xs[0];
+  for (int i = 0; i < n; ++i) {
+    lo = std::min(lo, xs[i]);
+    hi = std::max(hi, xs[i]);
+  }
+  for (int i = 0; i < n_cands; ++i) {
+    lo = std::min(lo, cands[i]);
+    hi = std::max(hi, cands[i]);
+  }
+  if (!(hi - lo > 0)) return -1;
+  hvdtpu::BayesianOptimization bo({{lo, hi}}, xi);
+  for (int i = 0; i < n; ++i) bo.AddSample({xs[i]}, ys[i]);
+  std::vector<std::vector<double>> candidates;
+  candidates.reserve(n_cands);
+  for (int i = 0; i < n_cands; ++i) candidates.push_back({cands[i]});
+  return bo.SuggestAmong(candidates);
 }
 
 // Self-test for the GP hyperparameter fit (reference gaussian_process.h:

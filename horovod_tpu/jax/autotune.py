@@ -15,10 +15,15 @@ scores the current threshold in steps/sec, advances to the next candidate,
 and bumps ``generation`` — which makes every dispatch handle re-jit so the
 new threshold re-traces into a new bucket plan. Per candidate the first
 window is discarded as warmup (it pays the recompile), mirroring the
-reference's warmup-discard (parameter_manager.h:38-43). After one sweep the
-best threshold wins, ``converged`` flips, and the hot path never blocks
-again. Scores append to HOROVOD_AUTOTUNE_LOG in the same TSV layout as the
-native tuner (csrc/autotune/parameter_manager.cc).
+reference's warmup-discard (parameter_manager.h:38-43). Candidate order
+comes from the native GP + expected-improvement machinery when available
+(``hvdtpu_ei_next`` — the same csrc/autotune/ code that tunes the eager
+lane, reference bayesian_optimization.h:31-44), else a sequential sweep;
+scores are synced from process 0 so every process probes and converges
+identically. When probing ends the best threshold wins, ``converged``
+flips, and the hot path never blocks again. Scores append to
+HOROVOD_AUTOTUNE_LOG in the same TSV layout as the native tuner
+(csrc/autotune/parameter_manager.cc).
 """
 
 from __future__ import annotations
@@ -34,7 +39,19 @@ DEFAULT_CANDIDATES = [0] + [1 << s for s in range(20, 28)]  # 1 MB .. 128 MB
 
 
 class StepAutotuner:
-    """Sweeps ``config.fusion_threshold`` against measured step rate."""
+    """Tunes ``config.fusion_threshold`` against measured step rate.
+
+    ``strategy``: ``"sweep"`` probes every candidate in order; ``"ei"``
+    probes 3 seeds (current default, largest, middle) and then lets the
+    native GP + expected-improvement machinery (csrc/autotune/, the same
+    code that tunes the eager lane) pick each next probe, stopping at
+    ``max_probes`` — roughly half the windows of a full sweep on the
+    default 9-candidate space. ``"auto"`` (default) uses EI when the
+    native library is available and the candidate space is big enough to
+    be worth a surrogate, else sweeps. Multi-host, process 0 alone picks
+    candidates and broadcasts each decision, so probe sequences cannot
+    diverge across hosts.
+    """
 
     def __init__(
         self,
@@ -42,21 +59,44 @@ class StepAutotuner:
         log_path: str = "",
         candidates: Optional[Sequence[int]] = None,
         window: int = 10,
+        strategy: str = "auto",
+        max_probes: Optional[int] = None,
     ) -> None:
         self.config = config
         cand = list(candidates if candidates is not None else DEFAULT_CANDIDATES)
-        # Sweep the CURRENT (default) threshold first: if tuning ever
+        # Probe the CURRENT (default) threshold first: if tuning ever
         # stalls (e.g. no handle keeps dispatching), the job is left at
         # the untuned default rather than at an arbitrary candidate.
         self.candidates: List[int] = [config.fusion_threshold] + [
             c for c in cand if c != config.fusion_threshold
         ]
         self.window = max(1, int(window))
+        self.strategy = strategy
+        self.max_probes = max_probes or (
+            3 + (len(self.candidates) - 3 + 1) // 2
+        )
         self.generation = 1
         self.converged = False
         self.best_threshold = config.fusion_threshold
         self.best_score = -1.0
-        self._idx = 0
+        self.probed: dict = {}  # threshold -> synced score
+        # Resolve the strategy NOW (setup time, where a cold native build
+        # is acceptable) rather than mid-training. Only process 0's
+        # strategy matters: it alone picks candidates; everyone else
+        # follows its broadcast decisions, so per-host differences in
+        # native availability cannot diverge the probe sequence.
+        if strategy == "auto":
+            if len(self.candidates) >= 5:
+                try:
+                    from horovod_tpu import native
+
+                    native.load_library()
+                    strategy = "ei"
+                except Exception:
+                    strategy = "sweep"
+            else:
+                strategy = "sweep"
+        self._strategy_resolved = strategy
         self._warming = True
         self._steps_in_window = 0
         self._t0: Optional[float] = None
@@ -112,54 +152,109 @@ class StepAutotuner:
             self._t0 = now
             return
         score = self.window / (now - self._t0)  # steps/sec
+        # Multi-host: every process adopts process 0's measurement, so
+        # probed/best — and therefore every EI decision and the final
+        # winner — are identical everywhere. Divergent bucket plans
+        # would lower different collective sequences into the "same"
+        # SPMD program (reference SyncParams rationale,
+        # parameter_manager.h:95-96,232).
+        score = self._sync_value(score)
+        self.probed[self.config.fusion_threshold] = score
         self._log_line("sample", self.config.fusion_threshold, score)
         if score > self.best_score:
             self.best_score = score
             self.best_threshold = self.config.fusion_threshold
-        self._idx += 1
-        if self._idx >= len(self.candidates):
-            self._sync_winner()
+        nxt = self._decide_next()
+        if nxt is None:
             self.config.fusion_threshold = self.best_threshold
             self.converged = True
             self.generation += 1
-            # Only process 0 has a log (basics gates log_path), and
-            # process 0 is the sync root, so its winner — and therefore
-            # this score — is always its own measurement.
             self._log_line("converged", self.best_threshold, self.best_score)
             if self._log is not None:
                 self._log.close()
                 self._log = None
         else:
-            self.config.fusion_threshold = self.candidates[self._idx]
+            self.config.fusion_threshold = nxt
             self.generation += 1
             self._warming = True
             self._t0 = now
 
-    def _sync_winner(self) -> bool:
-        """Multi-host: adopt process 0's winner so every process re-traces
-        the SAME bucket plan. Local timing noise can rank candidates
-        differently per host; divergent plans would lower different
-        collective sequences into the "same" SPMD program. The reference
-        broadcast tuned params from rank 0 for the same reason
-        (horovod/common/parameter_manager.h:95-96,232). Returns True when
-        the local winner was overridden."""
+    # -- candidate selection ------------------------------------------------
+
+    @staticmethod
+    def _xform(threshold: int) -> float:
+        """Thresholds live on a log scale (0, 1 MB .. 128 MB); the GP
+        surrogate sees log2(1 + MB) so candidates are evenly spaced."""
+        import math
+
+        return math.log2(1.0 + threshold / float(1 << 20))
+
+    def _decide_next(self) -> Optional[int]:
+        """Process 0 picks the next probe; everyone adopts its choice.
+        One broadcast decision per window makes divergence structurally
+        impossible — no local EI result, native-build failure, or FP
+        difference can fork the probe sequence across hosts."""
         from horovod_tpu.common.state import global_state
 
         st = global_state()
         if st.process_count <= 1:
-            return False
+            return self._next_candidate()
         import jax.numpy as jnp
 
         from horovod_tpu.jax import eager
 
-        won = int(
+        local = -1
+        if st.process_index == 0:
+            nxt = self._next_candidate()
+            local = -1 if nxt is None else int(nxt)
+        got = int(
+            eager.process_broadcast(jnp.asarray([local], jnp.int32), 0)[0]
+        )
+        return None if got < 0 else got
+
+    def _next_candidate(self) -> Optional[int]:
+        unprobed = [c for c in self.candidates if c not in self.probed]
+        if not unprobed:
+            return None
+        if self._strategy_resolved == "sweep":
+            return unprobed[0]
+        if len(self.probed) >= self.max_probes:
+            return None
+        # Seeds: default (already probed first), largest, middle.
+        for seed in (self.candidates[-1],
+                     self.candidates[len(self.candidates) // 2]):
+            if seed not in self.probed:
+                return seed
+        try:
+            from horovod_tpu import native
+
+            i = native.ei_next(
+                [self._xform(t) for t in self.probed],
+                list(self.probed.values()),
+                [self._xform(c) for c in unprobed],
+            )
+            if i >= 0:
+                return unprobed[i]
+        except Exception:
+            pass
+        return unprobed[0]
+
+    def _sync_value(self, value: float) -> float:
+        """Adopt process 0's measurement (identity on one process)."""
+        from horovod_tpu.common.state import global_state
+
+        st = global_state()
+        if st.process_count <= 1:
+            return value
+        import jax.numpy as jnp
+
+        from horovod_tpu.jax import eager
+
+        return float(
             eager.process_broadcast(
-                jnp.asarray([self.best_threshold], jnp.int32), 0
+                jnp.asarray([value], jnp.float32), 0
             )[0]
         )
-        overridden = won != self.best_threshold
-        self.best_threshold = won
-        return overridden
 
     def close(self) -> None:
         if self._log is not None:

@@ -161,7 +161,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvdtpu_enable_autotune.argtypes = [c.c_char_p]
     lib.hvdtpu_enable_autotune.restype = None
     lib.hvdtpu_gp_selftest.restype = c.c_int
+    dp = c.POINTER(c.c_double)
+    lib.hvdtpu_ei_next.argtypes = [dp, dp, c.c_int, dp, c.c_int, c.c_double]
+    lib.hvdtpu_ei_next.restype = c.c_int
     return lib
+
+
+def ei_next(xs, ys, candidates, xi: float = 0.01) -> int:
+    """Index of the candidate maximizing expected improvement given the
+    (position, score) observations — the native GP/EI machinery
+    (csrc/autotune/) serving any Python-side sweep. Returns -1 when the
+    GP cannot be fit (caller falls back to sequential order)."""
+    import ctypes as c
+
+    lib = load_library()
+    n, m = len(xs), len(candidates)
+    ax = (c.c_double * n)(*[float(v) for v in xs])
+    ay = (c.c_double * n)(*[float(v) for v in ys])
+    ac = (c.c_double * m)(*[float(v) for v in candidates])
+    return int(lib.hvdtpu_ei_next(ax, ay, n, ac, m, float(xi)))
 
 
 def load_library() -> ctypes.CDLL:

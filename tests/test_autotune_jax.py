@@ -109,6 +109,58 @@ def test_winner_applied_to_dispatch_after_convergence(hvd):
         st.config.fusion_threshold = saved_threshold
 
 
+def test_native_ei_next_suggests_near_peak(hvd):
+    """The ctypes bridge to the native GP/EI picks the candidate nearest
+    the observed peak of a smooth score curve."""
+    from horovod_tpu import native
+
+    xs = [0.0, 9.0, 4.0]
+    ys = [1.0, 2.0, 8.0]
+    cands = [1.0, 3.0, 5.0, 7.0]
+    i = native.ei_next(xs, ys, cands)
+    assert cands[i] in (3.0, 5.0)
+
+
+def test_ei_strategy_converges_near_optimum_with_fewer_probes(hvd, monkeypatch):
+    """EI mode probes <= max_probes of the 9-candidate space (vs 9 for a
+    sweep) and still lands on (or next to) the optimum of a smooth
+    deterministic score curve."""
+    import math
+
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax import autotune as at
+
+    st = global_state()
+    saved_threshold = st.config.fusion_threshold
+    fake_now = [0.0]
+    monkeypatch.setattr(at.time, "perf_counter", lambda: fake_now[0])
+
+    def duration(threshold):
+        # Smooth valley with minimum (fastest window) at 8 MB.
+        x = math.log2(1.0 + threshold / float(1 << 20))
+        return 1.0 + (x - math.log2(9.0)) ** 2
+
+    tuner = at.StepAutotuner(st.config, window=1, strategy="ei")
+    st.config.fusion_threshold = tuner.candidates[0]
+    try:
+        assert len(tuner.candidates) == 9
+        for _ in range(100):
+            if tuner.converged:
+                break
+            if tuner.step_done():
+                fake_now[0] += duration(st.config.fusion_threshold)
+                tuner.end_window()
+        assert tuner.converged
+        assert len(tuner.probed) <= tuner.max_probes < len(tuner.candidates)
+        # Optimum is 8 MB; accept an immediate log-scale neighbor.
+        assert tuner.best_threshold in (4 << 20, 8 << 20, 16 << 20), (
+            tuner.best_threshold, tuner.probed)
+        assert st.config.fusion_threshold == tuner.best_threshold
+    finally:
+        st.autotuner = None
+        st.config.fusion_threshold = saved_threshold
+
+
 def test_owner_handoff_when_first_handle_goes_idle(hvd):
     """Regression: a warmup/eval handle that dispatches first must not pin
     the tuner forever — after 3 windows of owner inactivity, ownership
