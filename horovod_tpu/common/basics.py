@@ -58,10 +58,7 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
             # the TPU pod runtime); a connect failure must propagate —
             # swallowing it would leave this rank world-size 1 while its
             # peers block on the barrier, with zero diagnostics.
-            already_up = (
-                getattr(jax.distributed.global_state, "client", None)
-                is not None
-            )
+            already_up = jax.distributed.is_initialized()
             if not already_up:
                 jax.distributed.initialize(
                     coordinator_address=jax_coord,

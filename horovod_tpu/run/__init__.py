@@ -46,7 +46,8 @@ def _free_port() -> int:
 
 def _worker_env(base: Dict[str, str], rank: int, size: int, local_rank: int,
                 local_size: int, controller: str, driver: str,
-                secret_hex: str) -> Dict[str, str]:
+                secret_hex: str,
+                jax_coordinator: str = "") -> Dict[str, str]:
     env = dict(base)
     env.update({
         "HOROVOD_RANK": str(rank),
@@ -57,6 +58,11 @@ def _worker_env(base: Dict[str, str], rank: int, size: int, local_rank: int,
         "HOROVOD_DRIVER": driver,
         "HOROVOD_SECRET": secret_hex,
     })
+    if jax_coordinator:
+        # hvd.init() joins the jax distributed runtime at this address
+        # before its first backend query, so every process sees the
+        # GLOBAL device set (horovod_tpu/common/basics.py).
+        env["HOROVOD_JAX_COORDINATOR"] = jax_coordinator
     return env
 
 
@@ -175,11 +181,17 @@ def _kill_all(procs: List[subprocess.Popen]) -> None:
 
 def launch_command(cmd: Sequence[str], np: int,
                    hosts: Optional[str] = None,
-                   env: Optional[Dict[str, str]] = None) -> int:
+                   env: Optional[Dict[str, str]] = None,
+                   jax_distributed: bool = False) -> int:
     """Run ``cmd`` as an N-rank job; returns the job's exit code.
 
     Fails fast: the first non-zero rank kills the rest (the reference
     relied on mpirun for exactly this).
+
+    ``jax_distributed``: also stand up a jax coordination service address
+    (HOROVOD_JAX_COORDINATOR) so each worker's ``hvd.init()`` joins one
+    global jax device mesh — the SPMD lane spanning all workers' chips,
+    the way mpirun+NCCL spanned all GPUs in the reference.
     """
     base_env = dict(env if env is not None else os.environ)
     secret_hex = make_secret_key().hex()
@@ -206,12 +218,19 @@ def launch_command(cmd: Sequence[str], np: int,
         controller_host = first_host
         controller_port = random.randint(20000, 59999)
     controller = f"{controller_host}:{controller_port}"
+    jax_coordinator = ""
+    if jax_distributed:
+        jax_port = controller_port
+        while jax_port == controller_port:  # two services, two ports
+            jax_port = (_free_port() if controller_host == "127.0.0.1"
+                        else random.randint(20000, 59999))
+        jax_coordinator = f"{controller_host}:{jax_port}"
 
     procs: List[subprocess.Popen] = []
     try:
         for rank, (host, local_rank, local_size) in enumerate(placements):
             wenv = _worker_env(base_env, rank, np, local_rank, local_size,
-                               controller, "", secret_hex)
+                               controller, "", secret_hex, jax_coordinator)
             if host is None or host in ("localhost", "127.0.0.1"):
                 procs.append(_spawn_local(cmd, wenv))
             else:

@@ -22,13 +22,18 @@ def main(argv=None) -> int:
                         help="total number of ranks")
     parser.add_argument("-H", "--hosts", default=None,
                         help="host1:slots,host2:slots (default: all local)")
+    parser.add_argument("--jax", action="store_true", dest="jax_distributed",
+                        help="join workers into ONE global jax device mesh "
+                             "(sets HOROVOD_JAX_COORDINATOR; each worker's "
+                             "hvd.init() then spans all workers' chips)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
     cmd = args.command[1:] if args.command[0] == "--" else args.command
-    return launch_command(cmd, np=args.num_proc, hosts=args.hosts)
+    return launch_command(cmd, np=args.num_proc, hosts=args.hosts,
+                          jax_distributed=args.jax_distributed)
 
 
 if __name__ == "__main__":
