@@ -42,6 +42,8 @@ def main():
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--fp32", action="store_true", help="disable bfloat16 compute")
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1 optimizer-state sharding over the mesh")
     args = parser.parse_args()
 
     import jax
@@ -60,8 +62,10 @@ def main():
     model = models.build(args.model, num_classes=1000, dtype=dtype)
     rng = jax.random.PRNGKey(42)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
-    state, optimizer = models.create_train_state(rng, model, optax.sgd(0.01, momentum=0.9), sample)
+    state, optimizer = models.create_train_state(
+        rng, model, optax.sgd(0.01, momentum=0.9), sample, zero=args.zero)
     step_fn = models.make_train_step(model, optimizer, average_loss=False)
+    state_spec = models.state_partition_specs(state) if args.zero else P()
 
     global_batch = args.batch_size * n
     batch = {
@@ -74,8 +78,8 @@ def main():
     # instead of reallocating ~100 MB every step.
     run_step = hvd.spmd_fn(
         step_fn,
-        in_specs=(P(), P("hvd")),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, P("hvd")),
+        out_specs=(state_spec, P()),
         donate_argnums=(0,),
     )
 

@@ -26,6 +26,7 @@ from horovod_tpu.models.train import (
 )
 from horovod_tpu.models.transformer import TransformerBlock, TransformerLM
 from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
+from horovod_tpu.models.vit import ViT_B16, ViT_S16, VisionTransformer
 
 _FAMILY = dict(_RESNET_FAMILY)
 _FAMILY.update({
@@ -36,6 +37,8 @@ _FAMILY.update({
     "inception_v3": InceptionV3,
     "inception3": InceptionV3,
     "transformer_lm": TransformerLM,
+    "vit_s16": ViT_S16,
+    "vit_b16": ViT_B16,
 })
 
 
@@ -66,6 +69,9 @@ __all__ = [
     "InceptionV3",
     "TransformerBlock",
     "TransformerLM",
+    "VisionTransformer",
+    "ViT_S16",
+    "ViT_B16",
     "build",
     "TrainState",
     "create_train_state",

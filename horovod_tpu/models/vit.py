@@ -1,0 +1,75 @@
+"""Vision Transformer — beyond-reference model family.
+
+The reference's benchmark set is all-convolutional (Inception/ResNet/VGG,
+reference docs/benchmarks.md:5-6); ViT is the modern image classifier a
+user switching frameworks expects to find, and on TPU it is the
+best-case model: the whole forward is large batched matmuls on the MXU.
+Reuses :class:`~horovod_tpu.models.transformer.TransformerBlock` with
+non-causal dense attention (the block's pluggable ``attn_fn``), so the
+parallelism stories (TP over heads, SP over patches via ring/Ulysses)
+apply unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import TransformerBlock
+from horovod_tpu.ops.attention import dot_product_attention
+
+
+class VisionTransformer(nn.Module):
+    """ViT encoder: patchify -> [CLS] + learned pos -> pre-norm blocks ->
+    fp32 head. bf16 compute / fp32 norms+head, static shapes."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    embed_dim: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B = x.shape[0]
+        if x.shape[1] % self.patch_size or x.shape[2] % self.patch_size:
+            raise ValueError(
+                f"image size {x.shape[1]}x{x.shape[2]} not divisible by "
+                f"patch size {self.patch_size}"
+            )
+        x = jnp.asarray(x, self.dtype)
+        # Patch embedding: one strided conv = per-patch linear projection
+        # (VALID: partial zero-padded patches are not canonical ViT).
+        x = nn.Conv(self.embed_dim, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID", dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(B, -1, self.embed_dim)  # [B, L, E]
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.embed_dim), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, self.embed_dim)).astype(self.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.embed_dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        bidirectional = partial(dot_product_attention, causal=False)
+        for _ in range(self.depth):
+            x = TransformerBlock(self.num_heads, dtype=self.dtype,
+                                 attn_fn=bidirectional,
+                                 dropout=self.dropout)(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+ViT_S16 = partial(VisionTransformer, patch_size=16, embed_dim=384,
+                  depth=12, num_heads=6)
+ViT_B16 = partial(VisionTransformer, patch_size=16, embed_dim=768,
+                  depth=12, num_heads=12)

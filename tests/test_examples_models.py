@@ -52,6 +52,7 @@ class TestModelZoo:
     @pytest.mark.parametrize("name,shape", [
         ("vgg11", (2, 32, 32, 3)),
         ("inception_v3", (1, 128, 128, 3)),
+        ("vit_s16", (2, 32, 32, 3)),
     ])
     def test_forward_shapes(self, name, shape):
         from horovod_tpu import models
@@ -60,6 +61,35 @@ class TestModelZoo:
         v = m.init(jax.random.PRNGKey(0), jnp.zeros(shape), train=False)
         out = m.apply(v, jnp.zeros(shape), train=False)
         assert out.shape == (shape[0], 7)
+
+    def test_vit_spmd_train_step(self, hvd):
+        """ViT trains under the full SPMD DP path (it has no batch_stats
+        — the train-state plumbing must tolerate that)."""
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import models
+
+        n = hvd.size()
+        model = models.VisionTransformer(
+            num_classes=5, patch_size=8, embed_dim=32, depth=2,
+            num_heads=2, dtype=jnp.float32, dropout=0.1)
+        rng = jax.random.PRNGKey(0)
+        sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        state, optimizer = models.create_train_state(
+            rng, model, optax.adamw(1e-3), sample)
+        step = models.make_train_step(model, optimizer)
+        batch = {
+            "image": jax.random.normal(rng, (2 * n, 32, 32, 3)),
+            "label": jax.random.randint(rng, (2 * n,), 0, 5),
+        }
+        fn = hvd.spmd_fn(step, in_specs=(P(), P("hvd")),
+                         out_specs=(P(), P()))
+        l0 = None
+        for _ in range(4):
+            state, metrics = fn(state, batch)
+            l0 = float(metrics["loss"]) if l0 is None else l0
+        assert float(metrics["loss"]) < l0
 
     def test_build_unknown(self):
         from horovod_tpu import models

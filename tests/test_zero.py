@@ -215,6 +215,38 @@ class TestZeroSharding:
             p_comp,
         )
 
+    def test_global_norm_clip_composes_outside(self, hvd):
+        """The documented recipe for non-elementwise transforms: compose
+        them OUTSIDE the zero wrapper (they see full gradients there).
+        chain(clip_by_global_norm, zero(sgd)) must equal the flat path."""
+        n = hvd.size()
+        params = _params()
+        grads = _per_rank_grads(n)
+
+        flat_opt = DistributedOptimizer(
+            optax.chain(optax.clip_by_global_norm(0.05), optax.sgd(0.1)))
+        p_flat, _ = _run_steps(flat_opt, P(), params, grads, n_steps=2)
+
+        # Average + clip on the FULL gradient, then shard the update.
+        # The inner reduce-scatter averages ALREADY-IDENTICAL grads
+        # (its default average=True makes it an identity reduction here).
+        z_inner = hvd.sharded_distributed_optimizer(optax.sgd(0.1))
+        z_opt = optax.chain(
+            hvd.allreduce_gradients_transform(),
+            optax.clip_by_global_norm(0.05),
+            z_inner,
+        )
+        z_specs = zero.state_partition_specs(z_opt.init(params))
+        p_zero, _ = _run_steps(z_opt, z_specs, params, grads, n_steps=2)
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-7
+            ),
+            p_flat,
+            p_zero,
+        )
+
     def test_dtype_mismatch_rejected(self, hvd):
         params = _params()
         z = hvd.sharded_distributed_optimizer(optax.sgd(0.1))
