@@ -51,5 +51,6 @@ Status HandshakeConnect(int fd, const std::string& key, uint8_t purpose,
 
 constexpr uint8_t kAuthPurposeControl = 1;  // worker -> rank-0 control star
 constexpr uint8_t kAuthPurposeRing = 2;     // data-ring neighbor link
+constexpr uint8_t kAuthPurposeHier = 3;     // local/cross hierarchy links
 
 }  // namespace hvdtpu

@@ -81,6 +81,11 @@ int hvdtpu_rank() { return GlobalCoordinator()->rank(); }
 int hvdtpu_size() { return GlobalCoordinator()->size(); }
 int hvdtpu_local_rank() { return GlobalCoordinator()->local_rank(); }
 int hvdtpu_local_size() { return GlobalCoordinator()->local_size(); }
+// Bitmask of ACTIVE hierarchical paths (1 = allreduce, 2 = allgather):
+// knob set and the two-level rings actually wired.
+int hvdtpu_hierarchical_active() {
+  return GlobalCoordinator()->hierarchical_active();
+}
 
 int hvdtpu_enqueue_allreduce(const char* name, void* data, int dtype,
                              int ndims, const int64_t* dims) {

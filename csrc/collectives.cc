@@ -27,42 +27,100 @@ void SegmentBounds(int64_t count, int size, std::vector<int64_t>* starts,
 
 }  // namespace
 
-Status RingAllreduce(Transport* t, void* data, int64_t count, DataType dt) {
-  int size = t->size();
-  int rank = t->rank();
-  if (size == 1 || count == 0) return Status::OK();
-  size_t esz = DataTypeSize(dt);
-  uint8_t* bytes = static_cast<uint8_t*>(data);
+namespace {
 
-  std::vector<int64_t> starts, lens;
-  SegmentBounds(count, size, &starts, &lens);
+// The reduce-scatter half of the ring allreduce on `scope`: after n-1
+// full-duplex steps, segment (pos + 1) % n of `data` holds the sum over
+// every ring member on this rank.
+Status ReduceScatterPhase(Transport* t, RingScope scope, uint8_t* bytes,
+                          const std::vector<int64_t>& starts,
+                          const std::vector<int64_t>& lens, size_t esz,
+                          DataType dt) {
+  int n = t->ring_n(scope);
+  int pos = t->ring_pos(scope);
   int64_t max_len = 0;
   for (auto l : lens) max_len = l > max_len ? l : max_len;
   std::vector<uint8_t> recv_buf(static_cast<size_t>(max_len) * esz);
-
-  // Phase 1 — reduce-scatter: after step k, segment (rank - k) holds the
-  // partial sum of k+1 ranks; after size-1 steps, segment (rank + 1) % size
-  // holds the full sum on this rank.
-  for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (rank - step + size) % size;
-    int recv_seg = (rank - step - 1 + size) % size;
-    Status s = t->SendRecv(bytes + starts[send_seg] * esz,
-                           static_cast<size_t>(lens[send_seg]) * esz,
-                           recv_buf.data(),
-                           static_cast<size_t>(lens[recv_seg]) * esz);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_seg = (pos - step + n) % n;
+    int recv_seg = (pos - step - 1 + n) % n;
+    Status s = t->RingSendRecv(scope, bytes + starts[send_seg] * esz,
+                               static_cast<size_t>(lens[send_seg]) * esz,
+                               recv_buf.data(),
+                               static_cast<size_t>(lens[recv_seg]) * esz);
     if (!s.ok()) return s;
     ReduceSum(bytes + starts[recv_seg] * esz, recv_buf.data(), lens[recv_seg],
               dt);
   }
+  return Status::OK();
+}
 
-  // Phase 2 — allgather: circulate the fully-reduced segments.
-  for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (rank + 1 - step + size) % size;
-    int recv_seg = (rank - step + size) % size;
-    Status s = t->SendRecv(bytes + starts[send_seg] * esz,
-                           static_cast<size_t>(lens[send_seg]) * esz,
-                           bytes + starts[recv_seg] * esz,
-                           static_cast<size_t>(lens[recv_seg]) * esz);
+// The allgather half: circulate fully-reduced segments (each rank starts
+// owning segment (pos + 1) % n, the reduce-scatter invariant).
+Status SegmentAllgatherPhase(Transport* t, RingScope scope, uint8_t* bytes,
+                             const std::vector<int64_t>& starts,
+                             const std::vector<int64_t>& lens, size_t esz) {
+  int n = t->ring_n(scope);
+  int pos = t->ring_pos(scope);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_seg = (pos + 1 - step + n) % n;
+    int recv_seg = (pos - step + n) % n;
+    Status s = t->RingSendRecv(scope, bytes + starts[send_seg] * esz,
+                               static_cast<size_t>(lens[send_seg]) * esz,
+                               bytes + starts[recv_seg] * esz,
+                               static_cast<size_t>(lens[recv_seg]) * esz);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status RingAllreduceOn(Transport* t, RingScope scope, void* data,
+                       int64_t count, DataType dt) {
+  int n = t->ring_n(scope);
+  if (n == 1 || count == 0) return Status::OK();
+  size_t esz = DataTypeSize(dt);
+  uint8_t* bytes = static_cast<uint8_t*>(data);
+  std::vector<int64_t> starts, lens;
+  SegmentBounds(count, n, &starts, &lens);
+  Status s = ReduceScatterPhase(t, scope, bytes, starts, lens, esz, dt);
+  if (!s.ok()) return s;
+  return SegmentAllgatherPhase(t, scope, bytes, starts, lens, esz);
+}
+
+Status RingAllreduce(Transport* t, void* data, int64_t count, DataType dt) {
+  return RingAllreduceOn(t, RingScope::kGlobal, data, count, dt);
+}
+
+Status RingAllgathervOn(Transport* t, RingScope scope, const void* in,
+                        const std::vector<int64_t>& counts, size_t elem_size,
+                        void* out) {
+  int n = t->ring_n(scope);
+  int pos = t->ring_pos(scope);
+  std::vector<int64_t> starts(n);
+  int64_t off = 0;
+  for (int s = 0; s < n; ++s) {
+    starts[s] = off;
+    off += counts[s];
+  }
+  uint8_t* obytes = static_cast<uint8_t*>(out);
+  if (obytes + starts[pos] * elem_size != in) {
+    memmove(obytes + starts[pos] * elem_size, in,
+            static_cast<size_t>(counts[pos]) * elem_size);
+  }
+  if (n == 1) return Status::OK();
+  // Circulate: at step k, forward the segment originally owned by
+  // (pos - k), receive the one owned by (pos - k - 1).
+  for (int step = 0; step < n - 1; ++step) {
+    int send_seg = (pos - step + n) % n;
+    int recv_seg = (pos - step - 1 + n) % n;
+    Status s = t->RingSendRecv(scope, obytes + starts[send_seg] * elem_size,
+                               static_cast<size_t>(counts[send_seg]) *
+                                   elem_size,
+                               obytes + starts[recv_seg] * elem_size,
+                               static_cast<size_t>(counts[recv_seg]) *
+                                   elem_size);
     if (!s.ok()) return s;
   }
   return Status::OK();
@@ -71,32 +129,86 @@ Status RingAllreduce(Transport* t, void* data, int64_t count, DataType dt) {
 Status RingAllgatherv(Transport* t, const void* in,
                       const std::vector<int64_t>& counts, size_t elem_size,
                       void* out) {
-  int size = t->size();
-  int rank = t->rank();
-  std::vector<int64_t> starts(size);
-  int64_t off = 0;
-  for (int s = 0; s < size; ++s) {
-    starts[s] = off;
-    off += counts[s];
-  }
+  return RingAllgathervOn(t, RingScope::kGlobal, in, counts, elem_size, out);
+}
+
+Status HierarchicalAllreduce(Transport* t, void* data, int64_t count,
+                             DataType dt) {
+  if (!t->hierarchy_ready())
+    return RingAllreduceOn(t, RingScope::kGlobal, data, count, dt);
+  if (count == 0) return Status::OK();
+  int inner = t->ring_n(RingScope::kLocal);
+  int lp = t->ring_pos(RingScope::kLocal);
+  size_t esz = DataTypeSize(dt);
+  uint8_t* bytes = static_cast<uint8_t*>(data);
+
+  // Stripe by local position — every group stripes identically, so stripe
+  // `i` of the local sums lines up across groups for the cross phase.
+  std::vector<int64_t> starts, lens;
+  SegmentBounds(count, inner, &starts, &lens);
+
+  // 1. Local reduce-scatter: this rank ends owning the group-wide sum of
+  //    stripe (lp + 1) % inner.
+  Status s = ReduceScatterPhase(t, RingScope::kLocal, bytes, starts, lens,
+                                esz, dt);
+  if (!s.ok()) return s;
+
+  // 2. Cross-ring allreduce of the owned stripe — the only inter-group
+  //    traffic, run in parallel by every local position (the analogue of
+  //    the reference's per-local-rank parallel MPI_Allreduce,
+  //    operations.cc:1380-1412).
+  int own = (lp + 1) % inner;
+  s = RingAllreduceOn(t, RingScope::kCross, bytes + starts[own] * esz,
+                      lens[own], dt);
+  if (!s.ok()) return s;
+
+  // 3. Local allgather of the now globally-reduced stripes.
+  return SegmentAllgatherPhase(t, RingScope::kLocal, bytes, starts, lens,
+                               esz);
+}
+
+Status HierarchicalAllgatherv(Transport* t, const void* in,
+                              const std::vector<int64_t>& counts,
+                              size_t elem_size, void* out) {
+  // Two-level needs one count per global rank to carve group blocks;
+  // anything else (notably the size-1 single-count path) rides the flat
+  // ring, which only indexes counts by its own ring length.
+  if (!t->hierarchy_ready() ||
+      static_cast<int>(counts.size()) != t->size())
+    return RingAllgathervOn(t, RingScope::kGlobal, in, counts, elem_size,
+                            out);
+  int inner = t->ring_n(RingScope::kLocal);
+  int groups = t->ring_n(RingScope::kCross);
+  int g = t->ring_pos(RingScope::kCross);
   uint8_t* obytes = static_cast<uint8_t*>(out);
-  if (obytes + starts[rank] * elem_size != in) {
-    memmove(obytes + starts[rank] * elem_size, in,
-            static_cast<size_t>(counts[rank]) * elem_size);
+
+  std::vector<int64_t> starts(counts.size());
+  int64_t off = 0;
+  for (size_t r = 0; r < counts.size(); ++r) {
+    starts[r] = off;
+    off += counts[r];
   }
-  if (size == 1) return Status::OK();
-  // Circulate: at step k, forward the segment originally owned by
-  // (rank - k), receive the one owned by (rank - k - 1).
-  for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (rank - step + size) % size;
-    int recv_seg = (rank - step - 1 + size) % size;
-    Status s = t->SendRecv(obytes + starts[send_seg] * elem_size,
-                           static_cast<size_t>(counts[send_seg]) * elem_size,
-                           obytes + starts[recv_seg] * elem_size,
-                           static_cast<size_t>(counts[recv_seg]) * elem_size);
-    if (!s.ok()) return s;
+
+  // 1. Local allgatherv assembles this group's contiguous block of the
+  //    rank-ordered output (ranks are grouped contiguously).
+  std::vector<int64_t> local_counts(counts.begin() + g * inner,
+                                    counts.begin() + (g + 1) * inner);
+  uint8_t* group_base = obytes + starts[g * inner] * elem_size;
+  Status s = RingAllgathervOn(t, RingScope::kLocal, in, local_counts,
+                              elem_size, group_base);
+  if (!s.ok()) return s;
+
+  // 2. Cross-ring allgatherv of whole group blocks (this group's block
+  //    already sits at its final offset, so `in` aliases and no memmove
+  //    happens inside).
+  std::vector<int64_t> group_counts(groups);
+  for (int j = 0; j < groups; ++j) {
+    int64_t total = 0;
+    for (int m = 0; m < inner; ++m) total += counts[j * inner + m];
+    group_counts[j] = total;
   }
-  return Status::OK();
+  return RingAllgathervOn(t, RingScope::kCross, group_base, group_counts,
+                          elem_size, obytes);
 }
 
 Status StarBroadcast(Transport* t, void* data, size_t len, int root) {

@@ -25,6 +25,32 @@ Status RingAllgatherv(Transport* t, const void* in,
                       const std::vector<int64_t>& counts, size_t elem_size,
                       void* out);
 
+// Scope-generalized variants: the same algorithms over the local or cross
+// sub-ring (counts[i] indexes ring position, not global rank).
+Status RingAllreduceOn(Transport* t, RingScope scope, void* data,
+                       int64_t count, DataType dt);
+Status RingAllgathervOn(Transport* t, RingScope scope, const void* in,
+                        const std::vector<int64_t>& counts, size_t elem_size,
+                        void* out);
+
+// Two-level allreduce, the TCP analogue of the reference's hierarchical
+// path (NCCL ReduceScatter within node -> cross-node MPI_Allreduce ->
+// NCCL AllGather, reference operations.cc:1284-1436): reduce-scatter on
+// the local ring, allreduce of the owned stripe on the cross ring,
+// allgather on the local ring. Falls back to the flat ring when
+// InitHierarchy has not wired sub-rings.
+Status HierarchicalAllreduce(Transport* t, void* data, int64_t count,
+                             DataType dt);
+
+// Two-level allgatherv (reference operations.cc:929-1032 used an MPI
+// shared-memory window within the node and Allgatherv over cross_comm;
+// here: local-ring allgatherv assembles each group's contiguous block,
+// then the cross ring exchanges whole group blocks). `counts` are global
+// per-rank element counts; output is the rank-ordered concatenation.
+Status HierarchicalAllgatherv(Transport* t, const void* in,
+                              const std::vector<int64_t>& counts,
+                              size_t elem_size, void* out);
+
 // Broadcast `len` bytes from `root` through the rank-0 star (at most two
 // hops: root -> 0 -> workers).
 Status StarBroadcast(Transport* t, void* data, size_t len, int root);

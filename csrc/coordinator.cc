@@ -63,6 +63,21 @@ static double EnvDouble(const char* name, double dflt) {
   return v ? std::atof(v) : dflt;
 }
 
+static int EnvInt(const char* name, int dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::atoi(v) : dflt;
+}
+
+// Truthiness matching the Python config surface (common/config.py
+// _env_bool): unset / "" / "0" / "false" are off.
+static bool EnvBool(const char* name) {
+  const char* v = std::getenv(name);
+  if (v == nullptr) return false;
+  std::string s(v);
+  return !(s.empty() || s == "0" || s == "false" || s == "False" ||
+           s == "FALSE");
+}
+
 Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
                          const std::string& coord_host, int coord_port,
                          int timeout_ms) {
@@ -90,6 +105,40 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
   Status s = transport_.Init(rank_, size_, coord_host, coord_port, timeout_ms);
   if (!s.ok()) return s;
 
+  // Hierarchical collectives (reference HOROVOD_HIERARCHICAL_ALLREDUCE /
+  // ALLGATHER, operations.h:65-66): wire the two-level rings. The group
+  // ("node") size defaults to local_size — ranks are launcher-assigned
+  // host-contiguously — and HOROVOD_HIERARCHICAL_INNER_SIZE overrides it
+  // (same knob semantics as the XLA lane, common/config.py). A topology
+  // the two-level ladder can't tile (inner doesn't divide size, or just
+  // one group) degrades to the flat ring with a warning — the analogue of
+  // the reference's heterogeneous-cluster degrade (operations.cc:1303-1315).
+  hier_allreduce_ = EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  hier_allgather_ = EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER");
+  if ((hier_allreduce_ || hier_allgather_) && size_ > 1) {
+    // Control-star barrier: every rank must finish the flat bootstrap
+    // before anyone dials local/cross links, or a hierarchy dial could
+    // land in a rank still accepting its flat-ring prev.
+    std::vector<uint8_t> token{1};
+    std::vector<std::vector<uint8_t>> all;
+    s = transport_.GatherToRoot(token, &all);
+    if (!s.ok()) return s;
+    s = transport_.BcastFromRoot(&token);
+    if (!s.ok()) return s;
+
+    int inner = EnvInt("HOROVOD_HIERARCHICAL_INNER_SIZE", 0);
+    if (inner <= 0) inner = local_size_;
+    if (inner > 1 && inner < size_ && size_ % inner == 0) {
+      s = transport_.InitHierarchy(inner, timeout_ms);
+      if (!s.ok()) return s;
+    } else {
+      HVD_LOG_RANK(WARNING, rank_)
+          << "hierarchical collectives requested but group size " << inner
+          << " cannot tile " << size_
+          << " ranks into >1 equal groups; using the flat ring";
+    }
+  }
+
   const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
   if (timeline_path != nullptr && rank_ == 0) {
     timeline_.Initialize(timeline_path,
@@ -104,6 +153,35 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
   background_ = std::thread(&Coordinator::BackgroundLoop, this);
   HVD_LOG_RANK(DEBUG, rank_) << "coordinator up, size " << size_;
   return Status::OK();
+}
+
+Status Coordinator::ReduceInPlace(void* data, int64_t count, DataType dt) {
+  return hier_allreduce_
+             ? HierarchicalAllreduce(&transport_, data, count, dt)
+             : RingAllreduce(&transport_, data, count, dt);
+}
+
+Status Coordinator::GatherRagged(const void* in,
+                                 const std::vector<int64_t>& counts,
+                                 size_t elem_size, void* out) {
+  return hier_allgather_
+             ? HierarchicalAllgatherv(&transport_, in, counts, elem_size, out)
+             : RingAllgatherv(&transport_, in, counts, elem_size, out);
+}
+
+const char* Coordinator::AllreduceActivity() const {
+  return hier_allreduce_ && transport_.hierarchy_ready() ? "HIER_ALLREDUCE"
+                                                         : "RING_ALLREDUCE";
+}
+
+const char* Coordinator::AllgatherActivity() const {
+  return hier_allgather_ && transport_.hierarchy_ready() ? "HIER_ALLGATHER"
+                                                         : "RING_ALLGATHER";
+}
+
+int Coordinator::hierarchical_active() const {
+  if (!transport_.hierarchy_ready()) return 0;
+  return (hier_allreduce_ ? 1 : 0) | (hier_allgather_ ? 2 : 0);
 }
 
 void Coordinator::EnableAutotune(const std::string& log_path) {
@@ -525,9 +603,8 @@ void Coordinator::PerformOperation(const Response& response) {
         // Single tensor: reduce in place, no staging copy (reference
         // used MPI_IN_PLACE here, operations.cc:1574-1584).
         TableEntry& e = entries[0];
-        timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
-        s = RingAllreduce(&transport_, e.data, e.shape.num_elements(),
-                          e.dtype);
+        timeline_.ActivityStart(e.name, AllreduceActivity());
+        s = ReduceInPlace(e.data, e.shape.num_elements(), e.dtype);
         timeline_.ActivityEnd(e.name);
       } else {
         // Fused: stage into the fusion buffer, one ring pass, copy back
@@ -546,8 +623,8 @@ void Coordinator::PerformOperation(const Response& response) {
           timeline_.ActivityEnd(e.name);
         }
         for (auto& e : entries)
-          timeline_.ActivityStart(e.name, "RING_ALLREDUCE");
-        s = RingAllreduce(&transport_, fusion_buffer_.data(), total_elems,
+          timeline_.ActivityStart(e.name, AllreduceActivity());
+        s = ReduceInPlace(fusion_buffer_.data(), total_elems,
                           entries[0].dtype);
         for (auto& e : entries) timeline_.ActivityEnd(e.name);
         off = 0;
@@ -588,8 +665,8 @@ void Coordinator::PerformOperation(const Response& response) {
       }
       size_t esz = DataTypeSize(e.dtype);
       std::vector<uint8_t> out(static_cast<size_t>(total) * esz);
-      timeline_.ActivityStart(e.name, "RING_ALLGATHER");
-      Status s = RingAllgatherv(&transport_, e.data, counts, esz, out.data());
+      timeline_.ActivityStart(e.name, AllgatherActivity());
+      Status s = GatherRagged(e.data, counts, esz, out.data());
       timeline_.ActivityEnd(e.name);
       timeline_.End(e.name, static_cast<int64_t>(out.size()));
       if (s.ok()) {

@@ -100,6 +100,10 @@ class Coordinator {
   NativeTimeline& timeline() { return timeline_; }
   void EnableAutotune(const std::string& log_path);
 
+  // Which hierarchical paths are ACTIVE (knob set AND sub-rings wired):
+  // bit 0 = allreduce, bit 1 = allgather. Introspection for tests/tools.
+  int hierarchical_active() const;
+
  private:
   void BackgroundLoop();
   bool RunLoopOnce();   // false -> exit loop
@@ -111,7 +115,18 @@ class Coordinator {
   void PerformOperation(const Response& response);
   void CheckForStalled();
 
+  // Single dispatch point for knob-gated two-level vs flat collectives
+  // (the Hierarchical* algorithms themselves degrade to the flat ring
+  // when sub-rings aren't wired) and the matching timeline labels.
+  Status ReduceInPlace(void* data, int64_t count, DataType dt);
+  Status GatherRagged(const void* in, const std::vector<int64_t>& counts,
+                      size_t elem_size, void* out);
+  const char* AllreduceActivity() const;
+  const char* AllgatherActivity() const;
+
   int rank_ = 0, size_ = 1, local_rank_ = 0, local_size_ = 1;
+  bool hier_allreduce_ = false;   // HOROVOD_HIERARCHICAL_ALLREDUCE
+  bool hier_allgather_ = false;   // HOROVOD_HIERARCHICAL_ALLGATHER
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   Transport transport_;

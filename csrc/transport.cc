@@ -194,6 +194,64 @@ std::string LocalIpToward(const std::string& host, int port) {
   return ip;
 }
 
+// Full duplex via poll: both fds nonblocking until each side completes.
+Status DuplexTransfer(int send_fd, int recv_fd, const void* send_data,
+                      size_t send_len, void* recv_data, size_t recv_len) {
+  const uint8_t* sp = static_cast<const uint8_t*>(send_data);
+  uint8_t* rp = static_cast<uint8_t*>(recv_data);
+  size_t sent = 0, recvd = 0;
+  int sflags = fcntl(send_fd, F_GETFL, 0);
+  int rflags = fcntl(recv_fd, F_GETFL, 0);
+  fcntl(send_fd, F_SETFL, sflags | O_NONBLOCK);
+  fcntl(recv_fd, F_SETFL, rflags | O_NONBLOCK);
+  Status result = Status::OK();
+  while (sent < send_len || recvd < recv_len) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      send_idx = n;
+      pfds[n++] = {send_fd, POLLOUT, 0};
+    }
+    if (recvd < recv_len) {
+      recv_idx = n;
+      pfds[n++] = {recv_fd, POLLIN, 0};
+    }
+    int rc = ::poll(pfds, n, -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      result = Status::Unknown(std::string("poll: ") + strerror(errno));
+      break;
+    }
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t m = ::send(send_fd, sp + sent, send_len - sent, MSG_NOSIGNAL);
+      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        result = Status::Unknown(std::string("send: ") + strerror(errno));
+        break;
+      }
+      if (m > 0) sent += static_cast<size_t>(m);
+    }
+    if (recv_idx >= 0 &&
+        (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t m = ::recv(recv_fd, rp + recvd, recv_len - recvd, 0);
+      if (m == 0) {
+        result = Status::Aborted("peer closed connection");
+        break;
+      }
+      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+          errno != EINTR) {
+        result = Status::Unknown(std::string("recv: ") + strerror(errno));
+        break;
+      }
+      if (m > 0) recvd += static_cast<size_t>(m);
+    }
+  }
+  fcntl(send_fd, F_SETFL, sflags);
+  fcntl(recv_fd, F_SETFL, rflags);
+  return result;
+}
+
 }  // namespace
 
 Transport::~Transport() { Close(); }
@@ -206,6 +264,13 @@ void Transport::Close() {
   CloseFd(&ring_send_fd_);
   CloseFd(&ring_recv_fd_);
   CloseFd(&data_listen_fd_);
+  CloseFd(&local_send_fd_);
+  CloseFd(&local_recv_fd_);
+  CloseFd(&cross_send_fd_);
+  CloseFd(&cross_recv_fd_);
+  hier_ready_ = false;
+  inner_ = groups_ = 1;
+  addrs_.clear();
 }
 
 Status Transport::Init(int rank, int size, const std::string& coord_host,
@@ -277,8 +342,10 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
   }
 
   // 2. Data-ring address exchange: gather "(host:port)" strings, bcast table.
+  // Backlog 4: the flat-ring prev plus (when InitHierarchy follows) the
+  // local- and cross-ring prevs may all be queued before we accept.
   int data_port;
-  Status s = Listen(0, 2, &data_listen_fd_, &data_port);
+  Status s = Listen(0, 4, &data_listen_fd_, &data_port);
   if (!s.ok()) return s;
   std::string my_host =
       rank_ == 0 ? coord_host : LocalIpToward(coord_host, coord_port);
@@ -309,6 +376,7 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
   }
   if (static_cast<int>(addrs.size()) != size_)
     return Status::Unknown("address table size mismatch");
+  addrs_ = addrs;  // kept for InitHierarchy's local/cross dials
 
   // 3. Ring connect: dial next, accept prev. Dial from a thread so the
   //    2-rank case (mutual connect) cannot deadlock.
@@ -387,61 +455,139 @@ Status Transport::RecvFromPrev(void* data, size_t len) {
 
 Status Transport::SendRecv(const void* send_data, size_t send_len,
                            void* recv_data, size_t recv_len) {
-  // Full duplex via poll: both fds nonblocking until each side completes.
-  const uint8_t* sp = static_cast<const uint8_t*>(send_data);
-  uint8_t* rp = static_cast<uint8_t*>(recv_data);
-  size_t sent = 0, recvd = 0;
-  int sflags = fcntl(ring_send_fd_, F_GETFL, 0);
-  int rflags = fcntl(ring_recv_fd_, F_GETFL, 0);
-  fcntl(ring_send_fd_, F_SETFL, sflags | O_NONBLOCK);
-  fcntl(ring_recv_fd_, F_SETFL, rflags | O_NONBLOCK);
-  Status result = Status::OK();
-  while (sent < send_len || recvd < recv_len) {
-    struct pollfd pfds[2];
-    int n = 0;
-    int send_idx = -1, recv_idx = -1;
-    if (sent < send_len) {
-      send_idx = n;
-      pfds[n++] = {ring_send_fd_, POLLOUT, 0};
-    }
-    if (recvd < recv_len) {
-      recv_idx = n;
-      pfds[n++] = {ring_recv_fd_, POLLIN, 0};
-    }
-    int rc = ::poll(pfds, n, -1);
-    if (rc < 0) {
-      if (errno == EINTR) continue;
-      result = Status::Unknown(std::string("poll: ") + strerror(errno));
+  return DuplexTransfer(ring_send_fd_, ring_recv_fd_, send_data, send_len,
+                        recv_data, recv_len);
+}
+
+Status Transport::RingSendRecv(RingScope scope, const void* send_data,
+                               size_t send_len, void* recv_data,
+                               size_t recv_len) {
+  int sfd, rfd;
+  switch (scope) {
+    case RingScope::kGlobal:
+      sfd = ring_send_fd_;
+      rfd = ring_recv_fd_;
       break;
+    case RingScope::kLocal:
+      sfd = local_send_fd_;
+      rfd = local_recv_fd_;
+      break;
+    case RingScope::kCross:
+      sfd = cross_send_fd_;
+      rfd = cross_recv_fd_;
+      break;
+    default:
+      return Status::InvalidArgument("bad ring scope");
+  }
+  if (sfd < 0 || rfd < 0)
+    return Status::InvalidArgument("ring not wired (InitHierarchy not run?)");
+  return DuplexTransfer(sfd, rfd, send_data, send_len, recv_data, recv_len);
+}
+
+int Transport::ring_pos(RingScope scope) const {
+  switch (scope) {
+    case RingScope::kLocal:
+      return rank_ % inner_;
+    case RingScope::kCross:
+      return rank_ / inner_;
+    default:
+      return rank_;
+  }
+}
+
+int Transport::ring_n(RingScope scope) const {
+  switch (scope) {
+    case RingScope::kLocal:
+      return inner_;
+    case RingScope::kCross:
+      return groups_;
+    default:
+      return size_;
+  }
+}
+
+Status Transport::InitHierarchy(int inner, int timeout_ms) {
+  if (hier_ready_) return Status::OK();
+  if (inner <= 1 || inner >= size_ || size_ % inner != 0)
+    return Status::InvalidArgument(
+        "InitHierarchy needs 1 < inner < size with size % inner == 0 (got "
+        "inner=" + std::to_string(inner) + ", size=" +
+        std::to_string(size_) + ")");
+  if (static_cast<int>(addrs_.size()) != size_)
+    return Status::InvalidArgument("InitHierarchy before Init");
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+
+  // Ring neighbors. Dials go to each peer's existing data listener; the
+  // kAuthPurposeHier handshake announces our rank, and the acceptor
+  // classifies the link (local vs cross) by which expected-prev rank it
+  // came from — the two are always distinct ranks when both rings are
+  // non-degenerate (enforced above).
+  int g = rank_ / inner, l = rank_ % inner, groups = size_ / inner;
+  int local_next = g * inner + (l + 1) % inner;
+  int local_prev = g * inner + (l - 1 + inner) % inner;
+  int cross_next = ((g + 1) % groups) * inner + l;
+  int cross_prev = ((g - 1 + groups) % groups) * inner + l;
+
+  auto dial = [&](int target, int* out_fd) -> Status {
+    const std::string& addr = addrs_[target];
+    size_t colon = addr.rfind(':');
+    Status s = ResolveAndConnect(addr.substr(0, colon),
+                                 std::stoi(addr.substr(colon + 1)),
+                                 timeout_ms, out_fd);
+    if (!s.ok()) return s;
+    return HandshakeConnect(*out_fd, secret_, kAuthPurposeHier, timeout_ms,
+                            rank_);
+  };
+  Status local_dial = Status::OK(), cross_dial = Status::OK();
+  std::thread local_dialer([&]() { local_dial = dial(local_next,
+                                                     &local_send_fd_); });
+  std::thread cross_dialer([&]() { cross_dial = dial(cross_next,
+                                                     &cross_send_fd_); });
+
+  // Accept the two inbound links, classifying by authenticated peer rank.
+  // Unexpected or unauthenticated connections are closed and logged, never
+  // allowed to wedge the bootstrap (same stance as the control star).
+  Status accept_status = Status::OK();
+  while (local_recv_fd_ < 0 || cross_recv_fd_ < 0) {
+    int fd;
+    accept_status = AcceptWithDeadline(data_listen_fd_, deadline, &fd);
+    if (!accept_status.ok()) break;
+    auto remain_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - Clock::now()).count();
+    if (remain_ms < 1) remain_ms = 1;
+    int32_t peer = -1;
+    Status hs = HandshakeAccept(fd, secret_, kAuthPurposeHier,
+                                static_cast<int>(remain_ms), &peer);
+    if (!hs.ok()) {
+      ::close(fd);
+      HVD_LOG_RANK(WARNING, rank_)
+          << "rejected hierarchy connection: " << hs.reason();
+      continue;
     }
-    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
-      ssize_t m = ::send(ring_send_fd_, sp + sent, send_len - sent,
-                         MSG_NOSIGNAL);
-      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
-          errno != EINTR) {
-        result = Status::Unknown(std::string("send: ") + strerror(errno));
-        break;
-      }
-      if (m > 0) sent += static_cast<size_t>(m);
-    }
-    if (recv_idx >= 0 &&
-        (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t m = ::recv(ring_recv_fd_, rp + recvd, recv_len - recvd, 0);
-      if (m == 0) {
-        result = Status::Aborted("peer closed connection");
-        break;
-      }
-      if (m < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
-          errno != EINTR) {
-        result = Status::Unknown(std::string("recv: ") + strerror(errno));
-        break;
-      }
-      if (m > 0) recvd += static_cast<size_t>(m);
+    if (peer == local_prev && local_recv_fd_ < 0) {
+      local_recv_fd_ = fd;
+    } else if (peer == cross_prev && cross_recv_fd_ < 0) {
+      cross_recv_fd_ = fd;
+    } else {
+      ::close(fd);
+      HVD_LOG_RANK(WARNING, rank_)
+          << "rejected hierarchy connection from unexpected rank " << peer;
     }
   }
-  fcntl(ring_send_fd_, F_SETFL, sflags);
-  fcntl(ring_recv_fd_, F_SETFL, rflags);
-  return result;
+  local_dialer.join();
+  cross_dialer.join();
+  if (!local_dial.ok()) return local_dial;
+  if (!cross_dial.ok()) return cross_dial;
+  if (!accept_status.ok()) return accept_status;
+
+  inner_ = inner;
+  groups_ = groups;
+  hier_ready_ = true;
+  HVD_LOG_RANK(DEBUG, rank_)
+      << "hierarchy up: local ring " << local_prev << " -> " << rank_
+      << " -> " << local_next << ", cross ring " << cross_prev << " -> "
+      << rank_ << " -> " << cross_next;
+  return Status::OK();
 }
 
 Status Transport::SendToRank(int dst, const void* data, size_t len) {

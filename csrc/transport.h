@@ -22,6 +22,16 @@
 
 namespace hvdtpu {
 
+// Which ring a data-plane transfer rides. kGlobal is the flat all-ranks
+// ring that Init always wires. kLocal/kCross exist only after
+// InitHierarchy: ranks are grouped into blocks of `inner` consecutive
+// ranks (the launcher assigns ranks host-contiguously, so a group == a
+// host when inner == local_size); kLocal rings within a group, kCross
+// rings across groups among ranks with equal within-group index. This is
+// the TCP analogue of the reference's local/cross communicator split
+// (reference horovod/common/operations.cc:1760-1797).
+enum class RingScope { kGlobal = 0, kLocal = 1, kCross = 2 };
+
 class Transport {
  public:
   Transport() = default;
@@ -55,6 +65,21 @@ class Transport {
   // kernel socket buffers.
   Status SendRecv(const void* send_data, size_t send_len, void* recv_data,
                   size_t recv_len);
+  // Same, on the chosen ring. kLocal/kCross require hierarchy_ready().
+  Status RingSendRecv(RingScope scope, const void* send_data, size_t send_len,
+                      void* recv_data, size_t recv_len);
+
+  // --- Two-level topology (hierarchical collectives) ---------------------
+  // Wire the local (within-group) and cross (between-group) rings for
+  // groups of `inner` consecutive ranks. Requires Init() done on EVERY
+  // rank first (the coordinator runs a control-star barrier before calling
+  // this, so no hierarchy dial can race another rank's flat-ring accept)
+  // and 1 < inner < size with size % inner == 0.
+  Status InitHierarchy(int inner, int timeout_ms = 60000);
+  bool hierarchy_ready() const { return hier_ready_; }
+  // This rank's position and the ring length within `scope`.
+  int ring_pos(RingScope scope) const;
+  int ring_n(RingScope scope) const;
 
   // Point-to-point over the control star (root<->worker), used by
   // broadcast when the root is not rank 0 and by shutdown draining.
@@ -71,6 +96,15 @@ class Transport {
   int ring_send_fd_ = -1;              // to (rank+1) % size
   int ring_recv_fd_ = -1;              // from (rank-1+size) % size
   int data_listen_fd_ = -1;
+  std::vector<std::string> addrs_;     // rank -> "host:port" data listeners
+
+  // Two-level rings (InitHierarchy). pos within local ring = rank % inner;
+  // pos within cross ring = rank / inner.
+  bool hier_ready_ = false;
+  int inner_ = 1;                      // local ring length
+  int groups_ = 1;                     // cross ring length
+  int local_send_fd_ = -1, local_recv_fd_ = -1;
+  int cross_send_fd_ = -1, cross_recv_fd_ = -1;
 };
 
 }  // namespace hvdtpu

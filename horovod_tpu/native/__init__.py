@@ -130,6 +130,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvdtpu_size.restype = c.c_int
     lib.hvdtpu_local_rank.restype = c.c_int
     lib.hvdtpu_local_size.restype = c.c_int
+    lib.hvdtpu_hierarchical_active.restype = c.c_int
     for op in ("allreduce", "allgather"):
         fn = getattr(lib, f"hvdtpu_enqueue_{op}")
         fn.argtypes = [c.c_char_p, c.c_void_p, c.c_int, c.c_int, i64p]
@@ -246,6 +247,11 @@ class NativeCore:
 
     def local_size(self) -> int:
         return self.lib.hvdtpu_local_size()
+
+    def hierarchical_active(self) -> int:
+        """Bitmask of active two-level collective paths: 1 = allreduce,
+        2 = allgather (0 when the flat ring is in use)."""
+        return self.lib.hvdtpu_hierarchical_active()
 
     # -- enqueue -----------------------------------------------------------
     def _dtype_id(self, arr: np.ndarray) -> int:

@@ -47,24 +47,10 @@ def init(comm=None) -> None:
     """
     if mpi_ops._core is not None and mpi_ops._core.initialized:
         return
-    from horovod_tpu.common.config import _env_bool
-
-    for _knob in ("HOROVOD_HIERARCHICAL_ALLREDUCE",
-                  "HOROVOD_HIERARCHICAL_ALLGATHER"):
-        if _env_bool(_knob):
-            # Documented decision: the native eager lane runs a single flat
-            # TCP ring with no intra-/cross-host topology distinction
-            # (csrc/transport.h), so the two-level ladders of reference
-            # operations.cc:1284-1436 and :929-1032 have no native
-            # counterpart; the knobs ARE honored on the XLA lane
-            # (horovod_tpu/jax/fusion.py, jax/mpi_ops.py). Warn rather
-            # than silently ignore.
-            import warnings
-
-            warnings.warn(
-                f"{_knob} is honored by the XLA/SPMD lane only; the "
-                "native eager lane uses a flat TCP ring (see README "
-                "'Scope decisions').", stacklevel=2)
+    # HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER are consumed inside the
+    # native core (csrc/coordinator.cc): it wires local/cross sub-rings and
+    # runs the two-level ladder (reference operations.cc:1284-1436,
+    # :929-1032), degrading to the flat ring for untileable topologies.
     rank = int(os.environ.get("HOROVOD_RANK", "0"))
     size = int(os.environ.get("HOROVOD_SIZE", "1"))
     local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))

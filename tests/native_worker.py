@@ -134,6 +134,69 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
             converged = bool((out == out[0]).all())
         assert converged, "autotuned parameters never converged across ranks"
 
+    elif scenario == "hier":
+        # Two-level collectives (reference hierarchical allreduce
+        # operations.cc:1284-1436 / allgather :929-1032, rebuilt as
+        # local-ring + cross-ring ladders in csrc/collectives.cc). The
+        # launcher env sets HOROVOD_HIERARCHICAL_* knobs; this scenario
+        # asserts both that the hierarchical path is ACTIVE (or correctly
+        # degraded for untileable topologies) and that results match the
+        # flat closed forms exactly.
+        import os
+
+        inner = int(os.environ.get("HOROVOD_HIERARCHICAL_INNER_SIZE", "0")) \
+            or size
+        tileable = 1 < inner < size and size % inner == 0
+        want = 3 if tileable else 0  # allreduce | allgather bits
+        assert core.hierarchical_active() == want, (
+            core.hierarchical_active(), want)
+
+        # Single large allreduce (count not divisible by inner: exercises
+        # the ragged stripe bounds).
+        a = np.arange(1003, dtype=np.float64) * (rank + 1)
+        h = core.allreduce_async_("h_ar", a)
+        core.wait(h)
+        core.release(h)
+        scale = sum(r + 1 for r in range(size))
+        assert np.allclose(a, np.arange(1003, dtype=np.float64) * scale)
+
+        # Fused volume (many small tensors through the fusion buffer, all
+        # riding the hierarchical ladder in one pass).
+        arrs, handles = [], []
+        for i in range(48):
+            x = np.full(7, float(rank + i), dtype=np.float32)
+            arrs.append(x)
+            handles.append(core.allreduce_async_(f"h_small.{i}", x))
+        for i, h in enumerate(handles):
+            core.wait(h)
+            core.release(h)
+            assert np.allclose(arrs[i], sum(r + i for r in range(size)))
+
+        # float16 through the two-level ladder (native half math).
+        f16 = np.ones(65, dtype=np.float16) * (rank + 1)
+        h = core.allreduce_async_("h_f16", f16)
+        core.wait(h)
+        core.release(h)
+        assert np.allclose(f16, scale, atol=0.01)
+
+        # Ragged hierarchical allgatherv: rank r contributes r+1 rows.
+        g = np.full((rank + 1, 3), rank, dtype=np.int64)
+        h = core.allgather_async("h_ag", g)
+        core.wait(h)
+        out = core.take_result(h, np.int64, (3,))
+        assert out.shape[0] == sum(r + 1 for r in range(size))
+        off = 0
+        for r in range(size):
+            assert (out[off:off + r + 1] == r).all()
+            off += r + 1
+
+        # Broadcast still rides the star path untouched.
+        b = np.full(9, rank * 2.0, dtype=np.float32)
+        h = core.broadcast_async_("h_bc", b, 0)
+        core.wait(h)
+        core.release(h)
+        assert (b == 0.0).all()
+
     elif scenario == "stall":
         # Rank 1 holds back its request so rank 0's stall checker
         # (coordinator.cc CheckForStalled, parity with reference

@@ -201,6 +201,41 @@ class TestStallDetection:
         assert "missing ranks: 1" in outs[0], outs[0][-2000:]
 
 
+class TestHierarchical:
+    """Two-level (local ring + cross ring) collectives on the native lane
+    (reference hierarchical allreduce operations.cc:1284-1436, hierarchical
+    allgather :929-1032; knobs operations.h:65-66)."""
+
+    def test_hierarchical_allreduce_allgather_4ranks_2groups(self):
+        """4 ranks tiled as 2 groups of 2: the hierarchical path must be
+        active on every rank and every collective must match the flat
+        closed forms (worker scenario asserts both)."""
+        env = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+               "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+               "HOROVOD_HIERARCHICAL_INNER_SIZE": "2"}
+        _spawn(4, "hier", extra_env={r: dict(env) for r in range(4)})
+
+    def test_hierarchical_authenticated(self):
+        """The local/cross hierarchy links run the same HMAC handshake as
+        the flat ring (csrc/auth.cc kAuthPurposeHier)."""
+        secret = os.urandom(16).hex()
+        env = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+               "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+               "HOROVOD_HIERARCHICAL_INNER_SIZE": "2",
+               "HOROVOD_SECRET": secret}
+        _spawn(4, "hier", extra_env={r: dict(env) for r in range(4)})
+
+    def test_untileable_topology_degrades_to_flat(self):
+        """size=3 with inner=2 can't tile into equal groups: the knob must
+        degrade to the flat ring (hierarchical_active()==0) with results
+        still correct — the analogue of the reference's heterogeneous
+        degrade (operations.cc:1303-1315)."""
+        env = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+               "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+               "HOROVOD_HIERARCHICAL_INNER_SIZE": "2"}
+        _spawn(3, "hier", extra_env={r: dict(env) for r in range(3)})
+
+
 class TestTransportAuth:
     """The TCP transport authenticates every connection with an
     HMAC-SHA256 challenge-response keyed by HOROVOD_SECRET (csrc/auth.cc),
