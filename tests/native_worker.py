@@ -14,8 +14,15 @@ from horovod_tpu.native import NativeCore, NativeError
 
 
 def run(rank: int, size: int, port: int, scenario: str) -> None:
+    import os
+
+    # Host grouping as the launcher would pass it down (run/__init__.py
+    # sets HOROVOD_LOCAL_RANK/LOCAL_SIZE per host); defaults to one group.
+    local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", str(size)))
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))
     core = NativeCore()
-    core.init(rank=rank, size=size, local_rank=rank, local_size=size,
+    core.init(rank=rank, size=size, local_rank=local_rank,
+              local_size=local_size,
               coord_host="127.0.0.1", coord_port=port, timeout_ms=30000)
     core.set_cycle_time_ms(1.0)
     assert core.rank() == rank and core.size() == size
@@ -142,10 +149,9 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
         # asserts both that the hierarchical path is ACTIVE (or correctly
         # degraded for untileable topologies) and that results match the
         # flat closed forms exactly.
-        import os
-
-        inner = int(os.environ.get("HOROVOD_HIERARCHICAL_INNER_SIZE", "0")) \
-            or size
+        inner = int(os.environ.get("HOROVOD_HIERARCHICAL_INNER_SIZE", "0"))
+        if inner <= 0:  # same fallback semantics as coordinator.cc
+            inner = local_size
         tileable = 1 < inner < size and size % inner == 0
         want = 3 if tileable else 0  # allreduce | allgather bits
         assert core.hierarchical_active() == want, (
@@ -196,6 +202,18 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
         core.wait(h)
         core.release(h)
         assert (b == 0.0).all()
+
+        # Multi-MB payload: stripes far beyond kernel socket buffers, so
+        # the full-duplex DuplexTransfer path on BOTH sub-rings is what
+        # keeps this from deadlocking (same rationale as the flat ring's
+        # SendRecv, transport.cc).
+        big = np.arange(2_000_003, dtype=np.float32) * (rank + 1)
+        h = core.allreduce_async_("h_big", big)
+        core.wait(h)
+        core.release(h)
+        assert np.allclose(
+            big, np.arange(2_000_003, dtype=np.float32) * scale), (
+            "big mismatch")
 
     elif scenario == "stall":
         # Rank 1 holds back its request so rank 0's stall checker

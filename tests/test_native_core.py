@@ -225,6 +225,18 @@ class TestHierarchical:
                "HOROVOD_SECRET": secret}
         _spawn(4, "hier", extra_env={r: dict(env) for r in range(4)})
 
+    def test_group_size_defaults_to_local_size(self):
+        """Without HOROVOD_HIERARCHICAL_INNER_SIZE the group size is the
+        launcher-provided local_size — the reference's grouping by host
+        (local_comm split, operations.cc:1760-1797). Simulate 2 hosts x 2
+        ranks via HOROVOD_LOCAL_RANK/LOCAL_SIZE."""
+        def env(rank):
+            return {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+                    "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+                    "HOROVOD_LOCAL_SIZE": "2",
+                    "HOROVOD_LOCAL_RANK": str(rank % 2)}
+        _spawn(4, "hier", extra_env={r: env(r) for r in range(4)})
+
     def test_untileable_topology_degrades_to_flat(self):
         """size=3 with inner=2 can't tile into equal groups: the knob must
         degrade to the flat ring (hierarchical_active()==0) with results
