@@ -195,6 +195,13 @@ class TestExamples:
     def test_long_context_ring_attention_smoke(self):
         _run_example("long_context_ring_attention.py", "--smoke")
 
+    def test_jax_word2vec_smoke(self):
+        """Sparse-gradient skip-gram (reference
+        examples/tensorflow_word2vec.py): loss falls and embeddings
+        cluster by topic; the example itself asserts both."""
+        proc = _run_example("jax_word2vec.py", "--smoke")
+        assert float(proc.stdout.strip().splitlines()[-1]) > 0
+
     def test_torch_mnist_via_launcher(self):
         _run_via_launcher("torch_mnist.py", "--epochs", "4",
                           "--batch-size", "32", "--train-size", "2048")
