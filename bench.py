@@ -44,6 +44,11 @@ def main():
     parser.add_argument("--fp32", action="store_true", help="disable bfloat16 compute")
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1 optimizer-state sharding over the mesh")
+    parser.add_argument("--bf16-momentum", action="store_true",
+                        help="keep SGD momentum in bfloat16: halves the "
+                             "optimizer-state HBM traffic of the update "
+                             "(PERF.md), off by default for reference-"
+                             "protocol parity")
     args = parser.parse_args()
 
     import jax
@@ -62,8 +67,11 @@ def main():
     model = models.build(args.model, num_classes=1000, dtype=dtype)
     rng = jax.random.PRNGKey(42)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    sgd = optax.sgd(
+        0.01, momentum=0.9,
+        accumulator_dtype=jnp.bfloat16 if args.bf16_momentum else None)
     state, optimizer = models.create_train_state(
-        rng, model, optax.sgd(0.01, momentum=0.9), sample, zero=args.zero)
+        rng, model, sgd, sample, zero=args.zero)
     step_fn = models.make_train_step(model, optimizer, average_loss=False)
     state_spec = models.state_partition_specs(state) if args.zero else P()
 
