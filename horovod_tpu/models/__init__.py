@@ -18,6 +18,7 @@ from horovod_tpu.models.resnet import (
 from horovod_tpu.models.resnet import _FAMILY as _RESNET_FAMILY
 from horovod_tpu.models.train import (
     TrainState,
+    apply_gradients,
     create_train_state,
     cross_entropy_loss,
     make_eval_step,
@@ -74,6 +75,7 @@ __all__ = [
     "ViT_B16",
     "build",
     "TrainState",
+    "apply_gradients",
     "create_train_state",
     "cross_entropy_loss",
     "make_eval_step",

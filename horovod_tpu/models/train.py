@@ -105,6 +105,27 @@ def create_train_state(
     return state, optimizer
 
 
+def apply_gradients(
+    optimizer: optax.GradientTransformation,
+    state: TrainState,
+    grads,
+    batch_stats=None,
+) -> TrainState:
+    """The shared update tail of every training step: optimizer update
+    (the DistributedOptimizer/ZeRO wrapper performs the fused cross-rank
+    gradient exchange here), parameter apply, state repack with the step
+    counter advanced."""
+    updates, new_opt_state = optimizer.update(
+        grads, state["opt_state"], state["params"]
+    )
+    return TrainState(
+        params=optax.apply_updates(state["params"], updates),
+        batch_stats=state["batch_stats"] if batch_stats is None else batch_stats,
+        opt_state=new_opt_state,
+        step=state["step"] + 1,
+    )
+
+
 def make_train_step(model, optimizer: optax.GradientTransformation, average_loss: bool = True):
     """Build the per-rank SPMD training step.
 
@@ -137,20 +158,12 @@ def make_train_step(model, optimizer: optax.GradientTransformation, average_loss
         (loss, (new_stats, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], state["batch_stats"], batch, rng
         )
-        # DistributedOptimizer's update performs the fused cross-rank
-        # gradient allreduce before the inner optimizer sees the grads.
-        updates, new_opt_state = optimizer.update(grads, state["opt_state"], state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
         accuracy = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
         if average_loss:
             loss = mpi_ops.allreduce(loss, average=True, name="train.loss")
             accuracy = mpi_ops.allreduce(accuracy, average=True, name="train.accuracy")
-        new_state = TrainState(
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt_state,
-            step=state["step"] + 1,
-        )
+        new_state = apply_gradients(optimizer, state, grads,
+                                    batch_stats=new_stats)
         return new_state, {"loss": loss, "accuracy": accuracy}
 
     return train_step

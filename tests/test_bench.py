@@ -1,0 +1,66 @@
+"""Smoke tests for the driver's bench entry (`bench.py`).
+
+The driver runs ``python bench.py`` on real hardware at round end; these
+tests pin its contract — one JSON line with metric/value/unit/vs_baseline
+— on the hermetic 8-device CPU mesh so a refactor can't silently break
+the recorded benchmark. Protocol anchor: reference
+examples/pytorch_synthetic_benchmark.py:79-110.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_bench(*args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["HVD_TPU_FORCE_CPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), *args],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=timeout)
+    assert proc.returncode == 0, (
+        f"bench rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1]), proc
+
+
+def test_default_lane_contract():
+    """The exact invocation the driver records (tiny sizes for CI)."""
+    out, _ = _run_bench("--batch-size", "2", "--image-size", "64",
+                        "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "2", "--num-iters", "2")
+    assert out["metric"] == "resnet50_img_per_sec_per_chip"
+    assert out["unit"] == "img/sec/chip"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+
+
+def test_lm_lane_contract():
+    """Long-context lane: tokens/sec with vs_baseline null."""
+    out, proc = _run_bench(
+        "--model", "transformer_lm", "--batch-size", "2",
+        "--seq-len", "128", "--vocab", "512", "--lm-layers", "2",
+        "--lm-dim", "64", "--lm-heads", "4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+        "--num-iters", "2")
+    assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
+    assert out["unit"] == "tokens/sec/chip"
+    assert out["value"] > 0
+    assert out["vs_baseline"] is None
+    assert "tokens/sec" in proc.stderr
+
+
+def test_zero_composes_with_lm_lane():
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--zero", "--batch-size", "2",
+        "--seq-len", "64", "--vocab", "256", "--lm-layers", "1",
+        "--lm-dim", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out["value"] > 0
