@@ -177,6 +177,20 @@ def _kill_all(procs: List[subprocess.Popen]) -> None:
                 os.killpg(os.getpgid(p.pid), signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+    # Reap the SIGKILLed stragglers so callers (notably the --restarts
+    # relaunch loop) never start a new attempt while an old local worker
+    # still holds its device lock or checkpoint file. SIGKILL cannot be
+    # blocked; the wait only stalls on uninterruptible (D-state) I/O, so
+    # bound it and report rather than hang the launcher.
+    reap_deadline = time.monotonic() + 10
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(max(0.1, reap_deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                print(f"hvdrun: worker pid {p.pid} did not exit after "
+                      "SIGKILL (uninterruptible I/O?); proceeding",
+                      file=sys.stderr, flush=True)
 
 
 def launch_command(cmd: Sequence[str], np: int,

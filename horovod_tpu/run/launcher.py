@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 from horovod_tpu.run import launch_command
 
@@ -26,14 +27,36 @@ def main(argv=None) -> int:
                         help="join workers into ONE global jax device mesh "
                              "(sets HOROVOD_JAX_COORDINATOR; each worker's "
                              "hvd.init() then spans all workers' chips)")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="relaunch the whole job up to N times after a "
+                             "failure. Combined with the checkpoint/resume "
+                             "pattern (rank-0 checkpoint + re-broadcast, "
+                             "flax.CheckpointCallback) the relaunch resumes "
+                             "from the last saved step. 0 = fail fast, the "
+                             "reference's MPI semantics")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
+    if args.restarts < 0:
+        parser.error("--restarts must be >= 0")
     cmd = args.command[1:] if args.command[0] == "--" else args.command
-    return launch_command(cmd, np=args.num_proc, hosts=args.hosts,
-                          jax_distributed=args.jax_distributed)
+    for attempt in range(args.restarts + 1):
+        rc = launch_command(cmd, np=args.num_proc, hosts=args.hosts,
+                            jax_distributed=args.jax_distributed)
+        if rc == 0:
+            return 0
+        if attempt < args.restarts:
+            print(f"hvdrun: attempt {attempt + 1} failed (exit {rc}); "
+                  f"relaunching ({args.restarts - attempt} restart(s) "
+                  f"left)", file=sys.stderr, flush=True)
+            # Local workers are reaped by _kill_all before launch_command
+            # returns; ssh-remote teardown is asynchronous (pty HUP), so
+            # give it a moment before the relaunch contends for devices.
+            if args.hosts:
+                time.sleep(3.0)
+    return rc
 
 
 if __name__ == "__main__":

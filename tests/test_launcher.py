@@ -165,6 +165,36 @@ class TestCLI:
             env=_clean_env(), cwd=str(REPO), timeout=120).returncode
         assert code == 3
 
+    def test_restarts_relaunches_until_success(self, tmp_path):
+        """--restarts N relaunches a failed job (the checkpoint/resume
+        companion: rank-0 checkpoint + re-broadcast makes the relaunch
+        continue from the saved step). A worker that crashes on the first
+        attempt (marker file) must succeed on the relaunch."""
+        marker = tmp_path / "attempted"
+        script = (
+            "import os, sys; m = sys.argv[1]\n"
+            "if os.environ['HOROVOD_RANK'] == '0' and not os.path.exists(m):\n"
+            "    open(m, 'w').close(); sys.exit(7)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             "--restarts", "1",
+             sys.executable, "-c", script, str(marker)],
+            env=_clean_env(), cwd=str(REPO), timeout=180,
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "relaunching" in proc.stderr
+        assert marker.exists()
+
+    def test_restarts_exhausted_returns_failure(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
+             "--restarts", "2", sys.executable, "-c", "raise SystemExit(5)"],
+            env=_clean_env(), cwd=str(REPO), timeout=180,
+            capture_output=True, text=True)
+        assert proc.returncode == 5
+        assert proc.stderr.count("relaunching") == 2
+
     def test_hosts_slot_mismatch(self):
         from horovod_tpu.run import LaunchError, launch_command
 
