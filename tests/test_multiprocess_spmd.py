@@ -16,11 +16,13 @@ REPO = Path(__file__).resolve().parent.parent
 WORKER = Path(__file__).resolve().parent / "spmd_multiproc_worker.py"
 
 
-def test_two_process_global_mesh_end_to_end():
+def _launch_and_check(extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--jax",
          sys.executable, str(WORKER)],
@@ -37,3 +39,20 @@ def test_two_process_global_mesh_end_to_end():
     # Same averaged gradients + same broadcast start => identical params.
     assert by_rank[0][0] == by_rank[1][0], by_rank
     assert by_rank[0][1] == by_rank[1][1]
+
+
+def test_two_process_global_mesh_end_to_end():
+    _launch_and_check()
+
+
+def test_two_process_hierarchical_ladder():
+    """The same end-to-end story with HOROVOD_HIERARCHICAL_* set: the
+    4-chip axis spans 2 processes x 2 chips, so the auto inner size is 2
+    and every fused gradient reduction runs the explicit two-level ladder
+    (reduce-scatter within the process's chips, cross-reduce over the
+    process boundary, all-gather back — horovod_tpu/jax/fusion.py ->
+    parallel/mesh.py). Every worker assert (closed-form collectives,
+    convergence, ZeRO sharding, ring attention, cross-process digest
+    equality) must still hold."""
+    _launch_and_check({"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+                       "HOROVOD_HIERARCHICAL_ALLGATHER": "1"})
