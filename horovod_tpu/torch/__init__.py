@@ -44,7 +44,25 @@ def init(comm=None) -> None:
 
     Single-process (no launcher) degenerates to size 1, the reference's
     "no cluster needed" mode (SURVEY §4 mechanism 1).
+
+    ``comm`` (reference ``hvd.init(comm=[ranks])``, common/__init__.py:
+    58-84: restrict the job to a subset of MPI_COMM_WORLD) is supported
+    on the jax lane, where the sub-mesh is just a device subset. On this
+    TCP lane a sub-world would need every member to learn the sub-
+    coordinator's address — information MPI groups provided for free and
+    the launcher env does not carry — so a proper subset raises rather
+    than being silently ignored; launch a smaller job (or use the jax
+    lane) instead.
     """
+    if comm is not None:
+        world = int(os.environ.get("HOROVOD_SIZE", "1"))
+        if list(comm) != list(range(world)):
+            raise ValueError(
+                "horovod_tpu.torch.init(comm=...) with a proper subset of "
+                "ranks is not supported on the native TCP lane (no rank "
+                "address registry for a sub-coordinator); launch a "
+                "separate smaller job with hvdrun, or use "
+                "horovod_tpu.jax.init(comm=...) which builds a sub-mesh.")
     if mpi_ops._core is not None and mpi_ops._core.initialized:
         return
     # HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER are consumed inside the

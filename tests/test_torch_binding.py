@@ -186,3 +186,19 @@ class TestMultiProcess:
 
     def test_optimizer_features(self):
         _spawn(2, "optimizer_features")
+
+
+def test_init_comm_subset_rejected_not_ignored():
+    """init(comm=<proper subset>) must raise, not silently run the full
+    world (round-1 standard: no knob parses to nothing). The full-world
+    comm and None are both accepted (reference common/__init__.py:58-84
+    semantics)."""
+    import pytest
+
+    import horovod_tpu.torch as hvd
+
+    with pytest.raises(ValueError, match="sub-mesh|smaller job"):
+        hvd.init(comm=[0, 2])
+    hvd.init(comm=[0])  # == full single-process world: fine
+    assert hvd.size() == 1
+    hvd.shutdown()
