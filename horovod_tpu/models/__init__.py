@@ -25,6 +25,7 @@ from horovod_tpu.models.train import (
     make_train_step,
     state_partition_specs,
 )
+from horovod_tpu.models import parallel_lm
 from horovod_tpu.models.transformer import TransformerBlock, TransformerLM
 from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from horovod_tpu.models.vit import ViT_B16, ViT_S16, VisionTransformer
@@ -76,6 +77,7 @@ __all__ = [
     "build",
     "TrainState",
     "apply_gradients",
+    "parallel_lm",
     "create_train_state",
     "cross_entropy_loss",
     "make_eval_step",

@@ -1,0 +1,215 @@
+"""Composed-parallelism GPT-style LM: dp x sp x tp in one model.
+
+The reference scaled batch only (SURVEY §2.9: no TP/SP anywhere); this
+module is the TPU-native flagship composition the parallel/ primitives
+exist for, packaged as a first-class model instead of a hand-assembled
+example:
+
+* **tp** — attention heads and MLP features shard Megatron-style
+  (:mod:`horovod_tpu.parallel.tp`): column-parallel QKV/up-projection
+  (no comm), row-parallel out/down-projection (one psum each);
+* **sp** — the sequence axis shards across chips and attention runs the
+  exact ring schedule (:mod:`horovod_tpu.parallel.ring_attention`),
+  with positional embeddings and the causal mask taken at global
+  positions;
+* **dp** — data parallelism is the caller's batch sharding plus the
+  uniform gradient pmean this module's loss helper pairs with.
+
+Everything is pure functions over an explicit parameter pytree, the
+idiom of :mod:`horovod_tpu.parallel`: build DENSE (unsharded) params
+with :func:`init_lm_params`, hand them to ``shard_map`` with
+:func:`lm_param_specs` as ``in_specs`` — the mesh slices the dense
+arrays onto chips — and call :func:`lm_apply` inside. With
+``sp=tp=None`` the same functions run the dense math on one device,
+which is exactly what the exactness tests compare against.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.attention import dot_product_attention
+from horovod_tpu.parallel.ring_attention import ring_attention
+from horovod_tpu.parallel.tp import (
+    sum_across,
+    tp_mlp,
+    tp_region_input,
+    tp_region_output,
+)
+
+
+def init_lm_params(rng, vocab: int, max_len: int, layers: int, heads: int,
+                   head_dim: int, ffn: int, dtype=jnp.float32) -> Dict:
+    """Dense (unsharded) parameter pytree. Shapes keep the head and
+    feature axes explicit so the tp specs can shard them:
+    wqkv [E, 3, H, Dh], wo [H, Dh, E], wup [E, F], wdn [F, E]."""
+    embed_dim = heads * head_dim
+    keys = jax.random.split(rng, 2 * layers + 3)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape) / math.sqrt(fan_in)).astype(dtype)
+
+    params: Dict[str, Any] = {
+        "embed": dense_init(keys[0], (vocab, embed_dim), embed_dim),
+        "pos": dense_init(keys[1], (max_len, embed_dim), embed_dim),
+        "layers": [],
+        "ln_f": {"g": jnp.ones((embed_dim,), dtype),
+                 "b": jnp.zeros((embed_dim,), dtype)},
+        "head": dense_init(keys[2], (embed_dim, vocab), embed_dim),
+    }
+    for i in range(layers):
+        ka, kb, kc = jax.random.split(keys[3 + 2 * i], 3)
+        kd = keys[4 + 2 * i]
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((embed_dim,), dtype),
+                    "b": jnp.zeros((embed_dim,), dtype)},
+            "wqkv": dense_init(ka, (embed_dim, 3, heads, head_dim),
+                               embed_dim),
+            "wo": dense_init(kb, (heads, head_dim, embed_dim), embed_dim),
+            "bo": jnp.zeros((embed_dim,), dtype),
+            "ln2": {"g": jnp.ones((embed_dim,), dtype),
+                    "b": jnp.zeros((embed_dim,), dtype)},
+            "wup": dense_init(kc, (embed_dim, ffn), embed_dim),
+            "bup": jnp.zeros((ffn,), dtype),
+            "wdn": dense_init(kd, (ffn, embed_dim), ffn),
+            "bdn": jnp.zeros((embed_dim,), dtype),
+        })
+    return params
+
+
+def lm_param_specs(layers: int, tp_axis: Optional[str]):
+    """PartitionSpec pytree matching :func:`init_lm_params`' structure.
+
+    Pass as the params entry of ``shard_map``'s ``in_specs`` (and
+    ``out_specs`` for the updated state): the mesh then slices the DENSE
+    arrays — heads/features over ``tp_axis``, everything else
+    replicated. ``tp_axis=None`` replicates everything."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    layer_spec = {
+        "ln1": {"g": P(), "b": P()},
+        "wqkv": P(None, None, t, None),
+        "wo": P(t, None, None),
+        "bo": P(),
+        "ln2": {"g": P(), "b": P()},
+        "wup": P(None, t),
+        "bup": P(t),
+        "wdn": P(t, None),
+        "bdn": P(),
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "layers": [dict(layer_spec) for _ in range(layers)],
+        "ln_f": {"g": P(), "b": P()},
+        "head": P(),
+    }
+
+
+def _layernorm(x, g, b):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + 1e-5)).astype(x.dtype) * g + b
+
+
+def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
+             tp: Optional[str] = None):
+    """Token ids [B, L_local] -> logits [B, L_local, vocab].
+
+    Inside ``shard_map``: ``sp`` names the sequence axis (tokens arrive
+    sequence-sharded; ring attention, global positions), ``tp`` the
+    tensor axis (params arrive head/feature-sharded via
+    :func:`lm_param_specs`). Both None = dense single-device math."""
+    B, L = tokens.shape
+    pos_offset = lax.axis_index(sp) * L if sp else 0
+    x = params["embed"][tokens]
+    x = x + lax.dynamic_slice_in_dim(params["pos"], pos_offset, L, 0)[None]
+
+    for layer in params["layers"]:
+        a = _layernorm(x, layer["ln1"]["g"], layer["ln1"]["b"])
+        if tp:
+            # Megatron f: upstream grads must SUM the per-head-shard
+            # cotangents (identity fwd, psum bwd).
+            a = tp_region_input(a, tp)
+        qkv = jnp.einsum("ble,ethd->blthd", a, layer["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        if sp:
+            attn = ring_attention(q, k, v, axis=sp, causal=True,
+                                  scale=scale)
+        else:
+            attn = dot_product_attention(q, k, v, causal=True, scale=scale)
+        proj = jnp.einsum("blhd,hde->ble", attn, layer["wo"])
+        if tp:
+            # Row-parallel over the head shards (Megatron g: exact bwd).
+            proj = tp_region_output(proj, tp)
+        x = x + proj + layer["bo"]
+
+        m = _layernorm(x, layer["ln2"]["g"], layer["ln2"]["b"])
+        if tp:
+            m = tp_region_input(m, tp)
+            x = x + tp_mlp(m, layer["wup"], layer["bup"], layer["wdn"],
+                           layer["bdn"], axis=tp)
+        else:
+            h = jax.nn.gelu(m @ layer["wup"] + layer["bup"])
+            x = x + h @ layer["wdn"] + layer["bdn"]
+
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return x @ params["head"]
+
+
+def next_token_nll(logits, tokens, sp: Optional[str] = None):
+    """Mean next-token negative log-likelihood, sequence-shard aware.
+
+    With ``sp``, each shard's last position needs the NEXT shard's first
+    token as its target — one ppermute — and the final global position is
+    masked out; the mean is taken over the sp axis so every chip returns
+    the same global value. Matches the dense shift exactly."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    B, L = tokens.shape
+    if sp:
+        n = lax.axis_size(sp)
+        nxt = lax.ppermute(tokens[:, :1], sp,
+                           [(i, (i - 1) % n) for i in range(n)])
+        tgt = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+        gpos = lax.axis_index(sp) * L + jnp.arange(L)
+        valid = (gpos < n * L - 1).astype(jnp.float32)[None, :]
+    else:
+        tgt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        valid = (jnp.arange(L) < L - 1).astype(jnp.float32)[None, :]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    valid = jnp.broadcast_to(valid, nll.shape)
+    local_sum = jnp.sum(nll * valid)
+    local_cnt = jnp.sum(valid)
+    if sp:
+        # sum_across, not bare psum: gradients through a raw psum get
+        # scaled by the axis size (see parallel/tp.py tp_region_output).
+        return sum_across(local_sum, sp) / lax.psum(local_cnt, sp)
+    return local_sum / local_cnt
+
+
+def reduce_grads(grads, dp: Optional[str] = None, sp: Optional[str] = None):
+    """The gradient reduction that pairs with :func:`next_token_nll`.
+
+    * ``sp``: SUM — the loss value is already normalized by the
+      sp-global token count (psum inside the nll), so each sp rank's
+      backward holds only its own tokens' contribution of the full
+      gradient;
+    * ``dp``: MEAN — the global loss is the mean of per-dp-shard means;
+    * ``tp``: nothing — tp peers see identical data, so replicated
+      leaves get identical grads and sharded leaves' grads are exactly
+      their slice.
+
+    Uniform over every leaf, replicated and tp-sharded alike."""
+    if sp:
+        grads = jax.tree_util.tree_map(lambda g: lax.psum(g, sp), grads)
+    if dp:
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), grads)
+    return grads

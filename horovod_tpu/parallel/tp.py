@@ -21,6 +21,65 @@ import jax.numpy as jnp
 from jax import lax
 
 
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_input(x, axis: str):
+    """Megatron's ``f`` conjugate: identity forward, psum backward.
+
+    Place on the replicated activation ENTERING a column-parallel region
+    whenever parameters live upstream (embeddings, layernorms, previous
+    blocks): each tp rank's backward only carries the cotangent of its
+    own head/feature shard, so without this psum the upstream gradients
+    would single-count the sharded paths. The forward-psum of
+    :func:`row_parallel` is the matching ``g`` on the way out. Costs
+    nothing in forward; one allreduce in backward."""
+    return x
+
+
+def _tp_region_fwd(x, axis):
+    return x, None
+
+
+def _tp_region_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+tp_region_input.defvjp(_tp_region_fwd, _tp_region_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_output(x, axis: str):
+    """Megatron's ``g`` conjugate: psum forward, identity backward.
+
+    The correct VJP for a cross-rank sum whose output is consumed as a
+    replicated value: the true Jacobian w.r.t. each rank's partial is 1,
+    so the replicated cotangent passes through unchanged. Differentiating
+    through a RAW ``lax.psum`` instead applies psum again in the
+    transpose (the classic pmap/shard_map gotcha), silently scaling every
+    upstream gradient by the axis size — which is why every
+    explicitly-summed parallel region here must use this (or
+    :func:`sum_across` for scalars) rather than bare psum when gradients
+    flow."""
+    return lax.psum(x, axis)
+
+
+def _tp_out_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_out_bwd(axis, _, g):
+    return (g,)
+
+
+tp_region_output.defvjp(_tp_out_fwd, _tp_out_bwd)
+
+# General-purpose alias: a differentiable cross-rank sum (e.g. loss
+# terms summed over a sequence-parallel axis).
+sum_across = tp_region_output
+
+
 def column_parallel(x, w, b=None, axis: str = "tp",
                     gather_output: bool = False):
     """y_local = x @ W_local where W is column-sharded [Din, Dout/P].
@@ -42,9 +101,10 @@ def row_parallel(x, w, b=None, axis: str = "tp"):
     and x is feature-sharded to match a preceding column-parallel layer.
 
     One psum produces the full output on every chip; the bias is added
-    once after the reduction.
-    """
-    y = lax.psum(x @ w, axis)
+    once after the reduction. The sum rides :func:`tp_region_output` so
+    gradients through it are exact (identity backward), not axis-size
+    scaled."""
+    y = tp_region_output(x @ w, axis)
     if b is not None:
         y = y + b
     return y
