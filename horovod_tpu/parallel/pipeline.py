@@ -84,6 +84,12 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp",
     _, outputs = lax.fori_loop(0, n_ticks, tick, (current0, outputs0))
 
     # Only the last stage holds real outputs; replicate them to all chips
-    # (masked psum = broadcast from the last stage).
+    # (masked psum = broadcast from the last stage). The sum rides the
+    # exact-VJP conjugate: a raw psum would apply psum again in its
+    # transpose and scale every upstream gradient by the stage count
+    # (see parallel/tp.py tp_region_output; grad test
+    # test_parallel.py::TestPipeline::test_gradients_match_sequential).
+    from horovod_tpu.parallel.tp import sum_across
+
     mask = (rank == size - 1).astype(outputs.dtype)
-    return lax.psum(outputs * mask, axis)
+    return sum_across(outputs * mask, axis)

@@ -310,6 +310,36 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    atol=1e-5)
 
+    def test_gradients_match_sequential(self, hvd):
+        """Pipeline gradients must equal the plain sequential autodiff —
+        this pinned down a latent x(pp size) scaling from differentiating
+        through the final raw psum (fixed via the exact-VJP sum_across)."""
+        mesh = _mesh({"pp": 4})
+        key = jax.random.PRNGKey(9)
+        D, M, Bm = 8, 6, 2
+        ws = jax.random.normal(key, (4, D, D)) * 0.3
+        x = jax.random.normal(jax.random.fold_in(key, 1), (M, Bm, D))
+
+        def stage(w, a):
+            return jnp.tanh(a @ w)
+
+        def seq_loss(ws):
+            out = x
+            for p in range(4):
+                out = jnp.tanh(out @ ws[p])
+            return jnp.mean(out ** 2)
+
+        g_seq = jax.grad(seq_loss)(ws)
+
+        def pipe_loss(ws, x):
+            return jnp.mean(par.pipeline_apply(stage, ws, x, "pp") ** 2)
+
+        g_pipe = jax.jit(jax.shard_map(
+            jax.grad(pipe_loss), mesh=mesh, in_specs=(P("pp"), P()),
+            out_specs=P("pp"), check_vma=False))(ws, x)
+        np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_remat_gradients_match(self, hvd):
         """remat=True recomputes stage internals in backward; gradients
         must be identical to the stored-activation schedule."""
