@@ -48,8 +48,8 @@ def dot_product_attention(q, k, v, causal: bool = False,
 # Pallas flash attention
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
-                  causal: bool, scale: float, block_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  seq_k: int, causal: bool, scale: float, block_q: int):
     """One (batch*head, q-block) program: stream K/V blocks through VMEM
     with online softmax so only O(block_q x d) state persists."""
     from jax.experimental import pallas as pl
@@ -90,6 +90,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
         m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
 
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # Per-row logsumexp (scores already include `scale`): persisted so the
+    # backward never re-derives it with an extra pass over the key blocks.
+    lse_ref[...] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -103,13 +106,32 @@ def flash_attention(q, k, v, causal: bool = False,
     Sequence lengths must be multiples of the block sizes (pad upstream).
     ``interpret`` defaults to True off-TPU so the same kernel is testable
     on the CPU mesh.
-    """
-    from jax.experimental import pallas as pl
 
+    Differentiable: the backward is the standard flash recurrence
+    (recompute scores blockwise against the saved output, never
+    materializing the [Lq, Lk] matrix) implemented with ``lax.scan`` over
+    key blocks — O(Lq x block_k) live memory, XLA-fused; gradient
+    exactness vs the dense reference is pinned in
+    tests/test_parallel.py::TestFlashAttention."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, float(scale), block_q, block_k,
+                  interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out [B, Lq, H, D], lse [B, H, Lq])."""
+    from jax.experimental import pallas as pl
+
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     block_q = min(block_q, Lq)
@@ -124,7 +146,7 @@ def flash_attention(q, k, v, causal: bool = False,
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, seq_k=Lk,
                                causal=causal, scale=scale, block_q=block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Lq // block_q),
         in_specs=[
@@ -132,8 +154,82 @@ def flash_attention(q, k, v, causal: bool = False,
             pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Lq), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    return (out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, Lq))
+
+
+def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, do):
+    """Flash backward, blockwise over key blocks (lax.scan), fp32 math.
+
+    Standard recurrences against the forward kernel's persisted
+    logsumexp:
+        D_i  = rowsum(dO_i * O_i)
+        P_ij = exp(S_ij - lse_i)
+        dV_j = sum_i P_ij^T dO_i
+        dS_ij = P_ij * (dO_i V_j^T - D_i)
+        dQ_i = sum_j dS_ij K_j * scale;  dK_j = sum_i dS_ij^T Q_i * scale
+    Peak live state is O(Lq x block_k) per (batch, head) — the score
+    matrix is never materialized. For causal rectangular Lq < Lk, key
+    blocks past the last visible key are fully masked and are skipped
+    statically (the forward kernel's early-exit mirror)."""
+    q, k, v, o, lse = res
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    bk = min(block_k, Lk)
+    nkb = Lk // bk
+    # Causal early-exit: keys at positions >= Lq are invisible to every
+    # query row (positions both start at 0).
+    nkb_live = min(nkb, -(-Lq // bk)) if causal else nkb
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    d_row = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B, Lq, H]
+    d_row = d_row.transpose(0, 2, 1)                        # [B, H, Lq]
+    q_pos = jnp.arange(Lq)[:, None]
+
+    def bwd_step(dq, jb):
+        kb = jax.lax.dynamic_slice_in_dim(kf, jb * bk, bk, 1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
+        if causal:
+            k_pos = jb * bk + jnp.arange(bk)[None, :]
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        vb = jax.lax.dynamic_slice_in_dim(vf, jb * bk, bk, 1)
+        p = jnp.exp(s - lse[..., None])                     # [B,H,Lq,bk]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vb)
+        ds = p * (dp - d_row[..., None])
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kb) * scale
+        dkb = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dvb = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        return dq, (dkb, dvb)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        bwd_step, jnp.zeros(q.shape, jnp.float32), jnp.arange(nkb_live))
+    # [nkb_live, B, bk, H, D] -> [B, nkb_live*bk, H, D] (+ zero tail for
+    # causally-skipped key blocks).
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(B, nkb_live * bk, H, D)
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(B, nkb_live * bk, H, D)
+    if nkb_live < nkb:
+        pad = [(0, 0), (0, Lk - nkb_live * bk), (0, 0), (0, 0)]
+        dk = jnp.pad(dk, pad)
+        dv = jnp.pad(dv, pad)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
