@@ -195,6 +195,13 @@ class TestExamples:
     def test_long_context_ring_attention_smoke(self):
         _run_example("long_context_ring_attention.py", "--smoke")
 
+    def test_jax_gpt_parallel_smoke(self):
+        """Composed dp x sp x tp LM example: trains on the synthetic
+        bigram corpus to well below the uniform-entropy floor (the
+        example itself asserts a 2x NLL drop)."""
+        proc = _run_example("jax_gpt_parallel.py", "--smoke")
+        assert float(proc.stdout.strip().splitlines()[-1]) < 1.0
+
     def test_jax_word2vec_smoke(self):
         """Sparse-gradient skip-gram (reference
         examples/tensorflow_word2vec.py): loss falls and embeddings
