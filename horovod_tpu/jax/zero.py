@@ -164,6 +164,17 @@ def sharded_distributed_optimizer(
 
     def update_fn(updates, state: ZeroState, params=None):
         axis = current_spmd_axis()
+        if axis is None:
+            # Hand-built shard_map (not via hvd.spmd_run/spmd_fn): the
+            # harness context is unset, but ``axis_name`` may still be a
+            # live mesh axis in this trace — honor it, so ZeRO composes
+            # with custom multi-axis meshes (e.g. ZeRO over "dp" inside a
+            # {dp, sp} shard_map; test_parallel_lm.py).
+            try:
+                lax.axis_size(axis_name)
+                axis = axis_name
+            except NameError:
+                axis = None
         st = global_state()
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         groups = _group_by_dtype(leaves)
