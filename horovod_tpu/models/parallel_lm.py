@@ -119,6 +119,40 @@ def _layernorm(x, g, b):
     return ((x32 - mu) * lax.rsqrt(var + 1e-5)).astype(x.dtype) * g + b
 
 
+def _project_qkv(layer, x, tp):
+    """ln1 -> (Megatron f) -> fused QKV projection onto local heads."""
+    a = _layernorm(x, layer["ln1"]["g"], layer["ln1"]["b"])
+    if tp:
+        # Megatron f: upstream grads must SUM the per-head-shard
+        # cotangents (identity fwd, psum bwd).
+        a = tp_region_input(a, tp)
+    qkv = jnp.einsum("ble,ethd->blthd", a, layer["wqkv"])
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _attn_out_residual(layer, attn, x, tp):
+    """Row-parallel output projection (Megatron g) + residual."""
+    proj = jnp.einsum("blhd,hde->ble", attn, layer["wo"])
+    if tp:
+        proj = tp_region_output(proj, tp)
+    return x + proj + layer["bo"]
+
+
+def _ffn_residual(layer, x, tp):
+    m = _layernorm(x, layer["ln2"]["g"], layer["ln2"]["b"])
+    if tp:
+        m = tp_region_input(m, tp)
+        return x + tp_mlp(m, layer["wup"], layer["bup"], layer["wdn"],
+                          layer["bdn"], axis=tp)
+    h = jax.nn.gelu(m @ layer["wup"] + layer["bup"])
+    return x + h @ layer["wdn"] + layer["bdn"]
+
+
+def _logits(params, x):
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return x @ params["head"]
+
+
 def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
              tp: Optional[str] = None):
     """Token ids [B, L_local] -> logits [B, L_local, vocab].
@@ -133,36 +167,89 @@ def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
     x = x + lax.dynamic_slice_in_dim(params["pos"], pos_offset, L, 0)[None]
 
     for layer in params["layers"]:
-        a = _layernorm(x, layer["ln1"]["g"], layer["ln1"]["b"])
-        if tp:
-            # Megatron f: upstream grads must SUM the per-head-shard
-            # cotangents (identity fwd, psum bwd).
-            a = tp_region_input(a, tp)
-        qkv = jnp.einsum("ble,ethd->blthd", a, layer["wqkv"])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = _project_qkv(layer, x, tp)
         scale = 1.0 / math.sqrt(q.shape[-1])
         if sp:
             attn = ring_attention(q, k, v, axis=sp, causal=True,
                                   scale=scale)
         else:
             attn = dot_product_attention(q, k, v, causal=True, scale=scale)
-        proj = jnp.einsum("blhd,hde->ble", attn, layer["wo"])
-        if tp:
-            # Row-parallel over the head shards (Megatron g: exact bwd).
-            proj = tp_region_output(proj, tp)
-        x = x + proj + layer["bo"]
+        x = _attn_out_residual(layer, attn, x, tp)
+        x = _ffn_residual(layer, x, tp)
 
-        m = _layernorm(x, layer["ln2"]["g"], layer["ln2"]["b"])
-        if tp:
-            m = tp_region_input(m, tp)
-            x = x + tp_mlp(m, layer["wup"], layer["bup"], layer["wdn"],
-                           layer["bdn"], axis=tp)
-        else:
-            h = jax.nn.gelu(m @ layer["wup"] + layer["bup"])
-            x = x + h @ layer["wdn"] + layer["bdn"]
+    return _logits(params, x)
 
-    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return x @ params["head"]
+
+def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
+              rng=None, tp: Optional[str] = None):
+    """Autoregressive generation with a static-shape KV cache.
+
+    TPU-idiomatic decode (beyond the reference, which predates LM
+    serving): the whole loop is ONE ``lax.scan`` — per-layer K/V caches
+    of fixed [B, Lmax, H, D] shape live in the carry and are written with
+    ``dynamic_update_slice``, each step attends the new token against the
+    masked cache, so the program compiles once regardless of prompt or
+    generation length. ``temperature=0`` is greedy argmax; otherwise
+    categorical sampling with ``rng``. Composes with tp (head-sharded
+    params inside shard_map; decode is forward-only). Returns the
+    generated ids [B, steps]."""
+    B, Lp = prompt.shape
+    Lmax = params["pos"].shape[0]
+    if Lp + steps > Lmax:
+        raise ValueError(
+            f"prompt ({Lp}) + steps ({steps}) exceeds the position table "
+            f"({Lmax})")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+
+    # Prefill: full forward over the prompt, capturing each layer's K/V
+    # into the fixed-size caches.
+    x = params["embed"][prompt] + params["pos"][None, :Lp]
+    caches = []
+    for layer in params["layers"]:
+        q, k, v = _project_qkv(layer, x, tp)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        pad = [(0, 0), (0, Lmax - Lp), (0, 0), (0, 0)]
+        caches.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
+        attn = dot_product_attention(q, k, v, causal=True, scale=scale)
+        x = _attn_out_residual(layer, attn, x, tp)
+        x = _ffn_residual(layer, x, tp)
+    logits_last = _logits(params, x[:, -1:])[:, 0]
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, i):
+        caches, logits, key = carry
+        key, sub = (jax.random.split(key) if key is not None
+                    else (None, None))
+        tok = pick(logits.astype(jnp.float32), sub)       # [B]
+        t = Lp + i                                        # absolute position
+        x = params["embed"][tok][:, None] + \
+            lax.dynamic_slice_in_dim(params["pos"], t, 1, 0)[None]
+        new_caches = []
+        for layer, cache in zip(params["layers"], caches):
+            q, k, v = _project_qkv(layer, x, tp)          # [B, 1, H, D]
+            ck = lax.dynamic_update_slice_in_dim(cache["k"], k, t, 1)
+            cv = lax.dynamic_update_slice_in_dim(cache["v"], v, t, 1)
+            new_caches.append({"k": ck, "v": cv})
+            scale = 1.0 / math.sqrt(q.shape[-1])
+            # The reference kernel with q_offset=t IS the cache mask
+            # (k_pos <= t; unwritten slots masked), keeping decode-step
+            # numerics identical to prefill/lm_apply.
+            attn = dot_product_attention(q, ck, cv, causal=True,
+                                         scale=scale, q_offset=t)
+            x = _attn_out_residual(layer, attn, x, tp)
+            x = _ffn_residual(layer, x, tp)
+        logits = _logits(params, x)[:, 0]
+        return (new_caches, logits, key), tok
+
+    key0 = rng if temperature > 0 else None
+    (_, _, _), toks = lax.scan(step, (caches, logits_last, key0),
+                               jnp.arange(steps))
+    return toks.T  # [B, steps]
 
 
 def next_token_nll(logits, tokens, sp: Optional[str] = None):
