@@ -252,6 +252,70 @@ def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
     return toks.T  # [B, steps]
 
 
+def stack_layers(params: Dict):
+    """Split the param pytree for pipeline parallelism: the per-layer
+    dicts stack into leading-axis arrays (shard with ``P(pp)`` so each
+    stage chip holds one block), everything else stays replicated.
+    Returns ``(rest, stacked_layers)``."""
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *params["layers"])
+    rest = {k: v for k, v in params.items() if k != "layers"}
+    return rest, stacked
+
+
+def lm_pp_specs(rest: Dict, stacked):
+    """Spec pytrees for :func:`lm_apply_pp` under shard_map: replicated
+    ``rest``, stage-sharded layers."""
+    from jax.sharding import PartitionSpec as P
+
+    return (jax.tree_util.tree_map(lambda _: P(), rest),
+            jax.tree_util.tree_map(lambda _: P("pp"), stacked))
+
+
+def lm_apply_pp(rest: Dict, stacked_layers, tokens, axis: str = "pp",
+                microbatches: int = 2, remat: bool = False):
+    """Pipeline-parallel forward: one transformer block per stage chip
+    (GPipe schedule over ``axis``, :mod:`horovod_tpu.parallel.pipeline`).
+
+    ``stacked_layers`` leaves carry a leading [n_layers] axis sharded
+    ``P(axis)`` — n_layers must equal the axis size. Embedding and head
+    run replicated on every stage chip; the batch splits into
+    ``microbatches``. Exactness (forward AND gradients, thanks to the
+    exact-VJP pipeline sum) vs the flat :func:`lm_apply` is pinned in
+    tests/test_parallel_lm.py."""
+    from horovod_tpu.parallel.pipeline import pipeline_apply
+
+    B, L = tokens.shape
+    x = rest["embed"][tokens] + rest["pos"][None, :L]
+    M = microbatches
+    xm = x.reshape(M, B // M, L, x.shape[-1])
+
+    def stage(layer, a):
+        q, k, v = _project_qkv(layer, a, None)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        attn = dot_product_attention(q, k, v, causal=True, scale=scale)
+        a = _attn_out_residual(layer, attn, a, None)
+        return _ffn_residual(layer, a, None)
+
+    out = pipeline_apply(stage, stacked_layers, xm, axis, remat=remat)
+    return _logits(rest, out.reshape(B, L, x.shape[-1]))
+
+
+def pp_reduce_rest_grads(g_rest: Dict, axis: str = "pp"):
+    """Gradient reduction for :func:`lm_apply_pp`'s replicated params.
+
+    The embedding/positional tables are consumed only through stage 0's
+    injection, so their per-chip grads are partial (full on the stage-0
+    chip, zero elsewhere) — SUM over the axis. The final layernorm and
+    head run replicated on the pipeline's broadcast output, so their
+    grads are already full and identical on every chip — left untouched.
+    Applied to grad values (never differentiated through)."""
+    out = dict(g_rest)
+    out["embed"] = lax.psum(g_rest["embed"], axis)
+    out["pos"] = lax.psum(g_rest["pos"], axis)
+    return out
+
+
 def next_token_nll(logits, tokens, sp: Optional[str] = None):
     """Mean next-token negative log-likelihood, sequence-shard aware.
 
