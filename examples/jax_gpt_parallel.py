@@ -108,6 +108,21 @@ def main():
     last = float(loss)
     log(f"nll: {first:.4f} -> {last:.4f}", file=sys.stderr)
     assert last < first * 0.5, (first, last)
+
+    # The trained model must have internalized the bigram table: greedy
+    # KV-cache decode from short prompts should emit each token's true
+    # successor chain (lm_decode runs single-device here; the params are
+    # replicated so any chip can serve).
+    prompts = tokens[:4, :2]
+    gen = np.asarray(plm.lm_decode(params, prompts, 12))
+    want = np.zeros_like(gen)
+    prev = np.asarray(prompts[:, -1])
+    for t in range(gen.shape[1]):
+        prev = succ[prev]
+        want[:, t] = prev
+    acc = float((gen == want).mean())
+    log(f"decode successor accuracy: {acc:.3f}", file=sys.stderr)
+    assert acc > 0.9, acc
     print(f"{last:.6f}")
 
 
