@@ -22,7 +22,10 @@ from horovod_tpu.parallel.tp import (  # noqa: F401
     row_parallel,
     shard_columns,
     shard_rows,
+    sum_across,
     tp_mlp,
+    tp_region_input,
+    tp_region_output,
 )
 from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from horovod_tpu.parallel.moe import moe_layer, top1_routing  # noqa: F401
