@@ -1,0 +1,96 @@
+"""Wire-byte invariants: the jaxpr the training step lowers to must move
+EXACTLY the communication volume the design claims (docs/concepts.md,
+docs/parallelism.md) — the structural counterpart of the reference's
+bytes/sec autotuner scoring (reference parameter_manager.h:211-217).
+
+* DP (fused DistributedOptimizer): one psum per bucket, total psum bytes
+  == total gradient bytes, plus scalar metric reductions — nothing else.
+* ZeRO-1: reduce-scatter + all-gather of the padded flat gradients, and
+  NO parameter-sized flat psum (that is the whole point).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu import models
+from horovod_tpu.common import state as _state
+
+COLLECTIVES = ("psum", "psum2", "all_gather", "reduce_scatter",
+               "psum_scatter", "all_to_all", "ppermute")
+
+
+def collect_collectives(jaxpr):
+    """[(primitive_name, operand_bytes)] over the whole jaxpr tree."""
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in COLLECTIVES:
+                nbytes = sum(v.aval.size * v.aval.dtype.itemsize
+                             for v in eqn.invars
+                             if hasattr(v.aval, "size"))
+                found.append((eqn.primitive.name, nbytes))
+            for v in eqn.params.values():
+                for item in (v if isinstance(v, (tuple, list)) else [v]):
+                    if hasattr(item, "jaxpr"):
+                        walk(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        walk(item)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+def _trace_step(zero):
+    model = models.MNISTNet()
+    state, opt = models.create_train_state(
+        jax.random.PRNGKey(0), model, optax.sgd(0.1, momentum=0.9),
+        jnp.zeros((1, 28, 28, 1)), zero=zero)
+    step = models.make_train_step(model, opt)
+    spec = models.state_partition_specs(state) if zero else P()
+    batch = {"image": jnp.zeros((16, 28, 28, 1)),
+             "label": jnp.zeros((16,), jnp.int32)}
+    tok = _state.set_spmd_axis("hvd")
+    try:
+        jaxpr = jax.make_jaxpr(jax.shard_map(
+            step, mesh=hvd.mesh(), in_specs=(spec, P("hvd")),
+            out_specs=(spec, P()), check_vma=False))(state, batch)
+    finally:
+        _state.reset_spmd_axis(tok)
+    grad_bytes = sum(l.size * 4
+                     for l in jax.tree_util.tree_leaves(state["params"]))
+    return collect_collectives(jaxpr), grad_bytes
+
+
+def test_dp_step_moves_exactly_gradient_bytes(hvd):
+    colls, grad_bytes = _trace_step(zero=False)
+    psums = [b for n, b in colls if n.startswith("psum")]
+    others = [(n, b) for n, b in colls if not n.startswith("psum")]
+    assert not others, f"unexpected collectives in the DP step: {others}"
+    # One fused bucket carrying every gradient byte + scalar metrics.
+    big = [b for b in psums if b > 64]
+    assert big == [grad_bytes], (big, grad_bytes)
+    assert all(b <= 64 for b in psums if b not in big)
+    assert len(psums) <= 4, psums
+
+
+def test_zero_step_reduce_scatters_instead_of_allreducing(hvd):
+    colls, grad_bytes = _trace_step(zero=True)
+    names = {n for n, _ in colls}
+    assert names & {"reduce_scatter", "psum_scatter"}, names
+    assert "all_gather" in names, names
+    # The flat parameter-sized allreduce must be GONE (scalars remain).
+    big_psums = [b for n, b in colls
+                 if n.startswith("psum") and b > 64]
+    assert not big_psums, big_psums
+    # Scatter + gather each carry the padded flat gradients (>= the raw
+    # gradient bytes, < 2x from padding on this tiny model).
+    rs = sum(b for n, b in colls if n in ("reduce_scatter", "psum_scatter"))
+    ag = sum(b for n, b in colls if n == "all_gather")
+    assert grad_bytes <= rs < 2 * grad_bytes, (rs, grad_bytes)
+    assert ag >= grad_bytes // 8, (ag, grad_bytes)  # gather of shards
