@@ -94,3 +94,25 @@ def test_zero_step_reduce_scatters_instead_of_allreducing(hvd):
     ag = sum(b for n, b in colls if n == "all_gather")
     assert grad_bytes <= rs < 2 * grad_bytes, (rs, grad_bytes)
     assert ag >= grad_bytes // 8, (ag, grad_bytes)  # gather of shards
+
+
+def test_ring_attention_rotates_exactly_local_kv_bytes(hvd):
+    """Long-context claim (docs/parallelism.md): ring attention's per-
+    rotation wire traffic is the LOCAL K/V block — constant per chip as
+    context grows with the mesh — and nothing else crosses the wire."""
+    import horovod_tpu.parallel as par
+
+    mesh = par.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, L_local, H, D = 2, 8, 2, 4
+    q = jnp.zeros((B, 4 * L_local, H, D))
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        lambda q, k, v: par.ring_attention(q, k, v, axis="sp", causal=True),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))(q, q, q)
+    colls = collect_collectives(jaxpr)
+    names = {n for n, _ in colls}
+    assert names == {"ppermute"}, colls
+    kv_local = 2 * B * L_local * H * D * 4  # K and V blocks, fp32
+    # The scan body appears once in the jaxpr: its two ppermutes (K, V)
+    # together carry exactly the local blocks each rotation.
+    assert sum(b for _, b in colls) == kv_local, (colls, kv_local)
