@@ -116,3 +116,60 @@ def test_ring_attention_rotates_exactly_local_kv_bytes(hvd):
     # The scan body appears once in the jaxpr: its two ppermutes (K, V)
     # together carry exactly the local blocks each rotation.
     assert sum(b for _, b in colls) == kv_local, (colls, kv_local)
+
+
+def test_tp_mlp_one_psum_of_activation_bytes(hvd):
+    """Megatron MLP claim (parallel/tp.py): column-parallel up costs no
+    comm; the whole block's wire traffic is ONE psum of the activation."""
+    import horovod_tpu.parallel as par
+
+    mesh = par.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    B, L, E, F = 2, 8, 16, 32
+    args = (jnp.zeros((B, L, E)), jnp.zeros((E, F)), jnp.zeros((F,)),
+            jnp.zeros((F, E)), jnp.zeros((E,)))
+    jx = jax.make_jaxpr(jax.shard_map(
+        lambda x, wu, bu, wd, bd: par.tp_mlp(x, wu, bu, wd, bd, axis="tp"),
+        mesh=mesh,
+        in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+        out_specs=P(), check_vma=False))(*args)
+    colls = collect_collectives(jx)
+    assert colls == [("psum", B * L * E * 4)], colls
+
+
+def test_ulysses_four_alltoalls_of_local_tensor_bytes(hvd):
+    """Ulysses SP: exactly four all_to_alls (q, k, v in; output back),
+    each carrying one local [B, L/P, H, D] tensor."""
+    import horovod_tpu.parallel as par
+
+    mesh = par.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, L_local, H, D = 2, 8, 4, 8
+    q = jnp.zeros((B, 4 * L_local, H, D))
+    jx = jax.make_jaxpr(jax.shard_map(
+        lambda q, k, v: par.ulysses_attention(q, k, v, axis="sp",
+                                              causal=True),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))(q, q, q)
+    colls = collect_collectives(jx)
+    tensor = B * L_local * H * D * 4
+    assert colls == [("all_to_all", tensor)] * 4, (colls, tensor)
+
+
+def test_moe_two_alltoalls_of_slot_bytes(hvd):
+    """Switch MoE: wire traffic is the dispatch + return all_to_alls of
+    the capacity-bounded expert slots — never the dense token set."""
+    import horovod_tpu.parallel as par
+
+    mesh = par.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    T_local, D, experts = 16, 8, 4
+    x = jnp.zeros((4 * T_local, D))
+    jx = jax.make_jaxpr(jax.shard_map(
+        lambda x, gw, ew: par.moe_layer(
+            x, gw, lambda p, t: t @ p["w"], ew, axis="ep",
+            capacity_factor=1.0),
+        mesh=mesh, in_specs=(P("ep"), P(), {"w": P("ep")}),
+        out_specs=P("ep"), check_vma=False))(
+        x, jnp.zeros((D, experts)), {"w": jnp.zeros((experts, D, D))})
+    colls = collect_collectives(jx)
+    capacity = T_local // experts  # ceil(T_local * cf / E), cf=1
+    slot_bytes = experts * capacity * D * 4
+    assert colls == [("all_to_all", slot_bytes)] * 2, (colls, slot_bytes)
