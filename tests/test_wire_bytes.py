@@ -173,3 +173,24 @@ def test_moe_two_alltoalls_of_slot_bytes(hvd):
     capacity = T_local // experts  # ceil(T_local * cf / E), cf=1
     slot_bytes = experts * capacity * D * 4
     assert colls == [("all_to_all", slot_bytes)] * 2, (colls, slot_bytes)
+
+
+def test_pipeline_hops_one_microbatch_per_tick(hvd):
+    """GPipe claim (parallel/pipeline.py): each tick ppermutes ONE
+    microbatch activation to the next stage; the only other traffic is
+    the final broadcast of the assembled outputs."""
+    import horovod_tpu.parallel as par
+
+    mesh = par.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    D, M, Bm = 8, 6, 2
+    ws = jnp.zeros((4, D, D))
+    x = jnp.zeros((M, Bm, D))
+    jx = jax.make_jaxpr(jax.shard_map(
+        lambda ws, x: par.pipeline_apply(
+            lambda w, a: jnp.tanh(a @ w), ws, x, "pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(ws, x)
+    colls = collect_collectives(jx)
+    micro = Bm * D * 4
+    assert colls == [("ppermute", micro), ("psum", M * Bm * D * 4)], (
+        colls, micro)
