@@ -201,6 +201,15 @@ class TestStallDetection:
         assert "missing ranks: 1" in outs[0], outs[0][-2000:]
 
 
+class TestFusionKnob:
+    def test_fusion_disabled_still_correct(self):
+        """HOROVOD_FUSION_THRESHOLD=0 disables fusion (one collective per
+        tensor, reference operations.cc semantics); the volume scenario's
+        64 concurrent small tensors must still reduce to closed form."""
+        env = {"HOROVOD_FUSION_THRESHOLD": "0"}
+        _spawn(2, "collectives", extra_env={0: dict(env), 1: dict(env)})
+
+
 class TestHierarchical:
     """Two-level (local ring + cross ring) collectives on the native lane
     (reference hierarchical allreduce operations.cc:1284-1436, hierarchical
