@@ -16,10 +16,11 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _run_bench(*args, timeout=600):
+def _run_bench(*args, timeout=600, extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env["HVD_TPU_FORCE_CPU"] = "1"
+    env.update(extra_env or {})
     proc = subprocess.run(
         [sys.executable, str(REPO / "bench.py"), *args],
         env=env, cwd=str(REPO), capture_output=True, text=True,
@@ -54,6 +55,39 @@ def test_lm_lane_contract():
     assert out["value"] > 0
     assert out["vs_baseline"] is None
     assert "tokens/sec" in proc.stderr
+
+
+def test_hung_backend_degrades_to_error_json():
+    """A hang (tunnel down, jax.devices() never returns) must not leave a
+    stack trace as the official record: the supervisor times the attempt
+    out, retries, then emits the contract line with an "error" field and
+    rc=0. Simulated by an attempt timeout shorter than the jax import."""
+    out, proc = _run_bench(
+        "--batch-size", "2", "--image-size", "64",
+        extra_env={"HVD_BENCH_ATTEMPTS": "2",
+                   "HVD_BENCH_ATTEMPT_TIMEOUT": "1",
+                   "HVD_BENCH_BACKOFF": "0.1"})
+    assert out["metric"] == "resnet50_img_per_sec_per_chip"
+    assert out["unit"] == "img/sec/chip"
+    assert out["value"] is None
+    assert "timeout" in out["error"]
+    assert proc.stderr.count("attempt") >= 2
+
+
+def test_crashing_child_degrades_to_error_json():
+    """A deterministic in-child failure (unknown model) is NOT retried —
+    the child signals it via a sentinel exit code, the supervisor fails
+    fast and still yields the parseable contract line, rc=0."""
+    out, proc = _run_bench(
+        "--model", "no_such_model",
+        extra_env={"HVD_BENCH_ATTEMPTS": "3",
+                   "HVD_BENCH_BACKOFF": "0.1"})
+    assert out["metric"] == "no_such_model_img_per_sec_per_chip"
+    assert out["value"] is None
+    assert "deterministic" in out["error"]
+    # Fail-fast: exactly one attempt despite HVD_BENCH_ATTEMPTS=3.
+    assert proc.stderr.count("attempt 1/") == 1
+    assert "attempt 2/" not in proc.stderr
 
 
 def test_zero_composes_with_lm_lane():
