@@ -115,7 +115,13 @@ def bench_image(args, log):
     n = hvd.size()
     batch_size = args.batch_size if args.batch_size is not None else 64
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
-    model = models.build(args.model, num_classes=1000, dtype=dtype)
+    build_kwargs = {}
+    if args.fused_bn:
+        if not args.model.lower().startswith("resnet"):
+            raise ValueError("--fused-bn applies to the ResNet family only")
+        build_kwargs["fused_bn"] = True
+    model = models.build(args.model, num_classes=1000, dtype=dtype,
+                         **build_kwargs)
     rng = jax.random.PRNGKey(42)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
     sgd = optax.sgd(
@@ -321,6 +327,12 @@ def main():
                         help="disable bfloat16 compute")
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1 optimizer-state sharding over the mesh")
+    parser.add_argument("--fused-bn", action="store_true",
+                        help="ResNet family: compute BN statistics in the "
+                             "1x1-conv matmul epilogue (Pallas kernel, "
+                             "ops/conv_bn.py) instead of a separate "
+                             "reduction pass — attacks the convert_reduce "
+                             "step-time share identified in PERF.md")
     parser.add_argument("--bf16-momentum", action="store_true",
                         help="keep SGD momentum in bfloat16: halves the "
                              "optimizer-state HBM traffic of the update "

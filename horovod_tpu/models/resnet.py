@@ -15,6 +15,12 @@ translated from torchvision:
   global batch / N; passing ``axis_name="hvd"`` syncs moments over the ICI
   (the reference had no sync-BN; each worker normalized locally — that is
   the default here too).
+* **Fused conv+BN-statistics option** (``fused_bn=True``) — every conv+BN
+  pair goes through one :class:`ConvBN` module; the 1x1 convolutions (36
+  of ResNet-50's 53) then compute their channel statistics in the matmul
+  epilogue via the Pallas kernel in :mod:`horovod_tpu.ops.conv_bn`,
+  eliminating the separate statistics read over each conv output that
+  profiling showed to be the largest single step-time sink (PERF.md).
 * Static shapes and no Python control flow in the forward pass: one XLA
   program, fully fusable.
 """
@@ -22,34 +28,135 @@ translated from torchvision:
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.conv_bn import conv1x1_bn_stats, fits_fused
 
 ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """Bias-free convolution + BatchNorm as ONE module.
+
+    Keeping the pair in one module lets the 1x1 case run the fused Pallas
+    matmul+statistics kernel (``fuse=True``) while every other case takes
+    the standard XLA conv + reduction path — with an IDENTICAL parameter
+    tree, so fused-vs-unfused exactness is testable with shared weights
+    (tests/test_conv_bn.py).
+
+    Parameters/variables: ``kernel`` (fp32, cast to ``dtype`` for
+    compute), BN ``scale``/``bias`` (fp32), running ``batch_stats``
+    ``mean``/``var`` (fp32). Statistics always use the fast-variance form
+    ``E[y^2] - E[y]^2`` so both paths consume the same moments.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None
+    scale_init: Callable = nn.initializers.ones_init()
+    fuse: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, cin, self.features), jnp.float32)
+        scale = self.param(
+            "scale", self.scale_init, (self.features,), jnp.float32)
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,),
+            jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32))
+
+        x = jnp.asarray(x, self.dtype)
+        k = jnp.asarray(kernel, self.dtype)
+
+        def conv(inputs):
+            return lax.conv_general_dilated(
+                inputs, k, window_strides=self.strides,
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=self.dtype)
+
+        if self.use_running_average:
+            y = conv(x)
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            can_fuse = (
+                self.fuse
+                and (kh, kw) == (1, 1)
+                and isinstance(self.padding, str)
+                and fits_fused(
+                    (x.shape[0] * x.shape[1] * x.shape[2])
+                    // (self.strides[0] * self.strides[1]),
+                    cin, self.features,
+                    itemsize=jnp.dtype(self.dtype).itemsize)
+            )
+            if can_fuse:
+                y, s1, s2 = conv1x1_bn_stats(x, k, self.strides)
+                n = jnp.asarray(
+                    y.shape[0] * y.shape[1] * y.shape[2], jnp.float32)
+                if self.axis_name is not None:
+                    s1 = lax.psum(s1, self.axis_name)
+                    s2 = lax.psum(s2, self.axis_name)
+                    n = lax.psum(n, self.axis_name)
+                mean = s1 / n
+                var = s2 / n - mean * mean
+            else:
+                y = conv(x)
+                yf = y.astype(jnp.promote_types(jnp.float32, y.dtype))
+                mean = jnp.mean(yf, axis=(0, 1, 2))
+                msq = jnp.mean(yf * yf, axis=(0, 1, 2))
+                if self.axis_name is not None:
+                    mean = lax.pmean(mean, self.axis_name)
+                    msq = lax.pmean(msq, self.axis_name)
+                var = msq - mean * mean
+            if not self.is_initializing() and self.is_mutable_collection(
+                    "batch_stats"):
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        mul = scale * lax.rsqrt(var + self.epsilon)
+        add = bias - mean * mul
+        return y * mul.astype(self.dtype) + add.astype(self.dtype)
 
 
 class ResNetBlock(nn.Module):
     """Basic 3x3+3x3 residual block (ResNet-18/34)."""
 
     filters: int
-    conv: ModuleDef
-    norm: ModuleDef
+    conv_bn: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
-        y = self.norm()(y)
+        y = self.conv_bn(self.filters, (3, 3), self.strides)(x)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        y = self.conv_bn(
+            self.filters, (3, 3),
+            scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(residual)
-            residual = self.norm(name="norm_proj")(residual)
+            residual = self.conv_bn(
+                self.filters, (1, 1), self.strides, name="proj")(residual)
         return self.act(residual + y)
 
 
@@ -57,28 +164,27 @@ class BottleneckResNetBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck block (ResNet-50/101/152)."""
 
     filters: int
-    conv: ModuleDef
-    norm: ModuleDef
+    conv_bn: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
+        y = self.conv_bn(self.filters, (1, 1))(x)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = self.norm()(y)
+        y = self.conv_bn(self.filters, (3, 3), self.strides)(y)
         y = self.act(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
         # Zero-init the last norm scale so each block starts as identity:
         # standard large-batch ResNet recipe (Goyal et al.), which the
         # reference applied via its LR-warmup callbacks instead.
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        y = self.conv_bn(
+            self.filters * 4, (1, 1),
+            scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
-            residual = self.norm(name="norm_proj")(residual)
+            residual = self.conv_bn(
+                self.filters * 4, (1, 1), self.strides,
+                name="proj")(residual)
         return self.act(residual + y)
 
 
@@ -86,6 +192,9 @@ class ResNet(nn.Module):
     """ImageNet-style ResNet over NHWC inputs.
 
     ``axis_name`` enables cross-replica BatchNorm moments under SPMD.
+    ``fused_bn`` routes the 1x1 conv+BN pairs through the Pallas fused
+    statistics kernel (training mode only; eval always uses the plain
+    conv since running statistics need no reduction).
     """
 
     stage_sizes: Sequence[int]
@@ -95,21 +204,23 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
     axis_name: Optional[str] = None
+    fused_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(
-            nn.BatchNorm,
+        conv_bn = partial(
+            ConvBN,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
             axis_name=self.axis_name if train else None,
+            fuse=self.fused_bn,
         )
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
-        x = norm(name="bn_init")(x)
+        x = conv_bn(
+            self.num_filters, (7, 7), (2, 2),
+            padding=[(3, 3), (3, 3)], name="stem")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, block_size in enumerate(self.stage_sizes):
@@ -118,8 +229,7 @@ class ResNet(nn.Module):
                 x = self.block_cls(
                     self.num_filters * 2**i,
                     strides=strides,
-                    conv=conv,
-                    norm=norm,
+                    conv_bn=conv_bn,
                     act=self.act,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,216 @@
+"""Fused 1x1-conv (matmul) + BatchNorm-statistics Pallas kernel.
+
+The ResNet-class benchmark step is memory-bound: profiling (PERF.md) puts
+~34% of device time in ``convert_reduce`` fusions — the bf16->f32
+converts feeding the BatchNorm statistics reductions. The forward half of
+that cost is a full HBM re-read of every conv output just to compute its
+channel mean/variance. ResNet-50's bottleneck blocks make 36 of its 53
+convolutions 1x1 — i.e. plain matmuls on the MXU — so this kernel folds
+the statistics into the matmul epilogue: while each output tile is still
+in VMEM it accumulates per-channel ``sum(y)`` and ``sum(y^2)`` into a
+grid-resident accumulator, eliminating the separate statistics pass over
+~0.9 GB of activations per forward step.
+
+The reference framework has no counterpart op (its benchmark model was
+stock torchvision ResNet-50, reference
+examples/pytorch_synthetic_benchmark.py:24-35); this is TPU-first perf
+work on the same workload, not a port.
+
+Gradient story (exact, not approximate): the public op returns
+``(y, s1, s2)`` and the BN apply happens outside in regular jnp, so
+autodiff needs the VJP of the map ``x, w -> (y, s1, s2)`` where
+``s1 = sum_rows(cast(y)), s2 = sum_rows(cast(y)^2)``. With incoming
+cotangents ``(dy, ds1, ds2)`` the chain rule collapses to a single
+per-element total
+
+    dy_total = dy + ds1[c] + 2 * y[r, c] * ds2[c]
+
+followed by the standard matmul gradients ``dx = dy_total @ w^T`` and
+``dw = x^T @ dy_total`` — the same contractions XLA runs for the unfused
+conv, so the backward pays no extra passes beyond one fused elementwise
+read of ``y``. Exactness vs the unfused composition is pinned in
+tests/test_conv_bn.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Keep the whole [K, N] weight + one [block_m, K] input tile + the f32
+# accumulator resident in VMEM; fall back to the unfused path when the
+# estimate exceeds this budget (v4/v5 VMEM is 16 MB; leave headroom for
+# Mosaic's own buffers).
+_VMEM_BUDGET_BYTES = 13 * 1024 * 1024
+
+_BLOCK_M_CANDIDATES = (512, 448, 256, 128, 64, 32, 16, 8)
+
+
+def _pick_block_m(m: int) -> Optional[int]:
+    for bm in _BLOCK_M_CANDIDATES:
+        if m % bm == 0:
+            return bm
+    return None
+
+
+def fits_fused(m: int, k: int, n: int, itemsize: int = 2) -> bool:
+    """Whether the fused kernel's working set fits the VMEM budget."""
+    bm = _pick_block_m(m) or 256
+    weight = k * n * itemsize
+    x_tile = bm * k * itemsize
+    y_tile = bm * n * itemsize
+    acc = bm * n * 4
+    return weight + x_tile + y_tile + acc <= _VMEM_BUDGET_BYTES
+
+
+def _fused_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    """One M-tile: matmul on the MXU, stats in the epilogue.
+
+    s1/s2 use a constant index map, so their [1, N] block stays resident
+    in VMEM across the whole (sequential) grid — the classic Pallas
+    reduction-accumulator pattern.
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    # f32 MXU accumulation for <=32-bit inputs; f64 only exists for the
+    # float64 exactness probes in CI (TPUs have no f64 path).
+    acc_t = (jnp.float64 if x_ref.dtype == jnp.float64 else jnp.float32)
+    acc = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=acc_t)
+    y_ref[...] = acc.astype(y_ref.dtype)
+    # Statistics over the ROUNDED output (what the unfused path sees when
+    # it upcasts the stored bf16 activation), so fused and unfused BN
+    # consume identical moments.
+    yr = y_ref[...].astype(s1_ref.dtype)
+    ps1 = jnp.sum(yr, axis=0, keepdims=True)
+    ps2 = jnp.sum(yr * yr, axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = ps1
+        s2_ref[...] = ps2
+
+    @pl.when(i > 0)
+    def _accum():
+        s1_ref[...] += ps1
+        s2_ref[...] += ps2
+
+
+def _fused_forward(x, w, interpret: bool):
+    """x [M, K], w [K, N] -> (y [M, N] x.dtype, s1 [N] f32, s2 [N] f32)."""
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    n = w.shape[1]
+    # Stats accumulate in f32 (f64 only under the CI exactness probes).
+    stats_t = jnp.promote_types(jnp.float32, x.dtype)
+    bm = _pick_block_m(m)
+    pad = 0
+    if bm is None:
+        # Irregular row counts: zero rows contribute nothing to s1/s2 and
+        # their y rows are sliced off below.
+        bm = 256
+        pad = (-m) % bm
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    # Under shard_map with check_vma=True (the default, kept on) Pallas
+    # outputs must declare which mesh axes they vary over, and both dot
+    # operands must agree — a replicated weight meeting a batch-sharded
+    # activation needs an explicit pvary.
+    try:
+        x_vma = jax.typeof(x).vma
+        w_vma = jax.typeof(w).vma
+    except (AttributeError, TypeError):
+        x_vma = w_vma = frozenset()
+    if x_vma - w_vma:
+        w = jax.lax.pvary(w, tuple(x_vma - w_vma))
+    if w_vma - x_vma:
+        x = jax.lax.pvary(x, tuple(w_vma - x_vma))
+    vma = x_vma | w_vma
+
+    def out_struct(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+    y, s1, s2 = pl.pallas_call(
+        _fused_kernel,
+        grid=((m + pad) // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            out_struct((m + pad, n), x.dtype),
+            out_struct((1, n), stats_t),
+            out_struct((1, n), stats_t),
+        ],
+        interpret=interpret,
+    )(x, w)
+    if pad:
+        y = y[:m]
+    return y, s1[0], s2[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def matmul_bn_stats(x, w, interpret: bool = False):
+    """Fused ``y = x @ w`` plus channel statistics ``(sum y, sum y^2)``.
+
+    The statistics are computed over the rounded (storage-dtype) ``y`` in
+    one pass while each tile is VMEM-resident. ``interpret=True`` runs
+    the same kernel through the Pallas interpreter (CPU CI).
+    """
+    return _fused_forward(x, w, interpret)
+
+
+def _matmul_bn_stats_fwd(x, w, interpret):
+    y, s1, s2 = _fused_forward(x, w, interpret)
+    return (y, s1, s2), (x, w, y)
+
+
+def _matmul_bn_stats_bwd(interpret, res, cts):
+    x, w, y = res
+    dy, ds1, ds2 = cts
+    # Collapse the three cotangent paths into one elementwise total (see
+    # module docstring); XLA fuses the broadcasts + add with the matmul
+    # operand preparation.
+    acc_t = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    dy_total = (dy.astype(acc_t)
+                + ds1[None, :].astype(acc_t)
+                + 2.0 * y.astype(acc_t) * ds2[None, :].astype(acc_t))
+    dy_total = dy_total.astype(x.dtype)
+    dx = jnp.dot(dy_total, w.T, preferred_element_type=acc_t)
+    dw = jnp.dot(x.T, dy_total, preferred_element_type=acc_t)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+matmul_bn_stats.defvjp(_matmul_bn_stats_fwd, _matmul_bn_stats_bwd)
+
+
+def conv1x1_bn_stats(x, w, strides: Tuple[int, int] = (1, 1),
+                     interpret: Optional[bool] = None):
+    """1x1 NHWC convolution with fused BN statistics.
+
+    x [B, H, W, C_in], w [1, 1, C_in, C_out] (or [C_in, C_out]) ->
+    (y [B, H', W', C_out], s1 [C_out], s2 [C_out]).
+
+    A strided 1x1 conv only ever reads the stride-subsampled input, so it
+    is the same matmul over ``x[:, ::sh, ::sw]`` — the slice is a strided
+    HBM read of 1/(sh*sw) of the data, not an extra pass.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if w.ndim == 4:
+        assert w.shape[:2] == (1, 1), w.shape
+        w = w[0, 0]
+    sh, sw = strides
+    if (sh, sw) != (1, 1):
+        x = x[:, ::sh, ::sw, :]
+    b, h, wd, c = x.shape
+    y, s1, s2 = matmul_bn_stats(x.reshape(b * h * wd, c), w, interpret)
+    return y.reshape(b, h, wd, -1), s1, s2

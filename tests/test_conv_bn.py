@@ -1,0 +1,286 @@
+"""Exactness tests for the fused 1x1-conv + BN-statistics Pallas kernel.
+
+The fused path is a performance schedule, not a different computation:
+every test pins it against the unfused composition (XLA conv + separate
+statistics reductions) on identical weights — values, statistics, AND
+gradients. The reference's analogue is its closed-form collective
+assertions (reference test/test_tensorflow.py:77-106); here the closed
+form is the unfused graph itself. Runs on CPU via the Pallas interpreter
+(the kernel auto-selects interpret mode off-TPU).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.models.resnet import ConvBN
+from horovod_tpu.ops.conv_bn import (
+    conv1x1_bn_stats,
+    fits_fused,
+    matmul_bn_stats,
+)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return jax.random.PRNGKey(7)
+
+
+def _unfused(x, w):
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+
+
+class TestKernel:
+    def test_matches_unfused_f32(self, rng):
+        k1, k2 = jax.random.split(rng)
+        x = jax.random.normal(k1, (256, 96), jnp.float32)
+        w = jax.random.normal(k2, (96, 128), jnp.float32)
+        y, s1, s2 = matmul_bn_stats(x, w, True)
+        yr, s1r, s2r = _unfused(x, w)
+        np.testing.assert_allclose(y, yr, rtol=1e-6)
+        np.testing.assert_allclose(s1, s1r, rtol=1e-5)
+        np.testing.assert_allclose(s2, s2r, rtol=1e-5)
+
+    def test_matches_unfused_bf16(self, rng):
+        k1, k2 = jax.random.split(rng)
+        x = jax.random.normal(k1, (512, 64), jnp.bfloat16)
+        w = jax.random.normal(k2, (64, 64), jnp.bfloat16)
+        y, s1, s2 = matmul_bn_stats(x, w, True)
+        yr, s1r, s2r = _unfused(x, w)
+        # Stats are accumulated over the SAME rounded bf16 y in both
+        # paths; only summation order differs (tile-wise vs flat).
+        np.testing.assert_allclose(
+            y.astype(np.float32), yr.astype(np.float32), rtol=1e-2)
+        np.testing.assert_allclose(s1, s1r, rtol=1e-3, atol=1e-1)
+        np.testing.assert_allclose(s2, s2r, rtol=1e-3, atol=1e-1)
+
+    def test_irregular_rows_padding_path(self, rng):
+        """M with no aligned divisor exercises the zero-pad branch; the
+        padded rows must not pollute the statistics."""
+        k1, k2 = jax.random.split(rng)
+        x = jax.random.normal(k1, (100, 32), jnp.float32)
+        w = jax.random.normal(k2, (32, 16), jnp.float32)
+        y, s1, s2 = matmul_bn_stats(x, w, True)
+        yr, s1r, s2r = _unfused(x, w)
+        assert y.shape == (100, 16)
+        np.testing.assert_allclose(y, yr, rtol=1e-6)
+        np.testing.assert_allclose(s1, s1r, rtol=1e-5)
+        np.testing.assert_allclose(s2, s2r, rtol=1e-5, atol=1e-4)
+
+    def test_gradients_match_unfused(self, rng):
+        """The custom VJP (dy_total = dy + ds1 + 2y*ds2 collapsed into
+        the standard matmul gradients) vs autodiff of the unfused graph,
+        through a BN-like consumer so all three cotangent paths are
+        exercised."""
+        k1, k2 = jax.random.split(rng)
+        x = jax.random.normal(k1, (128, 48), jnp.float32)
+        w = jax.random.normal(k2, (48, 32), jnp.float32) * 0.1
+
+        def consume(y, s1, s2):
+            n = y.shape[0]
+            mean = s1 / n
+            var = s2 / n - mean * mean
+            norm = (y - mean) * lax.rsqrt(var + 1e-5)
+            return jnp.sum(norm**2) + 0.3 * jnp.sum(jnp.sin(s1)) \
+                + 0.1 * jnp.sum(s2**0.5)
+
+        def fused_loss(x, w):
+            return consume(*matmul_bn_stats(x, w, True))
+
+        def unfused_loss(x, w):
+            return consume(*_unfused(x, w))
+
+        gx_f, gw_f = jax.grad(fused_loss, argnums=(0, 1))(x, w)
+        gx_r, gw_r = jax.grad(unfused_loss, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(gx_f, gx_r, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(gw_f, gw_r, rtol=1e-4, atol=1e-5)
+
+    def test_conv1x1_strided_matches_xla_conv(self, rng):
+        """Strided 1x1 == matmul over the stride-subsampled input."""
+        k1, k2 = jax.random.split(rng)
+        x = jax.random.normal(k1, (2, 8, 8, 24), jnp.float32)
+        w = jax.random.normal(k2, (1, 1, 24, 40), jnp.float32)
+        y, s1, s2 = conv1x1_bn_stats(x, w, strides=(2, 2), interpret=True)
+        yr = lax.conv_general_dilated(
+            x, w, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
+        yf = yr.reshape(-1, 40)
+        np.testing.assert_allclose(s1, jnp.sum(yf, 0), rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(
+            s2, jnp.sum(yf * yf, 0), rtol=1e-5, atol=1e-4)
+
+    def test_fits_fused_budget(self):
+        assert fits_fused(200704, 256, 64)          # resnet50 stage-1 conv1
+        assert fits_fused(3136, 1024, 2048)         # stage-4 projection
+        assert not fits_fused(4096, 8192, 8192)     # way past VMEM
+
+
+def _init_convbn(rng, module, x):
+    return module.init(rng, x)
+
+
+class TestConvBNModule:
+    def _paths(self, rng, dtype, kernel=(1, 1), strides=(1, 1), axis=None):
+        kw = dict(features=12, kernel_size=kernel, strides=strides,
+                  dtype=dtype, axis_name=axis)
+        return ConvBN(fuse=False, **kw), ConvBN(fuse=True, **kw)
+
+    def test_fused_equals_unfused_f32(self, rng):
+        unfused, fused = self._paths(rng, jnp.float32)
+        x = jax.random.normal(rng, (4, 6, 6, 8), jnp.float32)
+        variables = _init_convbn(rng, unfused, x)
+        out_u, stats_u = unfused.apply(
+            variables, x, mutable=["batch_stats"])
+        out_f, stats_f = fused.apply(variables, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(out_f, out_u, rtol=1e-5, atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6),
+            stats_f, stats_u)
+
+    def _grad_pair(self, rng, dtype):
+        unfused, fused = self._paths(rng, dtype)
+        x = jax.random.normal(rng, (4, 6, 6, 8), dtype)
+        variables = _init_convbn(rng, unfused, x)
+
+        def loss(params, module):
+            out, _ = module.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"])
+            return jnp.sum(out.astype(dtype) ** 2)
+
+        g_u = jax.grad(loss)(variables["params"], unfused)
+        g_f = jax.grad(loss)(variables["params"], fused)
+        return g_f, g_u
+
+    def test_fused_grads_equal_unfused_f64_exact(self, rng):
+        """The strong statement: in f64 (stats dtype follows the input)
+        the fused VJP and the unfused autodiff are the same math — any
+        systematic error in the collapsed cotangent formula would show
+        here far above 1e-9."""
+        with jax.enable_x64():
+            g_f, g_u = self._grad_pair(rng, jnp.float64)
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=1e-9, atol=1e-9),
+                g_f, g_u)
+
+    def test_fused_grads_close_unfused_f32(self, rng):
+        """f32: stats summation order differs between the tile-wise
+        kernel and the flat reduction, and BN's scale-invariance makes
+        the kernel gradient a near-total cancellation — so f32 agreement
+        is inherently loose (the f64 test above pins the math)."""
+        g_f, g_u = self._grad_pair(rng, jnp.float32)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-2, atol=5e-4),
+            g_f, g_u)
+
+    def test_eval_mode_ignores_fuse_flag(self, rng):
+        """Eval uses running statistics — no reduction to fuse; both
+        flags must produce the identical plain-conv graph."""
+        kw = dict(features=5, kernel_size=(1, 1), dtype=jnp.float32,
+                  use_running_average=True)
+        x = jax.random.normal(rng, (2, 4, 4, 3), jnp.float32)
+        variables = _init_convbn(rng, ConvBN(fuse=False, **kw), x)
+        out_u = ConvBN(fuse=False, **kw).apply(variables, x)
+        out_f = ConvBN(fuse=True, **kw).apply(variables, x)
+        np.testing.assert_allclose(out_f, out_u, rtol=0, atol=0)
+
+    def test_sync_bn_fused_matches_unfused_on_mesh(self, hvd, rng):
+        """Cross-replica statistics: fused psum(s1/s2/n) must equal the
+        unfused pmean path under shard_map over the 8-device mesh."""
+        from jax import shard_map
+
+        unfused, fused = self._paths(
+            rng, jnp.float32, axis="hvd")
+        x = jax.random.normal(rng, (16, 4, 4, 6), jnp.float32)
+        variables = _init_convbn(
+            rng, ConvBN(features=12, kernel_size=(1, 1),
+                        dtype=jnp.float32), x[:2])
+        mesh = hvd.mesh()
+
+        def run(module):
+            def f(xs):
+                out, stats = module.apply(
+                    variables, xs, mutable=["batch_stats"])
+                return out, stats
+            # check_vma=False is REQUIRED here, not a convenience: the
+            # Pallas interpreter's grid loop carries output buffers
+            # without vma, so the varying-axes check trips inside
+            # pallas_call (the JAX error itself prescribes this
+            # workaround). Scoped to this shard_map only.
+            return jax.jit(shard_map(
+                f, mesh=mesh, in_specs=P("hvd"),
+                out_specs=(P("hvd"), P()), check_vma=False))(x)
+
+        out_u, stats_u = run(unfused)
+        out_f, stats_f = run(fused)
+        np.testing.assert_allclose(out_f, out_u, rtol=1e-5, atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6),
+            stats_f, stats_u)
+
+
+class TestFusedResNet:
+    def test_resnet50_style_step_fused_vs_unfused(self, rng):
+        """End-to-end: a tiny bottleneck ResNet (every ConvBN flavor —
+        stem, 1x1s, strided 3x3, strided projection) computes one loss +
+        gradient with fused_bn on/off from identical params. Run in f64
+        so agreement is exact-math tight (see the ConvBN-level tests for
+        why f32 agreement is inherently loose)."""
+        from horovod_tpu.models.resnet import (
+            BottleneckResNetBlock, ResNet)
+
+        def build(fused):
+            return ResNet(stage_sizes=[1, 1],
+                          block_cls=BottleneckResNetBlock,
+                          num_classes=5, num_filters=8,
+                          dtype=jnp.float64, fused_bn=fused)
+
+        with jax.enable_x64():
+            x = jax.random.normal(rng, (4, 16, 16, 3), jnp.float64)
+            labels = jax.random.randint(rng, (4,), 0, 5)
+            variables = build(False).init(rng, x)
+
+            def loss_fn(params, model):
+                logits, _ = model.apply(
+                    {"params": params,
+                     "batch_stats": variables["batch_stats"]},
+                    x, mutable=["batch_stats"])
+                onehot = jax.nn.one_hot(labels, 5)
+                return -jnp.mean(
+                    jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+            lu, gu = jax.value_and_grad(loss_fn)(
+                variables["params"], build(False))
+            lf, gf = jax.value_and_grad(loss_fn)(
+                variables["params"], build(True))
+            np.testing.assert_allclose(lf, lu, rtol=1e-9)
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=1e-7, atol=1e-9),
+                gf, gu)
+
+    def test_param_tree_identical_between_modes(self, rng):
+        from horovod_tpu.models.resnet import ResNet50
+
+        x = jnp.zeros((1, 32, 32, 3))
+        tu = jax.eval_shape(
+            functools.partial(
+                ResNet50(num_classes=3, fused_bn=False).init, rng), x)
+        tf = jax.eval_shape(
+            functools.partial(
+                ResNet50(num_classes=3, fused_bn=True).init, rng), x)
+        assert jax.tree_util.tree_structure(tu) == \
+            jax.tree_util.tree_structure(tf)
