@@ -151,6 +151,23 @@ def test_graft_entry_multichip_subprocess():
     assert "MULTICHIP_OK" in proc.stdout
 
 
+def test_graft_entry_gate_catches_broken_conjugate(hvd, monkeypatch):
+    """The driver gate's closed-form asserts must catch a
+    gradient-only bug: replace the Megatron ``g`` conjugate with a raw
+    psum (identical forward, double-psum backward — the classic
+    shard_map transpose gotcha) and the tp x sp x dp lane has to fail
+    its dense-reference check, NOT sail through on a finite loss."""
+    import __graft_entry__ as g
+    from jax import lax
+
+    from horovod_tpu.parallel import tp as tp_mod
+
+    monkeypatch.setattr(tp_mod, "tp_region_output",
+                        lambda x, axis: lax.psum(x, axis))
+    with pytest.raises(AssertionError):
+        g._dryrun_tp_sp_dp(8)
+
+
 def test_eval_step(hvd, rng):
     model = models.MNISTNet()
     state, _ = models.create_train_state(
