@@ -95,7 +95,7 @@ def main():
 
     fn = jax.jit(jax.shard_map(
         step, mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=(specs, P()), check_vma=False),
+        out_specs=(specs, P())),
         donate_argnums=(0,))
 
     first = last = None

@@ -73,8 +73,7 @@ def main():
         return model.init(rng, tokens, train=False, pos_offset=offset)
 
     variables = jax.jit(jax.shard_map(
-        init_shard, mesh=mesh, in_specs=P(None, "sp"), out_specs=P(),
-        check_vma=False))(tokens)
+        init_shard, mesh=mesh, in_specs=P(None, "sp"), out_specs=P()))(tokens)
     params = variables["params"]
     opt = optax.adam(1e-3)
     opt_state = opt.init(params)
@@ -102,8 +101,7 @@ def main():
 
     fn = jax.jit(jax.shard_map(step, mesh=mesh,
                                in_specs=(P(), P(), P(None, "sp")),
-                               out_specs=(P(), P(), P()),
-                               check_vma=False))
+                               out_specs=(P(), P(), P())))
 
     if args.smoke:
         # Exactness: ring == dense on the same weights (first forward).
@@ -117,8 +115,7 @@ def main():
             lambda t: model.apply(
                 {"params": params}, t, train=False,
                 pos_offset=jax.lax.axis_index("sp") * L_local),
-            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
-            check_vma=False))(tokens)
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp")))(tokens)
         err = float(jnp.max(jnp.abs(dense_logits - ring_logits)))
         log(f"ring vs dense max |err| = {err:.2e}")
         assert err < 1e-3, err

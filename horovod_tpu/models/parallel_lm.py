@@ -407,24 +407,35 @@ def lm_apply_moe(params: Dict, tokens, ep: Optional[str] = None,
 
 
 def moe_reduce_grads(grads: Dict, axis: str = "ep"):
-    """Gradient reduction for :func:`lm_apply_moe` under a global-mean
-    loss (per-chip mean nll, pmean'd):
+    """Gradient reduction for :func:`lm_apply_moe`.
 
-    * replicated leaves (embed, attention, gates, head): each chip's
-      grad covers only its own tokens' loss — MEAN over the axis;
+    Loss contract: the caller differentiates the PER-CHIP mean nll over
+    its token shard (no collective inside the loss — rank-varying), and
+    the global objective is the mean of those terms. Then:
+
+    * replicated leaves (embed, attention, gates, head): MEAN over the
+      axis (vma-aware: typed grads arrive as the auto-summed total and
+      only need the /n);
     * expert shards: the all_to_all backward already returned every
       chip's contribution to this chip's experts, so the grad is the
       data-complete SUM — divide by the axis size (NO collective: a
       pmean/psum would mix gradients of *different* experts)."""
-    n = lax.axis_size(axis)
-    out = {k: jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), v)
+    from horovod_tpu.parallel._vma import (
+        reduce_cotangent,
+        scale_sharded_cotangent,
+    )
+
+    out = {k: jax.tree_util.tree_map(
+               lambda g: reduce_cotangent(g, axis, mean=True), v)
            for k, v in grads.items() if k != "layers"}
     out["layers"] = []
     for layer_g in grads["layers"]:
-        red = {k: jax.tree_util.tree_map(lambda g: lax.pmean(g, axis), v)
+        red = {k: jax.tree_util.tree_map(
+                   lambda g: reduce_cotangent(g, axis, mean=True), v)
                for k, v in layer_g.items() if k != "experts"}
-        red["experts"] = jax.tree_util.tree_map(lambda g: g / n,
-                                                layer_g["experts"])
+        red["experts"] = jax.tree_util.tree_map(
+            lambda g: scale_sharded_cotangent(g, axis),
+            layer_g["experts"])
         out["layers"].append(red)
     return out
 
@@ -438,9 +449,13 @@ def pp_reduce_rest_grads(g_rest: Dict, axis: str = "pp"):
     head run replicated on the pipeline's broadcast output, so their
     grads are already full and identical on every chip — left untouched.
     Applied to grad values (never differentiated through)."""
+    from horovod_tpu.parallel._vma import reduce_cotangent
+
     out = dict(g_rest)
-    out["embed"] = lax.psum(g_rest["embed"], axis)
-    out["pos"] = lax.psum(g_rest["pos"], axis)
+    out["embed"] = reduce_cotangent(g_rest["embed"], axis, mean=False,
+                                    invariant_loss=True)
+    out["pos"] = reduce_cotangent(g_rest["pos"], axis, mean=False,
+                                  invariant_loss=True)
     return out
 
 
@@ -487,8 +502,14 @@ def reduce_grads(grads, dp: Optional[str] = None, sp: Optional[str] = None):
       their slice.
 
     Uniform over every leaf, replicated and tp-sharded alike."""
+    from horovod_tpu.parallel._vma import reduce_cotangent
+
     if sp:
-        grads = jax.tree_util.tree_map(lambda g: lax.psum(g, sp), grads)
+        # next_token_nll's sum_across makes the loss sp-invariant.
+        grads = jax.tree_util.tree_map(
+            lambda g: reduce_cotangent(g, sp, mean=False,
+                                       invariant_loss=True), grads)
     if dp:
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), grads)
+        grads = jax.tree_util.tree_map(
+            lambda g: reduce_cotangent(g, dp, mean=True), grads)
     return grads

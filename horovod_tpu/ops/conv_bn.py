@@ -125,9 +125,9 @@ def _fused_forward(x, w, interpret: bool):
     except (AttributeError, TypeError):
         x_vma = w_vma = frozenset()
     if x_vma - w_vma:
-        w = jax.lax.pvary(w, tuple(x_vma - w_vma))
+        w = jax.lax.pcast(w, tuple(x_vma - w_vma), to="varying")
     if w_vma - x_vma:
-        x = jax.lax.pvary(x, tuple(w_vma - x_vma))
+        x = jax.lax.pcast(x, tuple(w_vma - x_vma), to="varying")
     vma = x_vma | w_vma
 
     def out_struct(shape, dtype):

@@ -79,8 +79,13 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp",
         current = lax.ppermute(result, axis, perm)
         return current, outputs
 
-    current0 = jnp.zeros(micro_shape, x.dtype)
-    outputs0 = jnp.zeros((M,) + micro_shape, x.dtype)
+    from horovod_tpu.parallel._vma import match_vma
+
+    # Zero-init carries typed varying like the stage weights/input so the
+    # fori_loop carry types match under check_vma=True.
+    vma_refs = (x, *jax.tree_util.tree_leaves(params))
+    current0 = match_vma(jnp.zeros(micro_shape, x.dtype), *vma_refs)
+    outputs0 = match_vma(jnp.zeros((M,) + micro_shape, x.dtype), *vma_refs)
     _, outputs = lax.fori_loop(0, n_ticks, tick, (current0, outputs0))
 
     # Only the last stage holds real outputs; replicate them to all chips

@@ -64,9 +64,13 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
         v_next = lax.ppermute(v_blk, axis, perm)
         return k_next, v_next, m_new, l_new, acc_new
 
-    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Lq), jnp.float32)
-    acc0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    from horovod_tpu.parallel._vma import match_vma
+
+    # Type the zero-init carries as varying like q/k/v so the loop body's
+    # carry-out matches under check_vma=True (values unchanged).
+    m0 = match_vma(jnp.full((B, H, Lq), NEG_INF, jnp.float32), q, k, v)
+    l0 = match_vma(jnp.zeros((B, H, Lq), jnp.float32), q, k, v)
+    acc0 = match_vma(jnp.zeros((B, H, Lq, D), jnp.float32), q, k, v)
     _, _, m, l, acc = lax.fori_loop(0, size, step, (k, v, m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Lq, D]

@@ -48,6 +48,14 @@ def spmd_fn(
     axis_name: str = "hvd",
     in_specs: Any = P(),
     out_specs: Any = P(),
+    # False BY DESIGN (not a leftover): this harness implements the
+    # Horovod programming model — "my code runs once per rank" — whose
+    # outputs are routinely rank-varying (rank(), per-rank metrics,
+    # per-shard BN statistics) under caller-chosen out_specs; the
+    # varying-manual-axes checker statically rejects exactly that
+    # pattern. Raw jax.shard_map call sites across the repo run with the
+    # checker ON (see docs/parallelism.md); callers of this harness can
+    # opt in via check_vma=True when their fn is fully typed.
     check_vma: bool = False,
     jit: bool = True,
     donate_argnums=(),

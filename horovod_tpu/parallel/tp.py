@@ -43,6 +43,19 @@ def _tp_region_fwd(x, axis):
 
 
 def _tp_region_bwd(axis, _, g):
+    from horovod_tpu.parallel._vma import vma_checking, vma_of
+
+    if vma_checking():
+        # Typed (check_vma=True) mode: the transpose of jax's
+        # auto-inserted pvary has ALREADY reduced the cotangent over
+        # every axis the primal was invariant on — psumming again would
+        # scale gradients by the axis size. Reduce ourselves only when
+        # the cotangent still carries per-rank values over `axis`.
+        if axis in vma_of(g):
+            return (lax.pcast(lax.psum(g, axis), axis, to="varying"),)
+        return (g,)
+    # Untyped (check_vma=False) mode: no auto-insertion happens, the
+    # cotangent holds this rank's partial — the conjugate owns the psum.
     return (lax.psum(g, axis),)
 
 
@@ -70,7 +83,9 @@ def _tp_out_fwd(x, axis):
 
 
 def _tp_out_bwd(axis, _, g):
-    return (g,)
+    # Identity value (the true Jacobian of a cross-rank sum consumed as
+    # replicated), typed varying to match the per-rank primal input.
+    return (lax.pcast(g, axis, to="varying"),)
 
 
 tp_region_output.defvjp(_tp_out_fwd, _tp_out_bwd)

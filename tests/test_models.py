@@ -153,17 +153,30 @@ def test_graft_entry_multichip_subprocess():
 
 def test_graft_entry_gate_catches_broken_conjugate(hvd, monkeypatch):
     """The driver gate's closed-form asserts must catch a
-    gradient-only bug: replace the Megatron ``g`` conjugate with a raw
-    psum (identical forward, double-psum backward — the classic
-    shard_map transpose gotcha) and the tp x sp x dp lane has to fail
-    its dense-reference check, NOT sail through on a finite loss."""
+    gradient-only bug: replace the Megatron ``g`` conjugate with one
+    whose forward is identical (psum) but whose backward scales the
+    cotangent by 1.25 — wrong in every gradient regime, invisible to a
+    finite-loss check. The tp x sp x dp lane has to fail its
+    dense-reference check, NOT sail through."""
+    from functools import partial
+
     import __graft_entry__ as g
     from jax import lax
 
     from horovod_tpu.parallel import tp as tp_mod
 
-    monkeypatch.setattr(tp_mod, "tp_region_output",
-                        lambda x, axis: lax.psum(x, axis))
+    @partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def bad_output(x, axis):
+        return lax.psum(x, axis)
+
+    def _bad_fwd(x, axis):
+        return lax.psum(x, axis), None
+
+    def _bad_bwd(axis, _, grad):
+        return (lax.pcast(grad * 1.25, axis, to="varying"),)
+
+    bad_output.defvjp(_bad_fwd, _bad_bwd)
+    monkeypatch.setattr(tp_mod, "tp_region_output", bad_output)
     with pytest.raises(AssertionError):
         g._dryrun_tp_sp_dp(8)
 

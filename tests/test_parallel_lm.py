@@ -39,7 +39,7 @@ def test_forward_matches_dense(hvd, setup):
     fn = jax.jit(jax.shard_map(
         lambda p, t: plm.lm_apply(p, t, sp="sp", tp="tp"),
         mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=P("dp", "sp", None), check_vma=False))
+        out_specs=P("dp", "sp", None)))
     sharded = fn(params, tokens)
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense),
                                rtol=2e-4, atol=2e-5)
@@ -59,7 +59,7 @@ def test_loss_matches_dense_shift(hvd, setup):
         lambda p, t: plm.next_token_nll(
             plm.lm_apply(p, t, sp="sp", tp="tp"), t, sp="sp")[None],
         mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=P("dp"), check_vma=False))
+        out_specs=P("dp")))
     # Per-dp-shard means over that shard's tokens; their mean == global.
     per_dp = fn(params, tokens)
     dense_per_dp = jax.vmap(
@@ -104,7 +104,7 @@ def test_train_step_matches_dense(hvd, setup):
 
     fn = jax.jit(jax.shard_map(
         sharded_step, mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=(specs, P()), check_vma=False))
+        out_specs=(specs, P())))
     sharded_params, sharded_loss = fn(params, tokens)
 
     np.testing.assert_allclose(float(sharded_loss), float(dense_loss),
@@ -127,7 +127,7 @@ def test_sp_only_and_tp_only_compose_independently(hvd, setup):
         lambda p, t: plm.lm_apply(p, t, sp="sp"),
         mesh=sp_mesh, in_specs=(plm.lm_param_specs(LAYERS, None),
                                 P(None, "sp")),
-        out_specs=P(None, "sp", None), check_vma=False))
+        out_specs=P(None, "sp", None)))
     np.testing.assert_allclose(np.asarray(fn_sp(params, tokens)),
                                np.asarray(dense), rtol=2e-4, atol=2e-5)
 
@@ -135,7 +135,7 @@ def test_sp_only_and_tp_only_compose_independently(hvd, setup):
     fn_tp = jax.jit(jax.shard_map(
         lambda p, t: plm.lm_apply(p, t, tp="tp"),
         mesh=tp_mesh, in_specs=(plm.lm_param_specs(LAYERS, "tp"), P()),
-        out_specs=P(), check_vma=False))
+        out_specs=P()))
     np.testing.assert_allclose(np.asarray(fn_tp(params, tokens)),
                                np.asarray(dense), rtol=2e-4, atol=2e-5)
 
@@ -176,6 +176,8 @@ def test_zero_composes_with_sequence_parallel(hvd, setup):
 
             return _ox.apply_updates(p, u), s, jax.lax.pmean(loss, "dp")
 
+        # ZeRO's scatter/gather collectives produce replicated values
+        # the vma checker cannot statically infer; scoped opt-out.
         fn = jax.jit(jax.shard_map(
             step, mesh=mesh, in_specs=(specs, ospec, sp_in),
             out_specs=(specs, ospec, P()), check_vma=False))
@@ -229,7 +231,7 @@ def test_decode_composes_with_tp(hvd, setup):
     fn = jax.jit(jax.shard_map(
         lambda p, t: plm.lm_decode(p, t, 6, tp="tp"),
         mesh=tp_mesh, in_specs=(plm.lm_param_specs(LAYERS, "tp"), P()),
-        out_specs=P(), check_vma=False))
+        out_specs=P()))
     sharded = fn(params, prompt)
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(dense))
 
@@ -277,7 +279,7 @@ def test_pipeline_parallel_matches_dense(hvd):
     fn = jax.jit(jax.shard_map(
         pp_loss_and_grads, mesh=mesh,
         in_specs=(rest_spec, layer_spec, P()),
-        out_specs=(P(), rest_spec, layer_spec), check_vma=False))
+        out_specs=(P(), rest_spec, layer_spec)))
     loss, g_rest, g_layers = fn(rest, stacked, tokens)
 
     np.testing.assert_allclose(float(loss), float(dense_val), rtol=1e-5)
@@ -312,7 +314,7 @@ def test_moe_lm_matches_dense_routing(hvd):
         lambda p, t: plm.lm_apply_moe(p, t, ep="ep",
                                       capacity_factor=float(experts))[0],
         mesh=mesh, in_specs=(specs, P("ep")),
-        out_specs=P("ep"), check_vma=False))
+        out_specs=P("ep")))
     sharded = fn(params, tokens)
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense_logits),
                                rtol=3e-4, atol=3e-5)
@@ -337,7 +339,7 @@ def test_moe_lm_matches_dense_routing(hvd):
 
     gfn = jax.jit(jax.shard_map(
         sharded_grads, mesh=mesh, in_specs=(specs, P("ep")),
-        out_specs=specs, check_vma=False))
+        out_specs=specs))
     g_sharded = gfn(params, tokens)
     for a, b in zip(jax.tree_util.tree_leaves(g_sharded),
                     jax.tree_util.tree_leaves(dense_g)):
@@ -358,7 +360,7 @@ def test_moe_lm_matches_dense_routing(hvd):
 
     sfn = jax.jit(jax.shard_map(
         step, mesh=mesh, in_specs=(specs, P("ep")),
-        out_specs=(specs, P()), check_vma=False))
+        out_specs=(specs, P())))
     losses = []
     ps = params
     for _ in range(8):
@@ -393,7 +395,7 @@ def test_bf16_composed_step_and_decode(hvd):
 
     fn = jax.jit(jax.shard_map(step, mesh=mesh,
                                in_specs=(specs, P("dp", "sp")),
-                               out_specs=(specs, P()), check_vma=False))
+                               out_specs=(specs, P())))
     losses = []
     ps = params
     for _ in range(6):

@@ -9,6 +9,11 @@ bytes/sec autotuner scoring (reference parameter_manager.h:211-217).
   NO parameter-sized flat psum (that is the whole point).
 """
 
+# These harnesses trace full rank-programs (train steps, sharded
+# attention) whose outputs are rank-varying or flow through
+# grouped/scatter collectives the vma checker cannot statically
+# infer — the same documented opt-out class as the spmd harness
+# (docs/parallelism.md); what is pinned here is the WIRE BYTES.
 import jax
 import jax.numpy as jnp
 import numpy as np
