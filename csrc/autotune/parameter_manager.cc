@@ -11,10 +11,17 @@ constexpr double kMinThresholdMb = 0.0;
 constexpr double kMaxThresholdMb = 64.0;
 constexpr double kMinCycleMs = 1.0;
 constexpr double kMaxCycleMs = 25.0;
+
+BayesianOptimization MakeBayes() {
+  return BayesianOptimization(
+      {{kMinThresholdMb, kMaxThresholdMb}, {kMinCycleMs, kMaxCycleMs}});
+}
 }  // namespace
 
-ParameterManager::ParameterManager()
-    : bayes_({{kMinThresholdMb, kMaxThresholdMb}, {kMinCycleMs, kMaxCycleMs}}) {}
+ParameterManager::ParameterManager() {
+  combos_ = {0};
+  bayes_.push_back(MakeBayes());
+}
 
 void ParameterManager::Initialize(int rank, const std::string& log_path) {
   rank_ = rank;
@@ -23,12 +30,25 @@ void ParameterManager::Initialize(int rank, const std::string& log_path) {
   }
 }
 
+void ParameterManager::SetHierarchyAvailable(bool available) {
+  if (!available || combos_.size() > 1) return;
+  // Bit 0 = hierarchical allreduce, bit 1 = hierarchical allgather
+  // (reference swept both knobs as independent categoricals,
+  // parameter_manager.h:149-205). Each combo owns a fresh surrogate:
+  // the throughput surfaces differ structurally between the ladders.
+  combos_ = {0, 1, 2, 3};
+  bayes_.clear();
+  for (size_t i = 0; i < combos_.size(); ++i) bayes_.push_back(MakeBayes());
+}
+
 bool ParameterManager::Update(int64_t cycle_bytes, double cur_cycle_ms,
-                              int64_t cur_threshold, double* new_cycle_ms,
-                              int64_t* new_threshold) {
+                              int64_t cur_threshold, int cur_hier,
+                              double* new_cycle_ms, int64_t* new_threshold,
+                              int* new_hier) {
   if (!active_ || converged_ || rank_ != 0) return false;
   cur_cycle_ms_ = cur_cycle_ms;
   cur_threshold_ = cur_threshold;
+  cur_hier_ = cur_hier;
   auto now = std::chrono::steady_clock::now();
   if (!window_open_) {
     window_open_ = true;
@@ -43,16 +63,37 @@ bool ParameterManager::Update(int64_t cycle_bytes, double cur_cycle_ms,
   double elapsed = std::chrono::duration<double>(now - window_start_).count();
   window_open_ = false;
   if (elapsed <= 0.0) return false;
-  Score(static_cast<double>(window_bytes_) / elapsed);
+  return FeedSample(static_cast<double>(window_bytes_) / elapsed,
+                    new_cycle_ms, new_threshold, new_hier);
+}
+
+bool ParameterManager::FeedSample(double bytes_per_sec, double* new_cycle_ms,
+                                  int64_t* new_threshold, int* new_hier) {
+  Score(bytes_per_sec);
   if (converged_) {
     *new_cycle_ms = best_cycle_ms_;
     *new_threshold = best_threshold_;
+    *new_hier = best_hier_;
     return true;
   }
-  auto next = bayes_.Suggest();
+  NextSuggestion(new_cycle_ms, new_threshold, new_hier);
+  cur_cycle_ms_ = *new_cycle_ms;
+  cur_threshold_ = *new_threshold;
+  cur_hier_ = *new_hier;
+  return true;
+}
+
+void ParameterManager::NextSuggestion(double* new_cycle_ms,
+                                      int64_t* new_threshold, int* new_hier) {
+  // Rotate the categorical combo each sample so every hierarchy mode
+  // keeps accumulating evidence, and let that combo's surrogate pick the
+  // numeric pair (the reference's categorical chain advanced the same
+  // way around its numeric chain).
+  combo_idx_ = (combo_idx_ + 1) % combos_.size();
+  auto next = bayes_[combo_idx_].Suggest();
   *new_threshold = static_cast<int64_t>(next[0] * 1024.0 * 1024.0);
   *new_cycle_ms = next[1];
-  return true;
+  *new_hier = combos_[combo_idx_];
 }
 
 void ParameterManager::Score(double bytes_per_sec) {
@@ -61,24 +102,29 @@ void ParameterManager::Score(double bytes_per_sec) {
   if (!warmup) {
     double threshold_mb =
         static_cast<double>(cur_threshold_) / (1024.0 * 1024.0);
-    bayes_.AddSample({threshold_mb, cur_cycle_ms_}, bytes_per_sec);
+    size_t ci = 0;
+    for (size_t i = 0; i < combos_.size(); ++i)
+      if (combos_[i] == cur_hier_) ci = i;
+    bayes_[ci].AddSample({threshold_mb, cur_cycle_ms_}, bytes_per_sec);
     if (bytes_per_sec > best_score_) {
       best_score_ = bytes_per_sec;
       best_cycle_ms_ = cur_cycle_ms_;
       best_threshold_ = cur_threshold_;
+      best_hier_ = cur_hier_;
     }
   }
   if (log_.is_open()) {
     log_ << samples_seen_ << "\t" << (warmup ? "warmup" : "sample") << "\t"
          << cur_threshold_ << "\t" << cur_cycle_ms_ << "\t" << bytes_per_sec
-         << "\n";
+         << "\t" << cur_hier_ << "\n";
     log_.flush();
   }
   if (samples_seen_ >= kMaxSamples + kWarmupSamples) {
     converged_ = true;
     HVD_LOG(INFO) << "autotune converged: fusion_threshold="
                   << best_threshold_ << " cycle_time_ms=" << best_cycle_ms_
-                  << " score=" << best_score_ << " B/s";
+                  << " hierarchical=" << best_hier_ << " score="
+                  << best_score_ << " B/s";
   }
 }
 

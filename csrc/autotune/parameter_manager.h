@@ -1,20 +1,26 @@
-// Joint autotuning of {fusion threshold, cycle time} by throughput score.
+// Joint autotuning of {fusion threshold, cycle time} numerically and the
+// hierarchical allreduce/allgather modes categorically, by throughput.
 //
 // Role parity with reference horovod/common/parameter_manager.h:35-217:
 // warmup discards, 5-cycle scoring windows of bytes/sec, Bayesian
-// optimization over the joint space, convergence to the best seen, optional
-// score log (HOROVOD_AUTOTUNE_LOG). Only rank 0 scores and tunes; the
-// winners are synced to every rank by piggybacking {cycle time, fusion
-// threshold} on the coordinator's broadcast ResponseList each cycle
-// (reference synced via a dedicated param bcast, parameter_manager.h:
-// 95-96,232) — the control round runs at the pace of the slowest rank, so
-// all ranks must pace identically for tuning to mean anything.
+// optimization over the joint numeric space, a categorical chain over the
+// hierarchical modes (reference :149-205 wrapped the numeric chain in
+// CategoricalParameterChains for HOROVOD_HIERARCHICAL_ALLREDUCE/
+// ALLGATHER), convergence to the best seen, optional score log
+// (HOROVOD_AUTOTUNE_LOG). Only rank 0 scores and tunes; the winners are
+// synced to every rank by piggybacking {cycle time, fusion threshold,
+// hierarchical bitmask} on the coordinator's broadcast ResponseList each
+// cycle (reference synced via a dedicated param bcast,
+// parameter_manager.h:95-96,232) — the control round runs at the pace of
+// the slowest rank, so all ranks must pace identically for tuning to
+// mean anything.
 #pragma once
 
 #include <chrono>
 #include <cstdint>
 #include <fstream>
 #include <string>
+#include <vector>
 
 #include "bayesian_optimization.h"
 
@@ -27,13 +33,31 @@ class ParameterManager {
   void SetAutoTuning(bool active) { active_ = active; }
   bool IsAutoTuning() const { return active_; }
 
+  // Declare whether the transport dialed hierarchical sub-rings: when
+  // true the categorical space is the 4 {flat,hier-AR} x {flat,hier-AG}
+  // combos (bitmask bit 0 = allreduce, bit 1 = allgather), each with its
+  // own numeric surrogate; when false only the flat combo is swept.
+  void SetHierarchyAvailable(bool available);
+
   // Called once per cycle with the payload bytes the cycle moved. Returns
-  // true when the caller should adopt *new_cycle_ms / *new_threshold.
+  // true when the caller should adopt *new_cycle_ms / *new_threshold /
+  // *new_hier.
   bool Update(int64_t cycle_bytes, double cur_cycle_ms, int64_t cur_threshold,
-              double* new_cycle_ms, int64_t* new_threshold);
+              int cur_hier, double* new_cycle_ms, int64_t* new_threshold,
+              int* new_hier);
+
+  // Deterministic drive for tests: record one SAMPLE at the given score
+  // for the current candidate and advance. Returns true once converged;
+  // outputs always carry the next (or final) candidate.
+  bool FeedSample(double bytes_per_sec, double* new_cycle_ms,
+                  int64_t* new_threshold, int* new_hier);
+
+  bool converged() const { return converged_; }
 
  private:
   void Score(double bytes_per_sec);
+  void NextSuggestion(double* new_cycle_ms, int64_t* new_threshold,
+                      int* new_hier);
 
   bool active_ = false;
   int rank_ = 0;
@@ -43,7 +67,12 @@ class ParameterManager {
   static constexpr int kCyclesPerSample = 10; // scoring window
   static constexpr int kMaxSamples = 30;      // then converge to best
 
-  BayesianOptimization bayes_;
+  // One numeric surrogate per categorical combo; combos_[i] is the
+  // hierarchical bitmask the surrogate bayes_[i] tunes under.
+  std::vector<BayesianOptimization> bayes_;
+  std::vector<int> combos_;
+  size_t combo_idx_ = 0;
+
   int64_t window_bytes_ = 0;
   int window_cycles_ = 0;
   std::chrono::steady_clock::time_point window_start_;
@@ -53,8 +82,10 @@ class ParameterManager {
   double best_score_ = -1.0;
   double best_cycle_ms_ = 5.0;
   int64_t best_threshold_ = 64 << 20;
+  int best_hier_ = 0;
   double cur_cycle_ms_ = 5.0;
   int64_t cur_threshold_ = 64 << 20;
+  int cur_hier_ = 0;
   bool converged_ = false;
 };
 

@@ -16,6 +16,7 @@
 
 #include "autotune/bayesian_optimization.h"
 #include "autotune/gaussian_process.h"
+#include "autotune/parameter_manager.h"
 #include "coordinator.h"
 
 using hvdtpu::Coordinator;
@@ -178,6 +179,36 @@ void hvdtpu_enable_autotune(const char* log_path) {
 // xs/ys: n observed (position, score) pairs; cands: n_cands positions
 // to rank. Returns the index of the candidate maximizing expected
 // improvement, or -1 on degenerate input / non-PD kernel.
+// ParameterManager test shim: drive the categorical x numeric tuner
+// with DETERMINISTIC sample scores (the production path scores real
+// wall-clock windows inside the coordinator loop). Lets the Python
+// suite prove the tuner flips hierarchy on exactly when the ladder's
+// measured throughput wins (reference parameter_manager.h:149-205).
+void* hvdtpu_pm_create(int hier_available) {
+  auto* pm = new hvdtpu::ParameterManager();
+  pm->Initialize(/*rank=*/0, /*log_path=*/"");
+  pm->SetAutoTuning(true);
+  pm->SetHierarchyAvailable(hier_available != 0);
+  return pm;
+}
+
+int hvdtpu_pm_feed(void* pm_ptr, double bytes_per_sec, double* cycle_ms,
+                   long long* threshold, int* hier) {
+  auto* pm = static_cast<hvdtpu::ParameterManager*>(pm_ptr);
+  double c;
+  int64_t t;
+  int h;
+  pm->FeedSample(bytes_per_sec, &c, &t, &h);
+  if (cycle_ms != nullptr) *cycle_ms = c;
+  if (threshold != nullptr) *threshold = static_cast<long long>(t);
+  if (hier != nullptr) *hier = h;
+  return pm->converged() ? 1 : 0;
+}
+
+void hvdtpu_pm_destroy(void* pm_ptr) {
+  delete static_cast<hvdtpu::ParameterManager*>(pm_ptr);
+}
+
 int hvdtpu_ei_next(const double* xs, const double* ys, int n,
                    const double* cands, int n_cands, double xi) {
   if (xs == nullptr || ys == nullptr || cands == nullptr || n < 2 ||

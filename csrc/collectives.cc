@@ -170,6 +170,13 @@ Status HierarchicalAllreduce(Transport* t, void* data, int64_t count,
 Status HierarchicalAllgatherv(Transport* t, const void* in,
                               const std::vector<int64_t>& counts,
                               size_t elem_size, void* out) {
+  // Rank layout assumption (also stated in transport.h): group
+  // membership is rank/inner, i.e. ranks are assigned HOST-CONTIGUOUSLY
+  // by the launcher (run/driver.py always does). A round-robin
+  // assignment would still produce CORRECT results — the group carving
+  // below is pure index arithmetic — but the "local" ring would span
+  // hosts and the ladder's locality benefit silently evaporates.
+  //
   // Two-level needs one count per global rank to carve group blocks;
   // anything else (notably the size-1 single-count path) rides the flat
   // ring, which only indexes counts by its own ring length.

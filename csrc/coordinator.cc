@@ -115,27 +115,59 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
   // the reference's heterogeneous-cluster degrade (operations.cc:1303-1315).
   hier_allreduce_ = EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE");
   hier_allgather_ = EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER");
-  if ((hier_allreduce_ || hier_allgather_) && size_ > 1) {
-    // Control-star barrier: every rank must finish the flat bootstrap
-    // before anyone dials local/cross links, or a hierarchy dial could
-    // land in a rank still accepting its flat-ring prev.
-    std::vector<uint8_t> token{1};
+  bool autotune_on = std::getenv("HOROVOD_AUTOTUNE") != nullptr;
+  if (size_ > 1) {
+    // Exchange the hierarchy decision through the control star — the
+    // gather/bcast doubles as the bootstrap barrier (every rank finishes
+    // the flat wiring before anyone dials local/cross links). Running it
+    // UNCONDITIONALLY, with the knob value in the payload, removes the
+    // hang a partially-propagated env produced (some ranks entering the
+    // barrier, others not): all ranks now dial — or skip — together,
+    // with a warning when their local knobs disagreed. The autotuner
+    // also wants the sub-rings dialed even when the env knobs are off,
+    // so it can sweep hierarchy as a categorical parameter.
+    uint8_t my_vote = (hier_allreduce_ ? 1 : 0) |
+                      (hier_allgather_ ? 2 : 0) | (autotune_on ? 4 : 0);
+    std::vector<uint8_t> token{my_vote};
     std::vector<std::vector<uint8_t>> all;
     s = transport_.GatherToRoot(token, &all);
     if (!s.ok()) return s;
+    if (rank_ == 0) {
+      uint8_t any = 0;
+      bool mismatch = false;
+      for (const auto& v : all) {
+        uint8_t b = v.empty() ? 0 : v[0];
+        mismatch |= (b != my_vote);
+        any |= b;
+      }
+      if (mismatch)
+        HVD_LOG(WARNING)
+            << "hierarchical/autotune knobs differ across ranks (env not "
+               "uniformly propagated?); adopting the union everywhere so "
+               "all ranks run the same collective algorithm";
+      token[0] = any;
+    }
     s = transport_.BcastFromRoot(&token);
     if (!s.ok()) return s;
 
-    int inner = EnvInt("HOROVOD_HIERARCHICAL_INNER_SIZE", 0);
-    if (inner <= 0) inner = local_size_;
-    if (inner > 1 && inner < size_ && size_ % inner == 0) {
-      s = transport_.InitHierarchy(inner, timeout_ms);
-      if (!s.ok()) return s;
-    } else {
-      HVD_LOG_RANK(WARNING, rank_)
-          << "hierarchical collectives requested but group size " << inner
-          << " cannot tile " << size_
-          << " ranks into >1 equal groups; using the flat ring";
+    // Adopt the unified decision: mixed per-rank algorithms would
+    // deadlock (the ladder's message pattern differs from the flat
+    // ring), so every rank takes the union of the votes.
+    hier_allreduce_ = (token[0] & 1) != 0;
+    hier_allgather_ = (token[0] & 2) != 0;
+
+    if (token[0] & 7) {
+      int inner = EnvInt("HOROVOD_HIERARCHICAL_INNER_SIZE", 0);
+      if (inner <= 0) inner = local_size_;
+      if (inner > 1 && inner < size_ && size_ % inner == 0) {
+        s = transport_.InitHierarchy(inner, timeout_ms);
+        if (!s.ok()) return s;
+      } else if (hier_allreduce_ || hier_allgather_) {
+        HVD_LOG_RANK(WARNING, rank_)
+            << "hierarchical collectives requested but group size " << inner
+            << " cannot tile " << size_
+            << " ranks into >1 equal groups; using the flat ring";
+      }
     }
   }
 
@@ -144,9 +176,12 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
     timeline_.Initialize(timeline_path,
                          std::getenv("HOROVOD_TIMELINE_MARK_CYCLES") != nullptr);
   }
-  if (std::getenv("HOROVOD_AUTOTUNE") != nullptr) {
+  if (autotune_on) {
     const char* log = std::getenv("HOROVOD_AUTOTUNE_LOG");
     EnableAutotune(log ? log : "");
+    // With the sub-rings dialed, hierarchy becomes a categorical
+    // dimension of the sweep (reference parameter_manager.h:149-205).
+    autotuner_->SetHierarchyAvailable(transport_.hierarchy_ready());
   }
 
   initialized_ = true;
@@ -365,6 +400,10 @@ bool Coordinator::RunLoopOnce() {
       // time on rank 0 alone would be ineffective.
       to_perform.tuned_cycle_ms = cycle_time_ms_.load();
       to_perform.tuned_threshold = fusion_threshold_.load();
+      // hierarchical_active() (flags AND sub-rings wired), not the raw
+      // flags: when the topology can't tile, what actually ran is the
+      // flat ring and the tuning record must say so.
+      to_perform.tuned_hier = hierarchical_active();
     }
     std::vector<uint8_t> wire;
     SerializeResponseList(to_perform, &wire);
@@ -396,6 +435,10 @@ bool Coordinator::RunLoopOnce() {
       // Adopt the coordinator's autotuned globals (reference SyncParams).
       cycle_time_ms_ = to_perform.tuned_cycle_ms;
       fusion_threshold_ = to_perform.tuned_threshold;
+      if (to_perform.tuned_hier >= 0 && transport_.hierarchy_ready()) {
+        hier_allreduce_ = (to_perform.tuned_hier & 1) != 0;
+        hier_allgather_ = (to_perform.tuned_hier & 2) != 0;
+      }
     }
   }
 
@@ -416,11 +459,21 @@ bool Coordinator::RunLoopOnce() {
   if (autotuner_ != nullptr) {
     double new_cycle_ms;
     int64_t new_threshold;
+    int new_hier;
+    // Clamp to what actually executed: with flags set but hierarchy
+    // undialed the collectives degraded to the flat ring, and crediting
+    // a phantom hierarchical mode would poison the surrogate and the
+    // converged log line.
+    int cur_hier = hierarchical_active();
     if (autotuner_->Update(cycle_bytes, cycle_time_ms_.load(),
-                           fusion_threshold_.load(), &new_cycle_ms,
-                           &new_threshold)) {
+                           fusion_threshold_.load(), cur_hier,
+                           &new_cycle_ms, &new_threshold, &new_hier)) {
       cycle_time_ms_ = new_cycle_ms;
       fusion_threshold_ = new_threshold;
+      if (transport_.hierarchy_ready()) {
+        hier_allreduce_ = (new_hier & 1) != 0;
+        hier_allgather_ = (new_hier & 2) != 0;
+      }
     }
   }
   return !to_perform.shutdown;

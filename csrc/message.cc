@@ -118,6 +118,7 @@ void SerializeResponseList(const ResponseList& in, std::vector<uint8_t>* out) {
   w.U8(in.shutdown ? 1 : 0);
   w.Raw(&in.tuned_cycle_ms, 8);
   w.I64(in.tuned_threshold);
+  w.I32(in.tuned_hier);
   w.I32(static_cast<int32_t>(in.responses.size()));
   for (const auto& r : in.responses) {
     w.U8(static_cast<uint8_t>(r.response_type));
@@ -135,7 +136,8 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   uint8_t shutdown;
   int32_t n;
   if (!rd.U8(&shutdown) || !rd.Raw(&out->tuned_cycle_ms, 8) ||
-      !rd.I64(&out->tuned_threshold) || !rd.I32(&n) || n < 0)
+      !rd.I64(&out->tuned_threshold) || !rd.I32(&out->tuned_hier) ||
+      !rd.I32(&n) || n < 0)
     return false;
   out->shutdown = shutdown != 0;
   out->responses.clear();

@@ -57,6 +57,9 @@ struct ResponseList {
   // (parameter_manager.h:95-96,232). threshold < 0 means "no update".
   double tuned_cycle_ms = 0.0;
   int64_t tuned_threshold = -1;
+  // Hierarchical-mode bitmask (bit 0 allreduce, bit 1 allgather) the
+  // autotuner is currently probing / converged to; -1 = not tuning.
+  int32_t tuned_hier = -1;
 };
 
 // Codec. Append-to / read-from a byte buffer; all integers little-endian.

@@ -286,8 +286,25 @@ def lm_apply_pp(rest: Dict, stacked_layers, tokens, axis: str = "pp",
     from horovod_tpu.parallel.pipeline import pipeline_apply
 
     B, L = tokens.shape
-    x = rest["embed"][tokens] + rest["pos"][None, :L]
     M = microbatches
+    if B % M != 0:
+        raise ValueError(
+            f"lm_apply_pp: batch {B} must divide into microbatches={M} "
+            f"(each stage tick processes one microbatch of B/M sequences)")
+    leaves = jax.tree_util.tree_leaves(stacked_layers)
+    n_stages = lax.axis_size(axis)
+    if leaves and leaves[0].shape[0] != 1:
+        # Inside shard_map with P(axis) on the stack, the per-chip view
+        # keeps a length-1 leading stage axis (n_layers == axis size).
+        # Anything else — a mis-sized stack, or a full stack passed
+        # replicated without the P(axis) in_spec — would surface as a
+        # cryptic reshape/einsum error deep inside pipeline_apply.
+        raise ValueError(
+            f"lm_apply_pp: per-chip stacked_layers leading dim is "
+            f"{leaves[0].shape[0]}, expected 1 — pass n_layers == "
+            f"'{axis}' axis size ({n_stages}) blocks sharded with "
+            f"P('{axis}') (one transformer block per stage chip)")
+    x = rest["embed"][tokens] + rest["pos"][None, :L]
     xm = x.reshape(M, B // M, L, x.shape[-1])
 
     def stage(layer, a):

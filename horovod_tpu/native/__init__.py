@@ -165,6 +165,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     dp = c.POINTER(c.c_double)
     lib.hvdtpu_ei_next.argtypes = [dp, dp, c.c_int, dp, c.c_int, c.c_double]
     lib.hvdtpu_ei_next.restype = c.c_int
+    lib.hvdtpu_pm_create.argtypes = [c.c_int]
+    lib.hvdtpu_pm_create.restype = c.c_void_p
+    lib.hvdtpu_pm_feed.argtypes = [
+        c.c_void_p, c.c_double, c.POINTER(c.c_double),
+        c.POINTER(c.c_longlong), c.POINTER(c.c_int)]
+    lib.hvdtpu_pm_feed.restype = c.c_int
+    lib.hvdtpu_pm_destroy.argtypes = [c.c_void_p]
+    lib.hvdtpu_pm_destroy.restype = None
     return lib
 
 

@@ -47,6 +47,14 @@ def main(argv=None) -> int:
                             jax_distributed=args.jax_distributed)
         if rc == 0:
             return 0
+        if rc == 2:
+            # Exit code 2 is the Unix/argparse usage-error convention:
+            # bad CLI flags or import-time misuse rerun identically, so
+            # burning the restart budget on them only delays the real
+            # error reaching the user.
+            print("hvdrun: exit code 2 (usage error) — deterministic "
+                  "failure, not relaunching", file=sys.stderr, flush=True)
+            return rc
         if attempt < args.restarts:
             print(f"hvdrun: attempt {attempt + 1} failed (exit {rc}); "
                   f"relaunching ({args.restarts - attempt} restart(s) "

@@ -141,7 +141,7 @@ def test_ei_strategy_converges_near_optimum_with_fewer_probes(hvd, monkeypatch):
         return 1.0 + (x - math.log2(9.0)) ** 2
 
     tuner = at.StepAutotuner(st.config, window=1, strategy="ei")
-    st.config.fusion_threshold = tuner.candidates[0]
+    st.config.fusion_threshold = tuner.candidates[0][0]
     try:
         assert len(tuner.candidates) == 9
         for _ in range(100):
@@ -159,6 +159,57 @@ def test_ei_strategy_converges_near_optimum_with_fewer_probes(hvd, monkeypatch):
     finally:
         st.autotuner = None
         st.config.fusion_threshold = saved_threshold
+
+
+def test_tuner_flips_hierarchy_by_measured_speed(hvd, monkeypatch):
+    """Categorical autotuning (reference parameter_manager.h:149-205
+    swept hierarchical modes alongside the numeric pair): with a mesh
+    that can ladder (inner=2 over 8 chips), the tuner must converge
+    with hierarchical allreduce ON when the ladder's windows are
+    measurably faster, and OFF when they are slower — driving the live
+    config knob fusion.py consumes at trace time."""
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax import autotune as at
+
+    st = global_state()
+    saved = (st.config.fusion_threshold, st.config.hierarchical_allreduce,
+             st.config.hierarchical_inner_size)
+    fake_now = [0.0]
+    monkeypatch.setattr(at.time, "perf_counter", lambda: fake_now[0])
+
+    def run(hier_faster):
+        st.config.hierarchical_inner_size = 2  # 8 chips -> 4x2 ladder
+        st.config.fusion_threshold = 8 << 20
+        st.config.hierarchical_allreduce = False
+        tuner = at.StepAutotuner(st.config, window=1, strategy="sweep")
+        assert any(h for _, h in tuner.candidates), (
+            "default space must include hierarchical candidates on a "
+            "ladderable mesh")
+        for _ in range(200):
+            if tuner.converged:
+                break
+            if tuner.step_done():
+                base = 1.0 + 0.01 * at.StepAutotuner._xform(
+                    st.config.fusion_threshold)
+                # The winning category's windows run at half the time.
+                fast = (st.config.hierarchical_allreduce == hier_faster)
+                fake_now[0] += base * (0.5 if fast else 1.0)
+                tuner.end_window()
+        assert tuner.converged
+        return tuner
+
+    try:
+        t_on = run(hier_faster=True)
+        assert t_on.best_hierarchical is True
+        assert st.config.hierarchical_allreduce is True
+
+        t_off = run(hier_faster=False)
+        assert t_off.best_hierarchical is False
+        assert st.config.hierarchical_allreduce is False
+    finally:
+        st.autotuner = None
+        (st.config.fusion_threshold, st.config.hierarchical_allreduce,
+         st.config.hierarchical_inner_size) = saved
 
 
 def test_owner_handoff_when_first_handle_goes_idle(hvd):

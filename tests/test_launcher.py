@@ -195,6 +195,21 @@ class TestCLI:
         assert proc.returncode == 5
         assert proc.stderr.count("relaunching") == 2
 
+    def test_restarts_skip_usage_errors(self, tmp_path):
+        """Exit code 2 (argparse/usage convention) reruns identically —
+        --restarts must fail fast instead of burning the budget before
+        surfacing the real error (advisor r2 finding)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
+             "--restarts", "3", sys.executable, "-c", "raise SystemExit(2)"],
+            env=_clean_env(), cwd=str(REPO), timeout=180,
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        # The retry path's message is "...; relaunching (N restart(s)
+        # left)"; the fail-fast path prints "not relaunching".
+        assert "; relaunching" not in proc.stderr
+        assert "usage error" in proc.stderr
+
     def test_hosts_slot_mismatch(self):
         from horovod_tpu.run import LaunchError, launch_command
 

@@ -224,6 +224,22 @@ class TestHierarchical:
                "HOROVOD_HIERARCHICAL_INNER_SIZE": "2"}
         _spawn(4, "hier", extra_env={r: dict(env) for r in range(4)})
 
+    def test_hierarchical_knob_mismatch_unifies(self):
+        """A partially-propagated env (knobs on rank 0 only) used to hang
+        at the bootstrap barrier; the coordinator now exchanges the votes
+        through the control star, every rank adopts the UNION (mixed
+        per-rank algorithms would deadlock mid-collective), and the job
+        completes with the hierarchical path active everywhere."""
+        on = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+              "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+              "HOROVOD_HIERARCHICAL_INNER_SIZE": "2",
+              "HVD_TEST_WANT_HIER": "3"}
+        off = {"HOROVOD_HIERARCHICAL_INNER_SIZE": "2",
+               "HVD_TEST_WANT_HIER": "3"}
+        _spawn(4, "hier",
+               extra_env={0: dict(on), 1: dict(off), 2: dict(off),
+                          3: dict(off)})
+
     def test_hierarchical_authenticated(self):
         """The local/cross hierarchy links run the same HMAC handshake as
         the flat ring (csrc/auth.cc kAuthPurposeHier)."""
@@ -331,6 +347,51 @@ class TestAutotune:
         """Rank-0's tuned {cycle time, fusion threshold} reach every rank
         (reference SyncParams semantics, parameter_manager.h:95-96,232)."""
         _spawn(2, "autotune_sync", timeout=150)
+
+    def _drive_pm(self, hier_available, score_fn, max_feeds=64):
+        """Drive the native ParameterManager deterministically through
+        the test shim: score each suggested candidate with ``score_fn``
+        until convergence; returns the winning (threshold, hier)."""
+        import ctypes as c
+
+        from horovod_tpu.native import load_library
+
+        lib = load_library()
+        pm = lib.hvdtpu_pm_create(1 if hier_available else 0)
+        try:
+            cyc = c.c_double(5.0)
+            thr = c.c_longlong(64 << 20)
+            hier = c.c_int(0)
+            for _ in range(max_feeds):
+                score = score_fn(thr.value, hier.value)
+                done = lib.hvdtpu_pm_feed(
+                    pm, float(score), c.byref(cyc), c.byref(thr),
+                    c.byref(hier))
+                if done:
+                    return thr.value, hier.value
+            raise AssertionError("ParameterManager never converged")
+        finally:
+            lib.hvdtpu_pm_destroy(pm)
+
+    def test_tuner_flips_hierarchy_by_throughput(self):
+        """Categorical autotuning (reference parameter_manager.h:149-205
+        swept hierarchical allreduce/allgather alongside the numeric
+        pair): when the two-level ladder's windows score 2x the flat
+        ring's bytes/sec, the converged winner must carry both
+        hierarchical bits — and with the scores reversed, neither."""
+        _, hier = self._drive_pm(
+            True, lambda t, h: 2e9 if h == 3 else 1e9)
+        assert hier == 3, hier
+
+        _, hier = self._drive_pm(
+            True, lambda t, h: 0.5e9 if h else 1e9)
+        assert hier == 0, hier
+
+    def test_tuner_without_hierarchy_stays_flat(self):
+        """Sub-rings not dialed: the categorical space collapses to the
+        flat combo regardless of scores."""
+        _, hier = self._drive_pm(False, lambda t, h: 1e9 + t)
+        assert hier == 0
 
     def test_gp_hyperparameter_fit_adapts(self):
         """The GP now fits {length scale, signal variance} by maximizing
