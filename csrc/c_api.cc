@@ -172,13 +172,6 @@ void hvdtpu_enable_autotune(const char* log_path) {
   GlobalCoordinator()->EnableAutotune(log_path ? log_path : "");
 }
 
-// EI-guided next-candidate selection over a 1-D discrete sweep. The
-// jax-lane fusion-threshold tuner drives this through ctypes so the
-// SPMD lane's autotuning uses the SAME GP/EI machinery as the native
-// coordinator (reference bayesian_optimization.h:31-44 acquisition).
-// xs/ys: n observed (position, score) pairs; cands: n_cands positions
-// to rank. Returns the index of the candidate maximizing expected
-// improvement, or -1 on degenerate input / non-PD kernel.
 // ParameterManager test shim: drive the categorical x numeric tuner
 // with DETERMINISTIC sample scores (the production path scores real
 // wall-clock windows inside the coordinator loop). Lets the Python
@@ -209,6 +202,13 @@ void hvdtpu_pm_destroy(void* pm_ptr) {
   delete static_cast<hvdtpu::ParameterManager*>(pm_ptr);
 }
 
+// EI-guided next-candidate selection over a 1-D discrete sweep. The
+// jax-lane fusion-threshold tuner drives this through ctypes so the
+// SPMD lane's autotuning uses the SAME GP/EI machinery as the native
+// coordinator (reference bayesian_optimization.h:31-44 acquisition).
+// xs/ys: n observed (position, score) pairs; cands: n_cands positions
+// to rank. Returns the index of the candidate maximizing expected
+// improvement, or -1 on degenerate input / non-PD kernel.
 int hvdtpu_ei_next(const double* xs, const double* ys, int n,
                    const double* cands, int n_cands, double xi) {
   if (xs == nullptr || ys == nullptr || cands == nullptr || n < 2 ||

@@ -128,37 +128,53 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
     // so it can sweep hierarchy as a categorical parameter.
     uint8_t my_vote = (hier_allreduce_ ? 1 : 0) |
                       (hier_allgather_ ? 2 : 0) | (autotune_on ? 4 : 0);
-    std::vector<uint8_t> token{my_vote};
+    // The inner size rides the same exchange: every rank MUST dial the
+    // same group shape (mismatched inner would deadlock the dial or
+    // wire mismatched sub-rings), so the root resolves one value and
+    // broadcasts it. 0 = this rank's env did not specify one.
+    int32_t my_inner =
+        static_cast<int32_t>(EnvInt("HOROVOD_HIERARCHICAL_INNER_SIZE", 0));
+    std::vector<uint8_t> token(5, 0);
+    token[0] = my_vote;
+    std::memcpy(token.data() + 1, &my_inner, 4);
     std::vector<std::vector<uint8_t>> all;
     s = transport_.GatherToRoot(token, &all);
     if (!s.ok()) return s;
     if (rank_ == 0) {
       uint8_t any = 0;
       bool mismatch = false;
+      int32_t inner_agreed = 0;
       for (const auto& v : all) {
-        uint8_t b = v.empty() ? 0 : v[0];
-        mismatch |= (b != my_vote);
+        uint8_t b = v.size() >= 5 ? v[0] : 0;
+        int32_t vi = 0;
+        if (v.size() >= 5) std::memcpy(&vi, v.data() + 1, 4);
+        mismatch |= (b != my_vote) || (vi != my_inner);
         any |= b;
+        if (inner_agreed == 0 && vi > 0) inner_agreed = vi;
       }
       if (mismatch)
         HVD_LOG(WARNING)
             << "hierarchical/autotune knobs differ across ranks (env not "
-               "uniformly propagated?); adopting the union everywhere so "
-               "all ranks run the same collective algorithm";
+               "uniformly propagated?); adopting the union + lowest-rank "
+               "inner size everywhere so all ranks run the same "
+               "collective algorithm";
+      if (inner_agreed == 0) inner_agreed = local_size_;
       token[0] = any;
+      std::memcpy(token.data() + 1, &inner_agreed, 4);
     }
     s = transport_.BcastFromRoot(&token);
     if (!s.ok()) return s;
 
     // Adopt the unified decision: mixed per-rank algorithms would
     // deadlock (the ladder's message pattern differs from the flat
-    // ring), so every rank takes the union of the votes.
+    // ring), so every rank takes the union of the votes and the root's
+    // resolved inner size.
     hier_allreduce_ = (token[0] & 1) != 0;
     hier_allgather_ = (token[0] & 2) != 0;
+    int32_t inner = 0;
+    std::memcpy(&inner, token.data() + 1, 4);
 
     if (token[0] & 7) {
-      int inner = EnvInt("HOROVOD_HIERARCHICAL_INNER_SIZE", 0);
-      if (inner <= 0) inner = local_size_;
       if (inner > 1 && inner < size_ && size_ % inner == 0) {
         s = transport_.InitHierarchy(inner, timeout_ms);
         if (!s.ok()) return s;

@@ -70,11 +70,15 @@ def fused_reduce(
     compression=Compression.none,
     op=None,
     fusion_threshold: Optional[int] = None,
+    name: Optional[str] = None,
 ):
     """Allreduce a sequence of tensors via fused flat buckets.
 
     Returns a list of reduced tensors in input order. Works inside an SPMD
     region (psum per bucket) and eagerly (size()==1 identity semantics).
+    ``name`` labels the per-tensor collectives on the eager process-level
+    path (where names drive the native negotiation and the timeline); the
+    SPMD path has no per-tensor identity inside the compiled program.
     """
     from horovod_tpu.jax import mpi_ops
 
@@ -95,8 +99,10 @@ def fused_reduce(
         # Multi-process eager: reduce each via the process-level path (the
         # native core fuses on its own side).
         return [
-            mpi_ops.allreduce(t, average=(op is mpi_ops.Average), op=op)
-            for t in tensors
+            mpi_ops.allreduce(
+                t, average=(op is mpi_ops.Average), op=op,
+                name=f"{name}.{i}" if name else None)
+            for i, t in enumerate(tensors)
         ]
 
     n = mpi_ops._axis_size(axis)

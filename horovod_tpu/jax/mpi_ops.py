@@ -326,6 +326,7 @@ def grouped_allreduce(
         compression=compression,
         op=op,
         fusion_threshold=fusion_threshold,
+        name=_normalize_name(name) if name else None,
     )
 
 
@@ -482,16 +483,34 @@ def broadcast_async_(tensor, root_rank, name=None):
 
 def alltoall(tensor, name: Optional[str] = None, split_axis: int = 0, concat_axis: int = 0):
     """Scatter equal splits of dim ``split_axis`` to all ranks and gather the
-    received splits along ``concat_axis``. SPMD-only."""
+    received splits along ``concat_axis``.
+
+    SPMD path: ``lax.all_to_all`` over the mesh axis. Eager multi-process
+    path: allgather + local split selection over the process world."""
     axis = _spmd_axis_or_none()
     tensor = jnp.asarray(tensor)
+    split_axis = split_axis % tensor.ndim
+    concat_axis = concat_axis % tensor.ndim
     if axis is None:
-        nproc, _ = _eager_world()
+        nproc, me = _eager_world()
         if nproc == 1:
             return tensor
-        raise PreconditionError(
-            "eager multi-process alltoall is not supported; use spmd_run"
-        )
+        if tensor.shape[split_axis] % nproc != 0:
+            raise InvalidArgumentError(
+                f"alltoall split dim {tensor.shape[split_axis]} not "
+                f"divisible by world size {nproc}")
+        # Process-level eager path: allgather everyone's tensor, then
+        # locally pick each source's split destined for this rank
+        # (pairwise SendRecv would halve the wire bytes; the gather
+        # rides the same multihost primitive as the other eager ops and
+        # keeps this a pure-data-plane fallback).
+        from horovod_tpu.jax import eager as _eager
+
+        gathered = _eager.process_allgather(tensor[None])
+        gathered = gathered.reshape((nproc,) + tensor.shape)
+        splits = jnp.split(gathered, nproc, axis=split_axis + 1)
+        return jnp.concatenate(
+            [splits[me][s] for s in range(nproc)], axis=concat_axis)
     n = _axis_size(axis)
     if tensor.shape[split_axis] % n != 0:
         raise InvalidArgumentError(
@@ -509,15 +528,28 @@ def alltoall(tensor, name: Optional[str] = None, split_axis: int = 0, concat_axi
 
 
 def reducescatter(tensor, average: bool = True, name: Optional[str] = None):
-    """Reduce across ranks and scatter dim-0 shards. SPMD-only."""
+    """Reduce across ranks and scatter dim-0 shards.
+
+    SPMD path: ``lax.psum_scatter``. Eager multi-process path: full
+    process-level reduce, keep this rank's dim-0 stripe."""
     axis = _spmd_axis_or_none()
     if axis is None:
-        nproc, _ = _eager_world()
+        nproc, me = _eager_world()
+        tensor = jnp.asarray(tensor)
         if nproc == 1:
-            return jnp.asarray(tensor)
-        raise PreconditionError(
-            "eager multi-process reducescatter is not supported; use spmd_run"
-        )
+            return tensor
+        if tensor.shape[0] % nproc != 0:
+            raise InvalidArgumentError(
+                f"reducescatter dim 0 ({tensor.shape[0]}) not divisible "
+                f"by world size {nproc}")
+        # Process-level eager path: full reduce, keep this rank's dim-0
+        # stripe (matches the SPMD psum_scatter result exactly).
+        from horovod_tpu.jax import eager as _eager
+
+        summed = _eager.process_allreduce(tensor)
+        per = tensor.shape[0] // nproc
+        out = summed[me * per:(me + 1) * per]
+        return out / nproc if average else out
     tensor = jnp.asarray(tensor)
     n = _axis_size(axis)
     if tensor.shape[0] % n != 0:

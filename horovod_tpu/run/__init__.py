@@ -281,7 +281,14 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
     base_env = dict(env if env is not None else os.environ)
     secret_hex = key.hex()
     controller = f"127.0.0.1:{_free_port()}"
-    driver_addr = f"127.0.0.1:{driver.port}"
+    # Publish EVERY candidate endpoint (loopback + per-NIC addresses);
+    # each worker probes for the first one that answers an authenticated
+    # Ping before registering — reference Spark interface discovery
+    # (spark/__init__.py:33-39,123-140). Local-only today, but ssh-remote
+    # workers get the multi-NIC story for free.
+    from horovod_tpu.run.network import candidate_addresses
+
+    driver_addr = ",".join(candidate_addresses(driver.port))
 
     procs: List[subprocess.Popen] = []
     try:

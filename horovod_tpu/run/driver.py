@@ -52,6 +52,34 @@ class Ack:
     pass
 
 
+@dataclasses.dataclass
+class Ping:
+    """Reachability probe (multi-NIC discovery): any authenticated
+    endpoint of the driver answers, proving the address routes AND the
+    peer holds the job secret (an open port alone is not enough)."""
+
+
+def probe_service(addrs, key: bytes, timeout: float = 1.5):
+    """First address in ``addrs`` (each ``\"host:port\"``) that answers an
+    authenticated :class:`Ping`, as a ``(host, port)`` tuple.
+
+    The reference's Spark tasks probed the driver's candidate interfaces
+    and kept the routable intersection (spark/__init__.py:123-140); here
+    a worker runs the probe once before registering. Raises
+    ``ConnectionError`` listing the candidates when none answers."""
+    tried = []
+    for addr in addrs:
+        host, _, port = addr.rpartition(":")
+        try:
+            BasicClient((host, int(port)), key, timeout=timeout).request(
+                Ping())
+            return host, int(port)
+        except Exception as e:  # unroutable, refused, timeout, bad auth
+            tried.append(f"{addr} ({type(e).__name__})")
+    raise ConnectionError(
+        "no driver endpoint reachable; tried: " + ", ".join(tried))
+
+
 class Driver:
     """Runs in the launcher process; workers talk to it over the
     authenticated RPC."""
@@ -73,6 +101,8 @@ class Driver:
         return self._service.port
 
     def _handle(self, req):
+        if isinstance(req, Ping):
+            return Ack()
         if isinstance(req, RegisterRequest):
             with self._cond:
                 self._registered[req.rank] = req.host

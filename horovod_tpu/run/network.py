@@ -34,6 +34,36 @@ def make_secret_key() -> bytes:
     return _secrets.token_bytes(32)
 
 
+def candidate_addresses(port: int) -> list:
+    """Every plausible ``host:port`` endpoint a service bound on 0.0.0.0
+    of this machine can be reached at: loopback, the hostname's
+    addresses, and the default-route interface (UDP-connect trick — no
+    packet is sent). The reference's Spark driver enumerated NICs the
+    same way and let tasks probe for the routable subset
+    (spark/__init__.py:33-39,123-140); on a multi-NIC pod only some of
+    these are reachable from a given worker, so publish them ALL and let
+    the worker probe (:func:`horovod_tpu.run.driver.probe_service`)."""
+    ips = ["127.0.0.1"]
+
+    def add(ip: str) -> None:
+        if ip and ip not in ips:
+            ips.append(ip)
+
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       socket.AF_INET):
+            add(info[4][0])
+    except socket.gaierror:
+        pass
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))  # no traffic: picks the route only
+            add(s.getsockname()[0])
+    except OSError:
+        pass
+    return [f"{ip}:{port}" for ip in ips]
+
+
 class IntegrityError(RuntimeError):
     pass
 

@@ -24,15 +24,24 @@ def _parent_watchdog(parent_pid: int) -> None:
 
 def main() -> int:
     rank = int(os.environ["HOROVOD_RANK"])
-    driver_host, _, driver_port = os.environ["HOROVOD_DRIVER"].rpartition(":")
     key = bytes.fromhex(os.environ["HOROVOD_SECRET"])
 
     threading.Thread(target=_parent_watchdog, args=(os.getppid(),),
                      daemon=True).start()
 
-    from horovod_tpu.run.driver import WorkerClient
+    from horovod_tpu.run.driver import WorkerClient, probe_service
 
-    client = WorkerClient((driver_host, int(driver_port)), key)
+    # HOROVOD_DRIVER carries one or more candidate endpoints (multi-NIC
+    # hosts publish every interface); probe for the first reachable one
+    # (reference Spark task-side discovery, spark/__init__.py:123-140).
+    candidates = os.environ["HOROVOD_DRIVER"].split(",")
+    if len(candidates) == 1:
+        host, _, port = candidates[0].rpartition(":")
+        addr = (host, int(port))
+    else:
+        addr = probe_service(candidates, key)
+
+    client = WorkerClient(addr, key)
     client.register(rank, os.uname().nodename)
     try:
         # fetch_task can itself fail (e.g. the fn unpickles by reference

@@ -52,6 +52,28 @@ def main() -> int:
     got = hvd.broadcast(jnp.full((4,), float(me)), root_rank=1)
     np.testing.assert_allclose(np.asarray(got), 1.0)
 
+    # 2b. Eager multi-process reducescatter (round-2 gap: raised
+    # PreconditionError): process p contributes rows of value p+1, so
+    # the summed tensor is uniform and each process keeps its dim-0
+    # stripe of the sum (or the mean).
+    rs_in = jnp.full((2 * nproc, 3), float(me + 1), jnp.float32)
+    total = float(sum(p + 1 for p in range(nproc)))
+    rs_sum = hvd.reducescatter(rs_in, average=False)
+    assert rs_sum.shape == (2, 3), rs_sum.shape
+    np.testing.assert_allclose(np.asarray(rs_sum), total, rtol=1e-6)
+    rs_avg = hvd.reducescatter(rs_in, average=True)
+    np.testing.assert_allclose(np.asarray(rs_avg), total / nproc, rtol=1e-6)
+
+    # 2c. Eager multi-process alltoall (same round-2 gap): process p
+    # sends split s the value 10*p + s; after the exchange process p
+    # holds split p from every source — [10*0 + p, 10*1 + p, ...].
+    a2a_in = jnp.concatenate(
+        [jnp.full((2,), 10.0 * me + s, jnp.float32) for s in range(nproc)])
+    a2a_out = hvd.alltoall(a2a_in)
+    expected_a2a = np.concatenate(
+        [np.full((2,), 10.0 * s + me, np.float32) for s in range(nproc)])
+    np.testing.assert_allclose(np.asarray(a2a_out), expected_a2a)
+
     # 3. One real training step: params broadcast from process 0, each
     # process feeds its own data shard, fused-psum DistributedOptimizer.
     params = {"w": jnp.full((3, 2), 0.1 * (me + 1)),

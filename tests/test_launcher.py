@@ -146,6 +146,70 @@ class TestRunFn:
         assert 1 in ei.value.failures
 
 
+class TestInterfaceDiscovery:
+    """Multi-NIC driver discovery (reference spark/__init__.py:33-39,
+    123-140: enumerate candidate interfaces, let workers probe for the
+    routable subset)."""
+
+    def test_candidate_addresses_include_loopback(self):
+        from horovod_tpu.run.network import candidate_addresses
+
+        addrs = candidate_addresses(1234)
+        assert addrs[0] == "127.0.0.1:1234"
+        assert all(a.endswith(":1234") for a in addrs)
+        assert len(addrs) == len(set(addrs))
+
+    def test_probe_skips_unroutable_first_candidate(self):
+        """The verdict scenario: the first published address does not
+        route (black-hole TEST-NET ip); the worker-side probe must fall
+        through to the live endpoint within its per-candidate timeout."""
+        from horovod_tpu.run.driver import Driver, probe_service
+        from horovod_tpu.run.network import make_secret_key
+
+        key = make_secret_key()
+        driver = Driver(1, key)
+        try:
+            addr = probe_service(
+                [f"192.0.2.1:{driver.port}",        # unroutable
+                 f"127.0.0.1:{driver.port}"], key, timeout=1.0)
+            assert addr == ("127.0.0.1", driver.port)
+        finally:
+            driver.close()
+
+    def test_probe_rejects_wrong_secret(self):
+        """An endpoint that answers TCP but fails the HMAC must not be
+        selected (an open port alone is not the driver)."""
+        import pytest
+
+        from horovod_tpu.run.driver import Driver, probe_service
+        from horovod_tpu.run.network import make_secret_key
+
+        driver = Driver(1, make_secret_key())
+        try:
+            with pytest.raises(ConnectionError, match="no driver"):
+                probe_service([f"127.0.0.1:{driver.port}"],
+                              make_secret_key(), timeout=1.0)
+        finally:
+            driver.close()
+
+    def test_run_fn_with_unroutable_first_candidate(self, monkeypatch):
+        """End-to-end: run(fn, np=2) still completes when the FIRST
+        published driver endpoint is a black hole — every worker probes
+        past it during registration."""
+        import horovod_tpu.run as hr
+        from horovod_tpu.run import network
+
+        real = network.candidate_addresses
+
+        def with_blackhole(port):
+            return [f"192.0.2.1:{port}"] + real(port)
+
+        monkeypatch.setattr(network, "candidate_addresses", with_blackhole)
+        out = hr.run(lambda: int(os.environ["HOROVOD_RANK"]), np=2,
+                     start_timeout=90.0)
+        assert sorted(out) == [0, 1]
+
+
 class TestCLI:
     def test_launch_command_success(self):
         code = subprocess.run(

@@ -225,17 +225,20 @@ class TestHierarchical:
         _spawn(4, "hier", extra_env={r: dict(env) for r in range(4)})
 
     def test_hierarchical_knob_mismatch_unifies(self):
-        """A partially-propagated env (knobs on rank 0 only) used to hang
-        at the bootstrap barrier; the coordinator now exchanges the votes
-        through the control star, every rank adopts the UNION (mixed
-        per-rank algorithms would deadlock mid-collective), and the job
-        completes with the hierarchical path active everywhere."""
+        """A partially-propagated env (knobs AND inner size on rank 0
+        only) used to hang at the bootstrap barrier; the coordinator now
+        exchanges votes + inner size through the control star, every
+        rank adopts the union and the root's resolved group shape (mixed
+        per-rank algorithms or group shapes would deadlock
+        mid-collective), and the job completes with the hierarchical
+        path active everywhere."""
         on = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
               "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
               "HOROVOD_HIERARCHICAL_INNER_SIZE": "2",
               "HVD_TEST_WANT_HIER": "3"}
-        off = {"HOROVOD_HIERARCHICAL_INNER_SIZE": "2",
-               "HVD_TEST_WANT_HIER": "3"}
+        # Ranks 1-3 get NEITHER the knobs NOR the inner size; the
+        # WANT override pins what the unified decision must be.
+        off = {"HVD_TEST_WANT_HIER": "3"}
         _spawn(4, "hier",
                extra_env={0: dict(on), 1: dict(off), 2: dict(off),
                           3: dict(off)})
