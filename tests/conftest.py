@@ -25,6 +25,60 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Two-lane suite strategy. The full suite (default) is the CI gate; on a
+# single-CPU box it runs ~20 min, dominated by a dozen whole-program
+# integration tests (subprocess launches, example smokes, big-model
+# compiles). `pytest -m "not slow"` is the fast iteration lane (<10 min)
+# that keeps every closed-form/exactness test and skips only the
+# whole-program wrappers whose INTERNALS those tests already cover.
+# Auto-marked here (one registry) instead of per-file decorators.
+_SLOW_TESTS = {
+    "test_bench.py::test_default_lane_contract",
+    "test_bench.py::test_lm_lane_contract",
+    "test_bench.py::test_zero_composes_with_lm_lane",
+    "test_bench.py::test_hung_backend_degrades_to_error_json",
+    "test_bench.py::test_crashing_child_degrades_to_error_json",
+    "test_examples_models.py::TestExamples::test_flax_imagenet_resnet50_smoke",
+    "test_examples_models.py::TestExamples::test_jax_transformer_zero_smoke",
+    "test_examples_models.py::TestExamples::test_jax_gpt_parallel_smoke",
+    "test_examples_models.py::TestExamples::test_long_context_ring_attention_smoke",
+    "test_examples_models.py::TestExamples::test_jax_mnist",
+    "test_examples_models.py::TestExamples::test_torch_mnist_via_launcher",
+    "test_examples_models.py::TestExamples::test_torch_synthetic_benchmark_via_launcher",
+    "test_examples_models.py::TestModelZoo::test_forward_shapes[inception_v3-shape1]",
+    "test_examples_models.py::TestModelZoo::test_vgg16_train_step_runs",
+    "test_models.py::test_graft_entry_multichip_subprocess",
+    "test_multiprocess_spmd.py::test_two_process_global_mesh_end_to_end",
+    "test_multiprocess_spmd.py::test_two_process_hierarchical_ladder",
+    "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
+    "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
+    "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",
+    "test_examples_models.py::TestExamples::test_jax_synthetic_benchmark_smoke",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: whole-program integration wrapper; skipped by the fast "
+        "iteration lane (pytest -m 'not slow'), always in the CI gate")
+
+
+def pytest_collection_modifyitems(config, items):
+    matched = set()
+    for item in items:
+        rel = item.nodeid.split("/")[-1]
+        if rel in _SLOW_TESTS:
+            matched.add(rel)
+            item.add_marker(pytest.mark.slow)
+    # Fail loudly on registry drift: a renamed/removed test would
+    # otherwise silently rejoin the fast lane. Only enforced on full
+    # collections (running a single file legitimately misses entries).
+    stale = _SLOW_TESTS - matched
+    if stale and len(items) > 200:
+        raise pytest.UsageError(
+            f"tests/conftest.py _SLOW_TESTS has stale entries: {stale}")
+
 
 @pytest.fixture(scope="session")
 def hvd():

@@ -21,9 +21,11 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
     local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", str(size)))
     local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))
     core = NativeCore()
+    timeout_ms = int(os.environ.get("HVD_TEST_INIT_TIMEOUT_MS", "30000"))
     core.init(rank=rank, size=size, local_rank=local_rank,
               local_size=local_size,
-              coord_host="127.0.0.1", coord_port=port, timeout_ms=30000)
+              coord_host="127.0.0.1", coord_port=port,
+              timeout_ms=timeout_ms)
     core.set_cycle_time_ms(1.0)
     assert core.rank() == rank and core.size() == size
 

@@ -300,6 +300,9 @@ class TestTransportAuth:
         for rank in range(2):
             rank_env = dict(env)
             rank_env["HOROVOD_SECRET"] = secrets[rank]
+            # The rejected rank retries until the bootstrap timeout; a
+            # short one keeps this failure-path test fast.
+            rank_env["HVD_TEST_INIT_TIMEOUT_MS"] = "6000"
             procs.append(subprocess.Popen(
                 [sys.executable, str(WORKER), str(rank), "2", str(port),
                  "collectives"],
