@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Round-3 hardware measurement sweep: run every pending on-chip number
+in PRIORITY order, so even a brief healthy-tunnel window captures the
+most valuable results first.
+
+Each lane is a bounded subprocess (bench.py's own supervisor handles
+tunnel flaps inside each attempt); results append to PERF_RUNS.tsv as
+    <utc-iso>\t<lane>\t<json-or-error>
+and a summary table prints at the end. Safe to re-run: lanes already
+recorded today can be skipped with --resume.
+
+Priority:
+  1. resnet50 baseline        (reference-parity tracked metric)
+  2. resnet50 --fused-bn      (round-3 A/B: Pallas conv+BN statistics)
+  3. transformer_lm           (long-context tokens/sec lane)
+  4. resnet101 / vgg16 / inception_v3  (headline table cells)
+  5. flash_check              (tools/tpu_flash_check.py artifact)
+  6. resnet50 bs=128 / bs=256 (batch-size scaling lane)
+"""
+
+import argparse
+import datetime
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "PERF_RUNS.tsv")
+
+LANES = [
+    ("resnet50", ["bench.py"]),
+    ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
+    ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
+    ("resnet101", ["bench.py", "--model", "resnet101"]),
+    ("vgg16", ["bench.py", "--model", "vgg16"]),
+    ("inception_v3", ["bench.py", "--model", "inception_v3"]),
+    ("flash_check", ["tools/tpu_flash_check.py"]),
+    ("resnet50_bs128", ["bench.py", "--batch-size", "128"]),
+    ("resnet50_bs256", ["bench.py", "--batch-size", "256"]),
+]
+
+
+def record(lane: str, payload: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    # One record per physical line: stderr tails carry newlines/tabs.
+    payload = payload.replace("\n", " ").replace("\t", " ")
+    with open(LOG, "a") as f:
+        f.write(f"{stamp}\t{lane}\t{payload}\n")
+
+
+def run_lane(cmd, env, timeout: float):
+    """Run one lane in its own process GROUP and kill the whole group on
+    timeout: bench.py is a supervisor whose measuring child holds the
+    PJRT client — orphaning it would wedge the device for every
+    subsequent lane."""
+    proc = subprocess.Popen(
+        [sys.executable, *cmd], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait(10)
+        raise
+
+
+def already_done_today(lane: str) -> bool:
+    if not os.path.exists(LOG):
+        return False
+    today = datetime.datetime.now(datetime.timezone.utc).date().isoformat()
+    for line in open(LOG):
+        parts = line.rstrip("\n").split("\t")
+        if (len(parts) == 3 and parts[1] == lane
+                and parts[0].startswith(today)
+                and '"error"' not in parts[2]
+                and parts[2].startswith("{")):
+            return True
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=float, default=1500.0,
+                    help="wall-clock bound per lane (seconds)")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip lanes already recorded successfully today")
+    ap.add_argument("--lanes", default="",
+                    help="comma list to restrict (names from the table)")
+    args = ap.parse_args()
+    pick = set(args.lanes.split(",")) if args.lanes else None
+    if pick is not None:
+        known = {lane for lane, _ in LANES}
+        unknown = pick - known
+        if unknown:
+            ap.error(f"unknown lane(s) {sorted(unknown)}; "
+                     f"have {sorted(known)}")
+
+    env = dict(os.environ)
+    # One in-lane retry round; the sweep moves on rather than stalling
+    # the whole window on one wedged lane. Budget the per-attempt
+    # timeout so both attempts + the backoff + final-JSON slack fit
+    # INSIDE the outer bound — otherwise the outer kill would land just
+    # before the degraded error-JSON record the supervisor guarantees.
+    backoff = float(env.setdefault("HVD_BENCH_BACKOFF", "20"))
+    env.setdefault("HVD_BENCH_ATTEMPTS", "2")
+    attempts = int(env["HVD_BENCH_ATTEMPTS"])
+    per_attempt = max(
+        60, int((args.timeout - (attempts - 1) * backoff - 60) / attempts))
+    env.setdefault("HVD_BENCH_ATTEMPT_TIMEOUT", str(per_attempt))
+
+    results = {}
+    for lane, cmd in LANES:
+        if pick is not None and lane not in pick:
+            continue
+        if args.resume and already_done_today(lane):
+            print(f"[sweep] {lane}: already recorded today, skipping",
+                  file=sys.stderr)
+            continue
+        print(f"[sweep] running {lane}: {' '.join(cmd)}", file=sys.stderr,
+              flush=True)
+        try:
+            rc, out, err = run_lane(cmd, env, args.timeout)
+            if lane == "flash_check":
+                payload = ("flash OK: " + err.strip().splitlines()[-1]
+                           if rc == 0 else f"rc={rc}: {err[-300:]}")
+            else:
+                lines = [l for l in out.strip().splitlines()
+                         if l.startswith("{")]
+                payload = lines[-1] if lines else (
+                    f"rc={rc}, no JSON: {err[-300:]}")
+        except subprocess.TimeoutExpired:
+            payload = f"sweep-level timeout after {args.timeout:.0f}s"
+        record(lane, payload)
+        results[lane] = payload
+        print(f"[sweep] {lane}: {payload[:160]}", file=sys.stderr, flush=True)
+
+    print("\n== sweep summary ==")
+    for lane, payload in results.items():
+        print(f"{lane:20s} {payload[:140]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
