@@ -36,6 +36,7 @@ _SLOW_TESTS = {
     "test_bench.py::test_default_lane_contract",
     "test_bench.py::test_lm_lane_contract",
     "test_bench.py::test_zero_composes_with_lm_lane",
+    "test_bench.py::test_lm_flash_attention_lane",
     "test_bench.py::test_hung_backend_degrades_to_error_json",
     "test_bench.py::test_crashing_child_degrades_to_error_json",
     "test_examples_models.py::TestExamples::test_flax_imagenet_resnet50_smoke",

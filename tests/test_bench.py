@@ -90,6 +90,19 @@ def test_crashing_child_degrades_to_error_json():
     assert "attempt 2/" not in proc.stderr
 
 
+def test_lm_flash_attention_lane():
+    """--flash-attention swaps the Pallas kernel into the LM lane (the
+    flash-vs-dense A/B surface); same contract, interpret mode on CPU."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--flash-attention",
+        "--batch-size", "2", "--seq-len", "128", "--vocab", "256",
+        "--lm-layers", "1", "--lm-dim", "64", "--lm-heads", "4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
+    assert out["value"] > 0
+
+
 def test_zero_composes_with_lm_lane():
     out, _ = _run_bench(
         "--model", "transformer_lm", "--zero", "--batch-size", "2",

@@ -32,6 +32,8 @@ LANES = [
     ("resnet50", ["bench.py"]),
     ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
+    ("transformer_lm_flash", ["bench.py", "--model", "transformer_lm",
+                              "--flash-attention"]),
     ("resnet101", ["bench.py", "--model", "resnet101"]),
     ("vgg16", ["bench.py", "--model", "vgg16"]),
     ("inception_v3", ["bench.py", "--model", "inception_v3"]),
