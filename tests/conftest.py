@@ -54,7 +54,6 @@ _SLOW_TESTS = {
     "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
     "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
     "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",
-    "test_examples_models.py::TestExamples::test_jax_synthetic_benchmark_smoke",
 }
 
 
