@@ -34,7 +34,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.ops.conv_bn import conv1x1_bn_stats, fits_fused
+from horovod_tpu.ops.conv_bn import (
+    conv1x1_bn_stats,
+    conv1x1_prologue_bn_stats,
+    fits_fused,
+)
 
 ModuleDef = Any
 
@@ -65,9 +69,18 @@ class ConvBN(nn.Module):
     axis_name: Optional[str] = None
     scale_init: Callable = nn.initializers.ones_init()
     fuse: bool = False
+    # emit_raw=True returns (raw_conv_output, mul, add) instead of the
+    # normalized output: the consumer folds the BatchNorm apply (+ReLU)
+    # into its own kernel's PROLOGUE (phase-2 fusion; see
+    # ops/conv_bn.py). Statistics and running averages still update.
+    emit_raw: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, prologue=None):
+        """``prologue``: optional ``(mul, add)`` of the PRODUCING layer;
+        this layer's input ``x`` is then that layer's RAW output and the
+        normalize + ReLU happens in the fused kernel's prologue (1x1
+        fused path) or as an explicit elementwise fallback."""
         kh, kw = self.kernel_size
         cin = x.shape[-1]
         kernel = self.param(
@@ -88,6 +101,13 @@ class ConvBN(nn.Module):
         x = jnp.asarray(x, self.dtype)
         k = jnp.asarray(kernel, self.dtype)
 
+        def apply_prologue(inputs):
+            # Same elementwise math the fused prologue runs in-kernel.
+            p_mul, p_add = prologue
+            return jnp.maximum(
+                inputs * p_mul.astype(self.dtype)
+                + p_add.astype(self.dtype), 0)
+
         def conv(inputs):
             return lax.conv_general_dilated(
                 inputs, k, window_strides=self.strides,
@@ -95,22 +115,27 @@ class ConvBN(nn.Module):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=self.dtype)
 
+        can_fuse = (
+            self.fuse
+            and not self.use_running_average
+            and (kh, kw) == (1, 1)
+            and isinstance(self.padding, str)
+            and fits_fused(
+                (x.shape[0] * x.shape[1] * x.shape[2])
+                // (self.strides[0] * self.strides[1]),
+                cin, self.features,
+                itemsize=jnp.dtype(self.dtype).itemsize)
+        )
         if self.use_running_average:
-            y = conv(x)
+            y = conv(apply_prologue(x) if prologue is not None else x)
             mean, var = ra_mean.value, ra_var.value
         else:
-            can_fuse = (
-                self.fuse
-                and (kh, kw) == (1, 1)
-                and isinstance(self.padding, str)
-                and fits_fused(
-                    (x.shape[0] * x.shape[1] * x.shape[2])
-                    // (self.strides[0] * self.strides[1]),
-                    cin, self.features,
-                    itemsize=jnp.dtype(self.dtype).itemsize)
-            )
             if can_fuse:
-                y, s1, s2 = conv1x1_bn_stats(x, k, self.strides)
+                if prologue is not None:
+                    y, s1, s2 = conv1x1_prologue_bn_stats(
+                        x, prologue[0], prologue[1], k, self.strides)
+                else:
+                    y, s1, s2 = conv1x1_bn_stats(x, k, self.strides)
                 n = jnp.asarray(
                     y.shape[0] * y.shape[1] * y.shape[2], jnp.float32)
                 if self.axis_name is not None:
@@ -120,7 +145,7 @@ class ConvBN(nn.Module):
                 mean = s1 / n
                 var = s2 / n - mean * mean
             else:
-                y = conv(x)
+                y = conv(apply_prologue(x) if prologue is not None else x)
                 yf = y.astype(jnp.promote_types(jnp.float32, y.dtype))
                 mean = jnp.mean(yf, axis=(0, 1, 2))
                 msq = jnp.mean(yf * yf, axis=(0, 1, 2))
@@ -135,6 +160,8 @@ class ConvBN(nn.Module):
                 ra_var.value = m * ra_var.value + (1 - m) * var
         mul = scale * lax.rsqrt(var + self.epsilon)
         add = bias - mean * mul
+        if self.emit_raw:
+            return y, mul, add
         return y * mul.astype(self.dtype) + add.astype(self.dtype)
 
 
@@ -161,26 +188,40 @@ class ResNetBlock(nn.Module):
 
 
 class BottleneckResNetBlock(nn.Module):
-    """1x1 -> 3x3 -> 1x1 bottleneck block (ResNet-50/101/152)."""
+    """1x1 -> 3x3 -> 1x1 bottleneck block (ResNet-50/101/152).
+
+    ``prologue_fuse``: the 3x3's normalized+ReLU'd output is consumed
+    ONLY by the last 1x1, so its BatchNorm apply moves into that 1x1
+    kernel's prologue — the intermediate never reaches HBM (phase-2
+    fusion, ops/conv_bn.py; requires the activation to be ReLU)."""
 
     filters: int
     conv_bn: ModuleDef
     act: Callable
     strides: Tuple[int, int] = (1, 1)
+    prologue_fuse: bool = False
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv_bn(self.filters, (1, 1))(x)
         y = self.act(y)
-        y = self.conv_bn(self.filters, (3, 3), self.strides)(y)
-        y = self.act(y)
         # Zero-init the last norm scale so each block starts as identity:
         # standard large-batch ResNet recipe (Goyal et al.), which the
         # reference applied via its LR-warmup callbacks instead.
-        y = self.conv_bn(
-            self.filters * 4, (1, 1),
-            scale_init=nn.initializers.zeros_init())(y)
+        if self.prologue_fuse:
+            raw, mul2, add2 = self.conv_bn(
+                self.filters, (3, 3), self.strides, emit_raw=True)(y)
+            y = self.conv_bn(
+                self.filters * 4, (1, 1),
+                scale_init=nn.initializers.zeros_init())(
+                    raw, prologue=(mul2, add2))
+        else:
+            y = self.conv_bn(self.filters, (3, 3), self.strides)(y)
+            y = self.act(y)
+            y = self.conv_bn(
+                self.filters * 4, (1, 1),
+                scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv_bn(
                 self.filters * 4, (1, 1), self.strides,
@@ -223,6 +264,12 @@ class ResNet(nn.Module):
             padding=[(3, 3), (3, 3)], name="stem")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # Phase-2 prologue fusion bakes a ReLU into the kernel, so it is
+        # only wired for the canonical activation.
+        block_kwargs = {}
+        if (self.fused_bn and self.act is nn.relu
+                and self.block_cls is BottleneckResNetBlock):
+            block_kwargs["prologue_fuse"] = True
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -231,6 +278,7 @@ class ResNet(nn.Module):
                     strides=strides,
                     conv_bn=conv_bn,
                     act=self.act,
+                    **block_kwargs,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

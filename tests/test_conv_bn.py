@@ -117,6 +117,77 @@ class TestKernel:
         np.testing.assert_allclose(
             s2, jnp.sum(yf * yf, 0), rtol=1e-5, atol=1e-4)
 
+    def test_prologue_matches_unfused_f32(self, rng):
+        """Phase-2 kernel: relu(x*a+b) @ w + stats vs the materialized
+        composition."""
+        from horovod_tpu.ops.conv_bn import matmul_prologue_bn_stats
+
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        x = jax.random.normal(k1, (256, 64), jnp.float32)
+        a = jax.random.normal(k2, (64,), jnp.float32) * 0.5 + 1.0
+        b = jax.random.normal(k3, (64,), jnp.float32) * 0.1
+        w = jax.random.normal(k4, (64, 32), jnp.float32) * 0.1
+        y, s1, s2 = matmul_prologue_bn_stats(x, a, b, w, True)
+        h = jnp.maximum(x * a[None] + b[None], 0)
+        yr, s1r, s2r = _unfused(h, w)
+        np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(s1, s1r, rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(s2, s2r, rtol=1e-5, atol=1e-4)
+
+    def test_prologue_padding_rows_masked(self, rng):
+        """Regression (review r3): zero-padded rows pass through the
+        affine as relu(b) != 0 for positive shifts — the kernel must
+        mask them back to zero or the statistics are silently wrong."""
+        from horovod_tpu.ops.conv_bn import matmul_prologue_bn_stats
+
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        x = jax.random.normal(k1, (100, 32), jnp.float32)  # no divisor
+        a = jnp.ones((32,), jnp.float32)
+        b = jnp.abs(jax.random.normal(k3, (32,))) + 0.5  # positive shifts
+        w = jax.random.normal(k4, (32, 16), jnp.float32) * 0.1
+        y, s1, s2 = matmul_prologue_bn_stats(x, a, b, w, True)
+        h = jnp.maximum(x * a[None] + b[None], 0)
+        yr, s1r, s2r = _unfused(h, w)
+        assert y.shape == (100, 16)
+        np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(s1, s1r, rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(s2, s2r, rtol=1e-5, atol=1e-4)
+
+    def test_prologue_gradients_exact_f64(self, rng):
+        """All four cotangent paths (x through the ReLU mask, the affine
+        a/b, and w) vs autodiff of the materialized composition, f64."""
+        from horovod_tpu.ops.conv_bn import matmul_prologue_bn_stats
+
+        with jax.enable_x64():
+            k1, k2, k3, k4 = jax.random.split(rng, 4)
+            x = jax.random.normal(k1, (64, 16), jnp.float64)
+            a = jax.random.normal(k2, (16,), jnp.float64) * 0.5 + 1.0
+            b = jax.random.normal(k3, (16,), jnp.float64) * 0.1
+            w = jax.random.normal(k4, (16, 8), jnp.float64) * 0.1
+
+            def consume(y, s1, s2):
+                n = y.shape[0]
+                mean = s1 / n
+                var = s2 / n - mean * mean
+                return jnp.sum(((y - mean) * lax.rsqrt(var + 1e-5)) ** 2)
+
+            def fused(p):
+                x, a, b, w = p
+                return consume(*matmul_prologue_bn_stats(x, a, b, w, True))
+
+            def ref(p):
+                x, a, b, w = p
+                h = jnp.maximum(x * a[None] + b[None], 0)
+                y = h @ w
+                return consume(y, jnp.sum(y, 0), jnp.sum(y * y, 0))
+
+            gf = jax.grad(fused)((x, a, b, w))
+            gr = jax.grad(ref)((x, a, b, w))
+            jax.tree_util.tree_map(
+                lambda u, v: np.testing.assert_allclose(
+                    u, v, rtol=1e-9, atol=1e-9),
+                gf, gr)
+
     def test_fits_fused_budget(self):
         assert fits_fused(200704, 256, 64)          # resnet50 stage-1 conv1
         assert fits_fused(3136, 1024, 2048)         # stage-4 projection
