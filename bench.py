@@ -254,13 +254,55 @@ def supervise(argv, args):
     Returns the process exit code. Prints exactly one JSON line to
     stdout in every outcome (success value, or error fallback).
     """
+    import signal
     import subprocess
     import tempfile
 
     attempts = max(1, int(os.environ.get("HVD_BENCH_ATTEMPTS", "4")))
-    timeout = float(os.environ.get("HVD_BENCH_ATTEMPT_TIMEOUT", "1800"))
+    # 600s bounds one attempt (a healthy run takes ~2-3 min incl. the
+    # first compile) so the worst-case all-attempts-hang stays ~45 min —
+    # inside any sane driver window, unlike a 1800s bound.
+    timeout = float(os.environ.get("HVD_BENCH_ATTEMPT_TIMEOUT", "600"))
     backoff = float(os.environ.get("HVD_BENCH_BACKOFF", "20"))
     last_err = "unknown"
+
+    # If the DRIVER's own deadline kills us mid-attempt, still honor the
+    # one-JSON-line contract on the way out (SIGKILL excepted): without
+    # this, an outer timeout reproduces the round-2 empty record.
+    current = {"proc": None}
+
+    def _kill_group(proc):
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        try:
+            proc.wait(10)
+        except subprocess.TimeoutExpired:
+            # Uninterruptible (D-state) child: nothing more we can do;
+            # the contract line still matters more than the reap.
+            pass
+
+    def _disarm():
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGINT, signal.SIG_DFL)
+
+    def _emit_and_exit(signum, frame):
+        _disarm()  # a second signal must not print a second line
+        proc = current["proc"]
+        if proc is not None and proc.poll() is None:
+            _kill_group(proc)
+        metric_, unit_ = metric_contract(args)
+        print(json.dumps({
+            "metric": metric_, "value": None, "unit": unit_,
+            "vs_baseline": None,
+            "error": f"supervisor received signal {signum} mid-run "
+                     f"(outer/driver deadline?); last state: {last_err}",
+        }), flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _emit_and_exit)
+    signal.signal(signal.SIGINT, _emit_and_exit)
     for attempt in range(1, attempts + 1):
         with tempfile.NamedTemporaryFile(
                 mode="r", suffix=".json", delete=False) as emit:
@@ -269,20 +311,36 @@ def supervise(argv, args):
                "--_child", "--_emit", emit_path]
         print(f"[bench supervisor] attempt {attempt}/{attempts} "
               f"(timeout {timeout:.0f}s)", file=sys.stderr, flush=True)
+        last_err = f"attempt {attempt} in flight"
         try:
             # Child stderr flows through live (the driver log keeps the
             # per-iteration lines); child stdout is discarded — the
             # supervisor alone owns the one-JSON-line stdout contract.
-            proc = subprocess.run(
-                cmd, stdout=subprocess.DEVNULL, timeout=timeout)
-            rc = proc.returncode
+            # Own process group so a timeout (or the signal handler)
+            # reaps the measuring child, not just the shell of it. The
+            # spawn + handler-visible assignment happens with signals
+            # masked so a driver SIGTERM cannot land in between and
+            # orphan a child the handler does not know about.
+            mask = {signal.SIGTERM, signal.SIGINT}
+            signal.pthread_sigmask(signal.SIG_BLOCK, mask)
+            try:
+                proc = subprocess.Popen(
+                    cmd, stdout=subprocess.DEVNULL,
+                    start_new_session=True)
+                current["proc"] = proc
+            finally:
+                signal.pthread_sigmask(signal.SIG_UNBLOCK, mask)
+            rc = proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
+            _kill_group(proc)
             rc = None
             last_err = (f"attempt {attempt} exceeded the "
                         f"{timeout:.0f}s wall-clock timeout "
                         "(hung backend/tunnel)")
             print(f"[bench supervisor] {last_err}", file=sys.stderr,
                   flush=True)
+        finally:
+            current["proc"] = None
         # A parseable emit file is the success signal, even if the child
         # tripped on a nonzero exit afterwards (e.g. atexit teardown).
         try:
@@ -296,6 +354,7 @@ def supervise(argv, args):
             except OSError:
                 pass
         if payload is not None:
+            _disarm()
             print(json.dumps(payload))
             return 0
         if rc is not None:
@@ -316,6 +375,7 @@ def supervise(argv, args):
             time.sleep(backoff)
             backoff *= 2
     metric, unit = metric_contract(args)
+    _disarm()
     print(json.dumps({
         "metric": metric, "value": None, "unit": unit,
         "vs_baseline": None, "error": last_err,

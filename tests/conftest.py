@@ -39,6 +39,7 @@ _SLOW_TESTS = {
     "test_bench.py::test_lm_flash_attention_lane",
     "test_bench.py::test_hung_backend_degrades_to_error_json",
     "test_bench.py::test_crashing_child_degrades_to_error_json",
+    "test_bench.py::test_sigterm_mid_run_still_emits_contract_line",
     "test_examples_models.py::TestExamples::test_flax_imagenet_resnet50_smoke",
     "test_examples_models.py::TestExamples::test_jax_transformer_zero_smoke",
     "test_examples_models.py::TestExamples::test_jax_gpt_parallel_smoke",
