@@ -117,8 +117,10 @@ def bench_image(args, log):
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
     build_kwargs = {}
     if args.fused_bn:
-        if not args.model.lower().startswith("resnet"):
-            raise ValueError("--fused-bn applies to the ResNet family only")
+        name = args.model.lower()
+        if not (name.startswith("resnet") or name.startswith("inception")):
+            raise ValueError(
+                "--fused-bn applies to the ResNet and Inception families")
         build_kwargs["fused_bn"] = True
     model = models.build(args.model, num_classes=1000, dtype=dtype,
                          **build_kwargs)

@@ -16,31 +16,41 @@ from typing import Any, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from horovod_tpu.models.resnet import ConvBN as _SharedConvBN
+
 
 class ConvBN(nn.Module):
+    """Conv + BN + ReLU through the shared :class:`resnet.ConvBN`, so the
+    many 1x1 convolutions Inception is built from can run the fused
+    Pallas matmul + statistics kernel (``fuse=True``; phase-1 only —
+    Inception's 1x1 outputs feed non-1x1 consumers, so the prologue
+    variant does not apply)."""
+
     features: int
     kernel: Tuple[int, int]
     strides: Tuple[int, int] = (1, 1)
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                    padding=self.padding, use_bias=False,
-                    dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-3, dtype=jnp.float32)(x)
-        return nn.relu(x)
+        y = _SharedConvBN(self.features, self.kernel, self.strides,
+                          padding=self.padding,
+                          use_running_average=not train, momentum=0.9,
+                          epsilon=1e-3, dtype=self.dtype,
+                          fuse=self.fuse)(x)
+        return nn.relu(y)
 
 
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fuse)
         b1 = c(64, (1, 1))(x, train)
         b2 = c(64, (5, 5))(c(48, (1, 1))(x, train), train)
         b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train),
@@ -52,10 +62,11 @@ class InceptionA(nn.Module):
 
 class InceptionB(nn.Module):
     dtype: Any
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fuse)
         b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
         b2 = c(96, (3, 3), strides=(2, 2), padding="VALID")(
             c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
@@ -66,10 +77,11 @@ class InceptionB(nn.Module):
 class InceptionC(nn.Module):
     channels_7x7: int
     dtype: Any
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fuse)
         c7 = self.channels_7x7
         b1 = c(192, (1, 1))(x, train)
         b2 = c(c7, (1, 1))(x, train)
@@ -87,10 +99,11 @@ class InceptionC(nn.Module):
 
 class InceptionD(nn.Module):
     dtype: Any
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fuse)
         b1 = c(320, (3, 3), strides=(2, 2), padding="VALID")(
             c(192, (1, 1))(x, train), train)
         b2 = c(192, (1, 1))(x, train)
@@ -103,10 +116,11 @@ class InceptionD(nn.Module):
 
 class InceptionE(nn.Module):
     dtype: Any
+    fuse: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fuse)
         b1 = c(320, (1, 1))(x, train)
         b2 = c(384, (1, 1))(x, train)
         b2 = jnp.concatenate([c(384, (1, 3))(b2, train),
@@ -125,10 +139,11 @@ class InceptionV3(nn.Module):
 
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    fused_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, fuse=self.fused_bn)
         x = x.astype(self.dtype)
         x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
         x = c(32, (3, 3), padding="VALID")(x, train)
@@ -137,17 +152,18 @@ class InceptionV3(nn.Module):
         x = c(80, (1, 1), padding="VALID")(x, train)
         x = c(192, (3, 3), padding="VALID")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
-        x = InceptionA(32, self.dtype)(x, train)
-        x = InceptionA(64, self.dtype)(x, train)
-        x = InceptionA(64, self.dtype)(x, train)
-        x = InceptionB(self.dtype)(x, train)
-        x = InceptionC(128, self.dtype)(x, train)
-        x = InceptionC(160, self.dtype)(x, train)
-        x = InceptionC(160, self.dtype)(x, train)
-        x = InceptionC(192, self.dtype)(x, train)
-        x = InceptionD(self.dtype)(x, train)
-        x = InceptionE(self.dtype)(x, train)
-        x = InceptionE(self.dtype)(x, train)
+        f = self.fused_bn
+        x = InceptionA(32, self.dtype, f)(x, train)
+        x = InceptionA(64, self.dtype, f)(x, train)
+        x = InceptionA(64, self.dtype, f)(x, train)
+        x = InceptionB(self.dtype, f)(x, train)
+        x = InceptionC(128, self.dtype, f)(x, train)
+        x = InceptionC(160, self.dtype, f)(x, train)
+        x = InceptionC(160, self.dtype, f)(x, train)
+        x = InceptionC(192, self.dtype, f)(x, train)
+        x = InceptionD(self.dtype, f)(x, train)
+        x = InceptionE(self.dtype, f)(x, train)
+        x = InceptionE(self.dtype, f)(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.5, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -48,6 +48,7 @@ _SLOW_TESTS = {
     "test_examples_models.py::TestExamples::test_torch_mnist_via_launcher",
     "test_examples_models.py::TestExamples::test_torch_synthetic_benchmark_via_launcher",
     "test_examples_models.py::TestModelZoo::test_forward_shapes[inception_v3-shape1]",
+    "test_conv_bn.py::TestFusedResNet::test_inception_fused_matches_unfused",
     "test_examples_models.py::TestModelZoo::test_vgg16_train_step_runs",
     "test_models.py::test_graft_entry_multichip_subprocess",
     "test_multiprocess_spmd.py::test_two_process_global_mesh_end_to_end",
