@@ -37,6 +37,8 @@ LANES = [
     ("resnet101", ["bench.py", "--model", "resnet101"]),
     ("vgg16", ["bench.py", "--model", "vgg16"]),
     ("inception_v3", ["bench.py", "--model", "inception_v3"]),
+    ("inception_v3_fused_bn", ["bench.py", "--model", "inception_v3",
+                               "--fused-bn"]),
     ("flash_check", ["tools/tpu_flash_check.py"]),
     ("resnet50_bs128", ["bench.py", "--batch-size", "128"]),
     ("resnet50_bs256", ["bench.py", "--batch-size", "256"]),
