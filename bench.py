@@ -10,7 +10,12 @@ run over a 1-D "hvd" mesh of every visible chip.
 
 Prints ONE JSON line:
     {"metric": "resnet50_img_per_sec_per_chip", "value": N,
-     "unit": "img/sec/chip", "vs_baseline": N}
+     "unit": "img/sec/chip", "vs_baseline": N, "peak": N}
+
+``peak`` is the best timed window's rate — on a shared/tunneled chip it
+bounds what the program does when the device is actually ours, while
+``value`` (the mean) stays the protocol's headline number. Degraded
+records carry the same keys with null values plus an ``"error"`` field.
 
 ``vs_baseline`` compares against the reference's published per-GPU
 absolute throughput: 1656.82 img/s over 16 Pascal GPUs = 103.55 img/s/GPU
@@ -99,7 +104,10 @@ def run_timed(run_step, state, batch, args, units_per_iter, unit, log):
         log(f"WARNING: high variance (CI {conf:.0f} vs mean {mean:.0f}) — "
             "noisy/shared chip; rerun on a quiet machine for a "
             "representative number", file=sys.stderr)
-    return mean, conf
+    # The best window is the least-contended observation: on a shared/
+    # tunneled chip it bounds what the program can do when the device is
+    # actually ours, while the mean stays the protocol's headline number.
+    return mean, conf, float(np.max(rates))
 
 
 def bench_image(args, log):
@@ -154,12 +162,12 @@ def bench_image(args, log):
     log(f"Model: {args.model}, batch size {batch_size}/chip, {n} chips "
         f"({jax.devices()[0].platform})", file=sys.stderr)
     units_per_iter = batch_size * args.num_batches_per_iter
-    mean, conf = run_timed(run_step, state, batch, args, units_per_iter,
-                           "img/sec", log)
+    mean, conf, peak = run_timed(run_step, state, batch, args,
+                                 units_per_iter, "img/sec", log)
     log(f"Total img/sec on {n} chip(s): {mean * n:.1f} +-{conf * n:.1f}",
         file=sys.stderr)
     metric, unit = metric_contract(args)
-    return mean, unit, metric
+    return mean, peak, unit, metric
 
 
 def bench_lm(args, log):
@@ -233,12 +241,12 @@ def bench_lm(args, log):
         f"seq {L}, batch {batch_size} seqs/chip, {n} chips "
         f"({jax.devices()[0].platform})", file=sys.stderr)
     units_per_iter = batch_size * L * args.num_batches_per_iter
-    mean, conf = run_timed(run_step, state, batch, args, units_per_iter,
-                           "tokens/sec", log)
+    mean, conf, peak = run_timed(run_step, state, batch, args,
+                                 units_per_iter, "tokens/sec", log)
     log(f"Total tokens/sec on {n} chip(s): {mean * n:.1f} "
         f"+-{conf * n:.1f}", file=sys.stderr)
     metric, unit = metric_contract(args)
-    return mean, unit, metric
+    return mean, peak, unit, metric
 
 
 def metric_contract(args):
@@ -297,7 +305,7 @@ def supervise(argv, args):
         metric_, unit_ = metric_contract(args)
         print(json.dumps({
             "metric": metric_, "value": None, "unit": unit_,
-            "vs_baseline": None,
+            "vs_baseline": None, "peak": None,
             "error": f"supervisor received signal {signum} mid-run "
                      f"(outer/driver deadline?); last state: {last_err}",
         }), flush=True)
@@ -380,7 +388,7 @@ def supervise(argv, args):
     _disarm()
     print(json.dumps({
         "metric": metric, "value": None, "unit": unit,
-        "vs_baseline": None, "error": last_err,
+        "vs_baseline": None, "peak": None, "error": last_err,
     }))
     return 0
 
@@ -446,9 +454,9 @@ def main():
         log = print if hvd.rank() == 0 else (lambda *a, **k: None)
 
         if args.model == "transformer_lm":
-            mean, unit, metric = bench_lm(args, log)
+            mean, peak, unit, metric = bench_lm(args, log)
         else:
-            mean, unit, metric = bench_image(args, log)
+            mean, peak, unit, metric = bench_image(args, log)
     except Exception as exc:
         # Tell the supervisor whether a retry can help: backend/tunnel
         # flaps are transient; everything else (unknown model, shape
@@ -469,6 +477,7 @@ def main():
             "value": round(mean, 2),
             "unit": unit,
             "vs_baseline": round(mean / base, 3) if base else None,
+            "peak": round(peak, 2),
         })
         print(line)
         if args._emit:
