@@ -40,6 +40,7 @@ def test_default_lane_contract():
     assert out["unit"] == "img/sec/chip"
     assert out["value"] > 0
     assert out["vs_baseline"] > 0
+    assert out["probe_tflops"] > 0
 
 
 def test_lm_lane_contract():

@@ -42,6 +42,11 @@ LANES = [
     ("flash_check", ["tools/tpu_flash_check.py"]),
     ("resnet50_bs128", ["bench.py", "--batch-size", "128"]),
     ("resnet50_bs256", ["bench.py", "--batch-size", "256"]),
+    # ViT: the compute-bound (MXU-friendly) image lane — unlike the
+    # memory-bound ResNet family it should approach the chip's matmul
+    # rate, quantifying how much of the ResNet gap is the model, not
+    # the framework (PERF.md "memory-bound by design").
+    ("vit_b16", ["bench.py", "--model", "vit_b16"]),
 ]
 
 
