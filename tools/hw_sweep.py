@@ -112,6 +112,9 @@ def main() -> int:
                      f"have {sorted(known)}")
 
     env = dict(os.environ)
+    # `python tools/x.py` puts tools/ on sys.path, not the repo root —
+    # every lane must import horovod_tpu regardless of entry location.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # One in-lane retry round; the sweep moves on rather than stalling
     # the whole window on one wedged lane. Budget the per-attempt
     # timeout so both attempts + the backoff + final-JSON slack fit
