@@ -242,7 +242,8 @@ def bench_lm(args, log):
     model = models.TransformerLM(
         vocab_size=args.vocab, num_layers=args.lm_layers,
         num_heads=args.lm_heads, embed_dim=args.lm_dim,
-        max_len=max(L, 2048), dtype=dtype, attn_fn=attn_fn)
+        max_len=max(L, 2048), dtype=dtype, attn_fn=attn_fn,
+        scan_layers=args.scan_layers, remat=args.remat)
     rng = jax.random.PRNGKey(42)
     sample = jnp.zeros((1, L), jnp.int32)
     # --bf16-momentum maps to adam's first-moment dtype on this lane (the
@@ -455,6 +456,16 @@ def main():
                         help="transformer_lm: run the Pallas flash "
                              "attention kernel instead of dense "
                              "attention (A/B at the same protocol)")
+    parser.add_argument("--scan-layers", action="store_true",
+                        help="transformer_lm: compile the layer stack as "
+                             "one lax.scan step over weight-stacked params "
+                             "— ~flat compile time in depth (the unrolled "
+                             "default grows linearly), at a small step-"
+                             "time cost from lost cross-layer fusion")
+    parser.add_argument("--remat", action="store_true",
+                        help="transformer_lm: rematerialize each block on "
+                             "the backward pass (activation memory O(1) "
+                             "in depth — the long-context default)")
     parser.add_argument("--fused-bn", action="store_true",
                         help="ResNet family: compute BN statistics in the "
                              "1x1-conv matmul epilogue (Pallas kernel, "

@@ -58,8 +58,40 @@ class TransformerBlock(nn.Module):
         return x + nn.Dense(E, dtype=self.dtype)(h)
 
 
+class _CarryBlock(nn.Module):
+    """TransformerBlock with a (carry, _) -> (carry, None) signature so
+    ``nn.scan`` can stack it along a layer axis."""
+
+    num_heads: int
+    dtype: Any
+    attn_fn: Optional[Callable]
+    dropout: float
+    train: bool
+    q_offset: int
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = TransformerBlock(self.num_heads, dtype=self.dtype,
+                             attn_fn=self.attn_fn, dropout=self.dropout)(
+                                 x, train=self.train, q_offset=self.q_offset)
+        return x, None
+
+
 class TransformerLM(nn.Module):
-    """Causal LM: token ids [B, L] -> logits [B, L, vocab]."""
+    """Causal LM: token ids [B, L] -> logits [B, L, vocab].
+
+    ``scan_layers`` compiles the layer stack as ONE ``lax.scan`` step
+    over weight-stacked parameters instead of ``num_layers`` unrolled
+    copies — XLA traces/compiles a single block, so compile time is
+    ~flat in depth (the unrolled path grows linearly; on a tunneled
+    backend where big first-compiles time out, that is the difference
+    between a recorded benchmark and none). Parameters change layout
+    (each block param gains a leading [num_layers] axis), so the two
+    layouts are not checkpoint-compatible; per-layer math is identical
+    (equivalence pinned in tests/test_models.py). ``remat`` additionally
+    rematerializes each block on the backward pass — activation memory
+    O(1) in depth, the long-context training default.
+    """
 
     vocab_size: int = 32000
     num_layers: int = 4
@@ -69,6 +101,8 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_fn: Optional[Callable] = None
     dropout: float = 0.0
+    scan_layers: bool = False
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset: int = 0):
@@ -80,11 +114,30 @@ class TransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.embed_dim,
                          dtype=self.dtype)(pos)[None]
-        for _ in range(self.num_layers):
-            x = TransformerBlock(self.num_heads, dtype=self.dtype,
-                                 attn_fn=self.attn_fn,
-                                 dropout=self.dropout)(
-                                     x, train=train, q_offset=pos_offset)
+        if self.scan_layers:
+            block = _CarryBlock
+            if self.remat:
+                block = nn.remat(block, prevent_cse=False)
+            scan = nn.scan(block,
+                           variable_axes={"params": 0},
+                           split_rngs={"params": True, "dropout": True},
+                           length=self.num_layers)
+            x, _ = scan(self.num_heads, self.dtype, self.attn_fn,
+                        self.dropout, train, pos_offset,
+                        name="layers")(x, None)
+        else:
+            blk = TransformerBlock
+            if self.remat:
+                # self=0, x=1: train and q_offset stay Python-static.
+                blk = nn.remat(blk, prevent_cse=False,
+                               static_argnums=(2, 3))
+            for i in range(self.num_layers):
+                # Explicit names keep the param tree identical whether
+                # or not the block is remat-wrapped (nn.remat would
+                # otherwise prefix the auto-name with "Checkpoint").
+                x = blk(self.num_heads, dtype=self.dtype,
+                        attn_fn=self.attn_fn, dropout=self.dropout,
+                        name=f"TransformerBlock_{i}")(x, train, pos_offset)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         use_bias=False)(x)

@@ -270,3 +270,60 @@ def test_transformer_lm_trains_with_flash_attention(rng):
                     jax.tree_util.tree_leaves(gd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_scan_layers_matches_unrolled(rng):
+    """scan_layers compiles ONE weight-stacked block (lax.scan) instead
+    of num_layers unrolled copies; per-layer math must be identical.
+    Transplants the stacked params into the unrolled layout and pins
+    logits AND gradients across the two layouts, plus the remat
+    variants (which must be numerically a no-op)."""
+    kw = dict(vocab_size=61, num_layers=3, num_heads=2, embed_dim=24,
+              max_len=32, dtype=jnp.float32)
+    scan_m = models.TransformerLM(scan_layers=True, **kw)
+    unrl_m = models.TransformerLM(**kw)
+    tokens = jax.random.randint(rng, (2, 16), 0, 61)
+
+    ps = scan_m.init(rng, tokens, train=False)["params"]
+    stacked = ps["layers"]["TransformerBlock_0"]
+    pu = {k: v for k, v in ps.items() if k != "layers"}
+    for i in range(kw["num_layers"]):
+        pu[f"TransformerBlock_{i}"] = jax.tree.map(
+            lambda a, i=i: a[i], stacked)
+
+    def loss(model, params):
+        logits = model.apply({"params": params}, tokens, train=False)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp[:, :-1], tokens[:, 1:, None], -1))
+
+    ls, gs = jax.value_and_grad(lambda p: loss(scan_m, p))(ps)
+    lu, gu = jax.value_and_grad(lambda p: loss(unrl_m, p))(pu)
+    np.testing.assert_allclose(float(ls), float(lu), rtol=1e-6)
+
+    # Gradients: restack the unrolled per-layer grads and compare.
+    gu_stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[gu[f"TransformerBlock_{i}"] for i in range(kw["num_layers"])])
+    for a, b in zip(jax.tree_util.tree_leaves(
+            gs["layers"]["TransformerBlock_0"]),
+            jax.tree_util.tree_leaves(gu_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for name in ["Embed_0", "Embed_1", "LayerNorm_0", "Dense_0"]:
+        for a, b in zip(jax.tree_util.tree_leaves(gs[name]),
+                        jax.tree_util.tree_leaves(gu[name])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    # remat is a scheduling choice, not a numerical one.
+    for scan in (True, False):
+        m = models.TransformerLM(scan_layers=scan, remat=True, **kw)
+        p = ps if scan else pu
+        lr, gr = jax.value_and_grad(lambda q: loss(m, q))(p)
+        np.testing.assert_allclose(float(lr), float(ls), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(gr),
+                        jax.tree_util.tree_leaves(
+                            gs if scan else gu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
