@@ -51,13 +51,18 @@ def dot_product_attention(q, k, v, causal: bool = False,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   seq_k: int, causal: bool, scale: float, block_q: int):
     """One (batch*head, q-block) program: stream K/V blocks through VMEM
-    with online softmax so only O(block_q x d) state persists."""
+    with online softmax so only O(block_q x d) state persists.
+
+    Mosaic discipline: every ref and every loop-carried value is kept
+    2-D ([block_q, 1] for the m/l statistics, [1, block_q] for the lse
+    output row) — 1-D vregs are the classic TPU-lowering trap that
+    interpret-mode CI cannot catch."""
     from jax.experimental import pallas as pl
 
     q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
     qi = pl.program_id(1)
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
     n_kblocks = seq_k // block_k
@@ -73,11 +78,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + p @ v_blk
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ v_blk
         return m_new, l_new, acc_new
 
     if causal:
@@ -89,10 +94,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     else:
         m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
 
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Per-row logsumexp (scores already include `scale`): persisted so the
     # backward never re-derives it with an extra pass over the key blocks.
-    lse_ref[...] = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[...] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(1, block_q)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -156,7 +161,9 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            # 2-D [1, block_q] row per program (no squeezed 1-D output
+            # ref — see the kernel's Mosaic-discipline note).
+            pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),

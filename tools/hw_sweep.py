@@ -115,6 +115,14 @@ def main() -> int:
     # `python tools/x.py` puts tools/ on sys.path, not the repo root —
     # every lane must import horovod_tpu regardless of entry location.
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Persistent compilation cache: a lane rerun (or a later A/B of the
+    # same program) skips XLA compilation entirely if the backend
+    # supports executable serialization; if it doesn't, jax logs a
+    # warning and proceeds — strictly better on a tunnel where big
+    # first-compiles are what time lanes out.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     # One in-lane retry round; the sweep moves on rather than stalling
     # the whole window on one wedged lane. Budget the per-attempt
     # timeout so both attempts + the backoff + final-JSON slack fit
