@@ -291,6 +291,8 @@ def metric_contract(args):
     """(metric, unit) the JSON line will carry — known without a backend,
     so the failure fallback can emit the same contract the success path
     would have."""
+    if getattr(args, "probe_only", False):
+        return "chip_probe_tflops", "TFLOP/s"
     if args.model == "transformer_lm":
         return "transformer_lm_tokens_per_sec_per_chip", "tokens/sec/chip"
     return f"{args.model}_img_per_sec_per_chip", "img/sec/chip"
@@ -456,6 +458,12 @@ def main():
                         help="transformer_lm: run the Pallas flash "
                              "attention kernel instead of dense "
                              "attention (A/B at the same protocol)")
+    parser.add_argument("--probe-only", action="store_true",
+                        help="emit only the chip-condition probe "
+                             "(metric chip_probe_tflops) and exit — a "
+                             "~30s structured health check for deciding "
+                             "whether a measurement window is worth "
+                             "spending")
     parser.add_argument("--scan-layers", action="store_true",
                         help="transformer_lm: compile the layer stack as "
                              "one lax.scan step over weight-stacked params "
@@ -501,6 +509,20 @@ def main():
 
         hvd.init()
         log = print if hvd.rank() == 0 else (lambda *a, **k: None)
+
+        if args.probe_only:
+            probe = probe_chip(log)
+            if hvd.rank() == 0:
+                line = json.dumps({
+                    "metric": "chip_probe_tflops", "value": probe,
+                    "unit": "TFLOP/s", "vs_baseline": None,
+                    "peak": None, "probe_tflops": probe,
+                })
+                print(line)
+                if args._emit:
+                    with open(args._emit, "w") as f:
+                        f.write(line + "\n")
+            return
 
         if args.model == "transformer_lm":
             mean, peak, unit, metric = bench_lm(args, log)
