@@ -160,6 +160,11 @@ def bench_image(args, log):
     n = hvd.size()
     batch_size = args.batch_size if args.batch_size is not None else 64
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    for flag in ("fused_ce", "scan_layers", "remat", "flash_attention"):
+        if getattr(args, flag):
+            raise ValueError(
+                f"--{flag.replace('_', '-')} applies to transformer_lm "
+                f"only (got --model {args.model})")
     build_kwargs = {}
     if args.fused_bn:
         name = args.model.lower()
@@ -257,12 +262,29 @@ def bench_lm(args, log):
     def step_fn(state, batch):
         tokens = batch["tokens"]
 
-        def loss_fn(params):
-            logits = model.apply({"params": params}, tokens, train=False)
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            tgt = tokens[:, 1:]
-            nll = -jnp.take_along_axis(logp, tgt[..., None], -1)
-            return jnp.mean(nll)
+        if args.fused_ce:
+            # Chunked fused loss (ops/xent.py): the [B, L, vocab] fp32
+            # logits tensor — the step's largest single HBM sink —
+            # never materializes; the vocab projection's gradient comes
+            # out of the same scan.
+            from horovod_tpu.ops.xent import fused_cross_entropy
+
+            def loss_fn(params):
+                hidden = model.apply({"params": params}, tokens,
+                                     train=False, return_hidden=True)
+                e = hidden.shape[-1]
+                h = hidden[:, :-1].reshape(-1, e).astype(jnp.float32)
+                wv = params["Dense_0"]["kernel"].astype(jnp.float32)
+                return fused_cross_entropy(h, wv, tokens[:, 1:].reshape(-1))
+        else:
+            def loss_fn(params):
+                logits = model.apply({"params": params}, tokens,
+                                     train=False)
+                logp = jax.nn.log_softmax(
+                    logits[:, :-1].astype(jnp.float32))
+                tgt = tokens[:, 1:]
+                nll = -jnp.take_along_axis(logp, tgt[..., None], -1)
+                return jnp.mean(nll)
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         return models.apply_gradients(optimizer, state, grads), loss
@@ -464,6 +486,10 @@ def main():
                              "~30s structured health check for deciding "
                              "whether a measurement window is worth "
                              "spending")
+    parser.add_argument("--fused-ce", action="store_true",
+                        help="transformer_lm: chunked fused cross-"
+                             "entropy (ops/xent.py) — the [B,L,vocab] "
+                             "fp32 logits tensor never materializes")
     parser.add_argument("--scan-layers", action="store_true",
                         help="transformer_lm: compile the layer stack as "
                              "one lax.scan step over weight-stacked params "

@@ -105,10 +105,17 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, pos_offset: int = 0):
+    def __call__(self, tokens, train: bool = True, pos_offset: int = 0,
+                 return_hidden: bool = False):
         """``pos_offset``: global position of tokens[:, 0] — sequence-
         parallel callers pass their shard's offset so positional
-        embeddings and causal masks stay globally consistent."""
+        embeddings and causal masks stay globally consistent.
+
+        ``return_hidden`` skips the vocab projection and returns the
+        final-LayerNorm hidden states [B, L, E] — for fused losses
+        (ops/xent.py) that consume the projection weight directly and
+        never materialize [B, L, vocab] logits. Init with the default
+        so the Dense param exists either way."""
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype)(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
@@ -139,5 +146,7 @@ class TransformerLM(nn.Module):
                         attn_fn=self.attn_fn, dropout=self.dropout,
                         name=f"TransformerBlock_{i}")(x, train, pos_offset)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
+        if return_hidden:
+            return x
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         use_bias=False)(x)

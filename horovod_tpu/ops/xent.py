@@ -1,0 +1,116 @@
+"""Chunked fused softmax-cross-entropy: the LM lane's logits never
+materialize.
+
+A causal-LM training step at GPT-2-small scale (16k tokens/chip, vocab
+32k) writes a [T, V] fp32 logits tensor of ~2 GB, reads it for
+log-softmax, and touches it again on the backward — on a chip whose
+step is HBM-bound, the loss head alone is ~a third of the traffic
+(PERF.md). This op computes
+
+    mean over tokens of  -log softmax(h @ w)[target]
+
+by ``lax.scan`` over TOKEN chunks: each step computes one
+[t_chunk, V] logits block, reduces it to per-token (logsumexp,
+target-logit) immediately, and lets XLA recycle the block — peak live
+logits memory is T/t_chunk times smaller, and the full tensor never
+round-trips HBM. The backward recomputes each chunk's logits
+(T·E·V MACs again — small next to the GBs of traffic saved on a
+memory-bound step) and accumulates ``dw`` in an fp32 scan carry while
+streaming ``dh`` out per chunk.
+
+The reference framework has no fused loss (its LM story is absent
+altogether — SURVEY §5 long-context); this is TPU-first perf work in
+the spirit of its fusion buffer: restructure the computation so the
+interconnect — here HBM — moves as few bytes as the math allows.
+
+Exactness (loss AND both gradients) vs the dense composition is pinned
+in tests/test_xent.py; ``bench.py --fused-ce`` A/Bs it at protocol
+scale.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pad_tokens(h, targets, t_chunk):
+    """Pad the token axis to a multiple of t_chunk; padded rows carry
+    weight 0 and target 0 (any valid index)."""
+    t = h.shape[0]
+    pad = (-t) % t_chunk
+    weights = jnp.ones((t,), jnp.float32)
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        weights = jnp.pad(weights, (0, pad))
+    return h, targets, weights, t
+
+
+def _chunk_stats(hc, w, tc):
+    """One chunk's per-token (lse, target_logit), fp32."""
+    logits = jnp.dot(hc, w, preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+    return lse, tgt
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_cross_entropy(h, w, targets, t_chunk: int = 512):
+    """Mean negative log-likelihood without materializing [T, V] logits.
+
+    h [T, E] (any float dtype; the matmul accumulates fp32),
+    w [E, V], targets [T] int32 -> scalar fp32 mean NLL over T tokens.
+    """
+    loss, _ = _fce_fwd(h, w, targets, t_chunk)
+    return loss
+
+
+def _fce_fwd(h, w, targets, t_chunk):
+    hp, tp, weights, t = _pad_tokens(h, targets, t_chunk)
+    n = hp.shape[0] // t_chunk
+    hcs = hp.reshape(n, t_chunk, h.shape[1])
+    tcs = tp.reshape(n, t_chunk)
+    wcs = weights.reshape(n, t_chunk)
+
+    def step(acc, xs):
+        hc, tc, wc = xs
+        lse, tgt = _chunk_stats(hc, w, tc)
+        return acc + jnp.sum((lse - tgt) * wc), None
+
+    total, _ = lax.scan(step, jnp.float32(0.0), (hcs, tcs, wcs))
+    return total / t, (h, w, targets)
+
+
+def _fce_bwd(t_chunk, res, g):
+    h, w, targets = res
+    hp, tp, weights, t = _pad_tokens(h, targets, t_chunk)
+    n = hp.shape[0] // t_chunk
+    e = h.shape[1]
+    hcs = hp.reshape(n, t_chunk, e)
+    tcs = tp.reshape(n, t_chunk)
+    wcs = weights.reshape(n, t_chunk)
+    scale = g / t  # d(mean)/d(per-token nll), folded in fp32
+
+    def step(dw_acc, xs):
+        hc, tc, wc = xs
+        logits = jnp.dot(hc, w, preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(tc, w.shape[1], dtype=jnp.float32)
+        dl = (p - onehot) * (wc * scale)[:, None]  # [t_chunk, V] fp32
+        dh_c = jnp.dot(dl, w.T.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        dw_acc = dw_acc + jnp.dot(hc.astype(jnp.float32).T, dl,
+                                  preferred_element_type=jnp.float32)
+        return dw_acc, dh_c
+
+    dw, dhs = lax.scan(step, jnp.zeros(w.shape, jnp.float32),
+                       (hcs, tcs, wcs))
+    dh = dhs.reshape(n * t_chunk, e)[:h.shape[0]]
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+fused_cross_entropy.defvjp(_fce_fwd, _fce_bwd)

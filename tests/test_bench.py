@@ -44,11 +44,15 @@ def test_default_lane_contract():
 
 
 def test_lm_lane_contract():
-    """Long-context lane: tokens/sec with vs_baseline null."""
+    """Long-context lane: tokens/sec with vs_baseline null. Runs with
+    the round-3 perf flags (--fused-ce --scan-layers --remat) so the
+    whole optimized path is driven end-to-end; the plain dense path is
+    pinned by test_models/test_xent equivalences."""
     out, proc = _run_bench(
         "--model", "transformer_lm", "--batch-size", "2",
         "--seq-len", "128", "--vocab", "512", "--lm-layers", "2",
         "--lm-dim", "64", "--lm-heads", "4",
+        "--fused-ce", "--scan-layers", "--remat",
         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
         "--num-iters", "2")
     assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"

@@ -1,0 +1,85 @@
+"""Chunked fused cross-entropy (ops/xent.py) vs the dense composition.
+
+The dense reference materializes [T, V] logits and log-softmaxes them —
+exactly what the LM bench's unfused loss does (bench.py bench_lm); the
+fused op must match its loss and gradients while never building the
+full logits tensor.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.xent import fused_cross_entropy
+
+
+def _dense_nll(h, w, targets):
+    logits = jnp.dot(h, w, preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[:, None], -1))
+
+
+@pytest.mark.parametrize("t,chunk", [(64, 16), (60, 16), (16, 16)])
+def test_fused_ce_matches_dense(t, chunk):
+    """Loss + dh + dw exact vs dense, incl. a non-divisible token count
+    (60 % 16 != 0 exercises the pad/weight path)."""
+    key = jax.random.PRNGKey(0)
+    e, v = 32, 97
+    h = jax.random.normal(key, (t, e), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (e, v), jnp.float32)
+    targets = jax.random.randint(jax.random.fold_in(key, 2), (t,), 0, v)
+
+    ld, (gdh, gdw) = jax.value_and_grad(_dense_nll, argnums=(0, 1))(
+        h, w, targets)
+    lf, (fdh, fdw) = jax.value_and_grad(
+        lambda h, w: fused_cross_entropy(h, w, targets, chunk),
+        argnums=(0, 1))(h, w)
+
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fdh), np.asarray(gdh),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fdw), np.asarray(gdw),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ce_bf16_hidden():
+    """bf16 hidden states (the LM's compute dtype): fp32 accumulation
+    inside, gradients returned in the input dtypes."""
+    key = jax.random.PRNGKey(3)
+    t, e, v = 48, 16, 53
+    h = jax.random.normal(key, (t, e), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (e, v), jnp.float32)
+    targets = jax.random.randint(jax.random.fold_in(key, 2), (t,), 0, v)
+
+    ld, (gdh, gdw) = jax.value_and_grad(
+        lambda h, w: _dense_nll(h.astype(jnp.float32), w, targets),
+        argnums=(0, 1))(h, w)
+    lf, (fdh, fdw) = jax.value_and_grad(
+        lambda h, w: fused_cross_entropy(
+            h.astype(jnp.float32), w, targets, 16),
+        argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    assert fdh.dtype == h.dtype and fdw.dtype == w.dtype
+    np.testing.assert_allclose(np.asarray(fdh, np.float32),
+                               np.asarray(gdh, np.float32),
+                               rtol=2e-2, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(fdw), np.asarray(gdw),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_ce_never_builds_full_logits():
+    """Structural guarantee: the jaxpr of the fused op contains no
+    [T, V]-shaped intermediate when T spans multiple chunks."""
+    t, e, v, chunk = 64, 8, 331, 16
+    h = jnp.zeros((t, e), jnp.float32)
+    w = jnp.zeros((e, v), jnp.float32)
+    targets = jnp.zeros((t,), jnp.int32)
+
+    jaxpr = jax.make_jaxpr(
+        jax.grad(lambda h: fused_cross_entropy(h, w, targets, chunk)))(h)
+    shapes = [getattr(var.aval, "shape", ())
+              for eqn in jaxpr.jaxpr.eqns for var in eqn.outvars]
+    # Scan internals may carry [chunk, V] blocks; the full [T, V] (or
+    # bigger) must never appear.
+    assert not any(s == (t, v) for s in shapes), shapes

@@ -32,6 +32,10 @@ LANES = [
     ("resnet50", ["bench.py"]),
     ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
+    # Adjacent to the dense lane so the A/B shares chip condition: the
+    # chunked fused loss removes the step's largest HBM tensor.
+    ("transformer_lm_fused_ce", ["bench.py", "--model", "transformer_lm",
+                                 "--fused-ce"]),
     ("transformer_lm_flash", ["bench.py", "--model", "transformer_lm",
                               "--flash-attention"]),
     ("resnet101", ["bench.py", "--model", "resnet101"]),
