@@ -223,6 +223,10 @@ def bench_lm(args, log):
     import horovod_tpu.jax as hvd
     from horovod_tpu import models
 
+    if args.fused_bn:
+        raise ValueError(
+            "--fused-bn applies to the ResNet and Inception families "
+            "(got --model transformer_lm)")
     n = hvd.size()
     # sequences per chip
     batch_size = args.batch_size if args.batch_size is not None else 8
@@ -274,7 +278,7 @@ def bench_lm(args, log):
                                      train=False, return_hidden=True)
                 e = hidden.shape[-1]
                 h = hidden[:, :-1].reshape(-1, e).astype(jnp.float32)
-                wv = params["Dense_0"]["kernel"].astype(jnp.float32)
+                wv = params["lm_head"]["kernel"].astype(jnp.float32)
                 return fused_cross_entropy(h, wv, tokens[:, 1:].reshape(-1))
         else:
             def loss_fn(params):

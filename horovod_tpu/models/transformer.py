@@ -148,5 +148,8 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         if return_hidden:
             return x
+        # Explicitly named so fused losses can address the projection
+        # weight (params["lm_head"]["kernel"]) without depending on
+        # flax auto-numbering staying stable.
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
-                        use_bias=False)(x)
+                        use_bias=False, name="lm_head")(x)

@@ -310,7 +310,7 @@ def test_scan_layers_matches_unrolled(rng):
             jax.tree_util.tree_leaves(gu_stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
-    for name in ["Embed_0", "Embed_1", "LayerNorm_0", "Dense_0"]:
+    for name in ["Embed_0", "Embed_1", "LayerNorm_0", "lm_head"]:
         for a, b in zip(jax.tree_util.tree_leaves(gs[name]),
                         jax.tree_util.tree_leaves(gu[name])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
