@@ -3,7 +3,8 @@
 from horovod_tpu.ops.attention import dot_product_attention, flash_attention
 from horovod_tpu.ops.conv_bn import (conv1x1_bn_stats,
                                      conv1x1_prologue_bn_stats)
-from horovod_tpu.ops.xent import fused_cross_entropy
+from horovod_tpu.ops.xent import (fused_cross_entropy,
+                                  tp_vocab_cross_entropy)
 
 __all__ = [
     "dot_product_attention",
@@ -11,4 +12,5 @@ __all__ = [
     "conv1x1_bn_stats",
     "conv1x1_prologue_bn_stats",
     "fused_cross_entropy",
+    "tp_vocab_cross_entropy",
 ]

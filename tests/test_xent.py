@@ -103,3 +103,69 @@ def test_fused_ce_never_builds_full_logits():
     offenders = [s for s in shapes
                  if len(s) >= 2 and s[-2] >= t and s[-1] >= v]
     assert not offenders, offenders
+
+
+class TestVocabParallel:
+    """tp_vocab_cross_entropy inside shard_map vs the dense NLL."""
+
+    def _mesh(self, n):
+        from horovod_tpu import parallel as par
+        return par.make_mesh({"tp": n}, devices=jax.devices()[:n])
+
+    @pytest.mark.parametrize("t,chunk", [(32, 8), (28, 8)])
+    def test_loss_and_grads_match_dense(self, t, chunk):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(4)
+        key = jax.random.PRNGKey(7)
+        e, v = 16, 64  # v_local = 16 per rank
+        h = jax.random.normal(key, (t, e), jnp.float32)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (e, v),
+                              jnp.float32)
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (t,),
+                                     0, v)
+
+        from horovod_tpu.ops.xent import tp_vocab_cross_entropy
+
+        def loss_vp(h, w):
+            fn = jax.shard_map(
+                lambda hh, ww: tp_vocab_cross_entropy(
+                    hh, ww, targets, "tp", chunk),
+                mesh=mesh, in_specs=(P(), P(None, "tp")), out_specs=P())
+            return fn(h, w)
+
+        ld, (gdh, gdw) = jax.value_and_grad(_dense_nll, argnums=(0, 1))(
+            h, w, targets)
+        lv, (vdh, vdw) = jax.value_and_grad(loss_vp, argnums=(0, 1))(h, w)
+
+        np.testing.assert_allclose(float(lv), float(ld), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(vdh), np.asarray(gdh),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vdw), np.asarray(gdw),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_loss_identical_on_every_rank(self):
+        """The op's contract: the returned scalar is axis-invariant
+        (same value on every tp rank) — out_specs=P() above would fail
+        loudly on mismatch, but pin it explicitly via a per-rank
+        output."""
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._mesh(4)
+        key = jax.random.PRNGKey(9)
+        t, e, v = 16, 8, 32
+        h = jax.random.normal(key, (t, e), jnp.float32)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (e, v),
+                              jnp.float32)
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (t,),
+                                     0, v)
+
+        from horovod_tpu.ops.xent import tp_vocab_cross_entropy
+
+        fn = jax.shard_map(
+            lambda hh, ww: tp_vocab_cross_entropy(
+                hh, ww, targets, "tp", 8)[None],
+            mesh=mesh, in_specs=(P(), P(None, "tp")),
+            out_specs=P("tp"))
+        per_rank = np.asarray(fn(h, w))
+        np.testing.assert_allclose(per_rank, per_rank[0], rtol=0)
