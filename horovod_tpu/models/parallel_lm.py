@@ -82,13 +82,18 @@ def init_lm_params(rng, vocab: int, max_len: int, layers: int, heads: int,
     return params
 
 
-def lm_param_specs(layers: int, tp_axis: Optional[str]):
+def lm_param_specs(layers: int, tp_axis: Optional[str],
+                   vocab_parallel: bool = False):
     """PartitionSpec pytree matching :func:`init_lm_params`' structure.
 
     Pass as the params entry of ``shard_map``'s ``in_specs`` (and
     ``out_specs`` for the updated state): the mesh then slices the DENSE
     arrays — heads/features over ``tp_axis``, everything else
-    replicated. ``tp_axis=None`` replicates everything."""
+    replicated. ``tp_axis=None`` replicates everything.
+    ``vocab_parallel`` additionally shards the vocab projection
+    [E, V] over ``tp_axis`` — pair with
+    :func:`next_token_nll_fused`'s vocab-parallel loss (the plain
+    :func:`lm_apply` logits path assumes a replicated head)."""
     from jax.sharding import PartitionSpec as P
 
     t = tp_axis
@@ -108,7 +113,7 @@ def lm_param_specs(layers: int, tp_axis: Optional[str]):
         "pos": P(),
         "layers": [dict(layer_spec) for _ in range(layers)],
         "ln_f": {"g": P(), "b": P()},
-        "head": P(),
+        "head": P(None, t) if vocab_parallel else P(),
     }
 
 
@@ -148,19 +153,27 @@ def _ffn_residual(layer, x, tp):
     return x + h @ layer["wdn"] + layer["bdn"]
 
 
+def _final_hidden(params, x):
+    return _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+
+
 def _logits(params, x):
-    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return x @ params["head"]
+    return _final_hidden(params, x) @ params["head"]
 
 
 def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
-             tp: Optional[str] = None):
+             tp: Optional[str] = None, return_hidden: bool = False):
     """Token ids [B, L_local] -> logits [B, L_local, vocab].
 
     Inside ``shard_map``: ``sp`` names the sequence axis (tokens arrive
     sequence-sharded; ring attention, global positions), ``tp`` the
     tensor axis (params arrive head/feature-sharded via
-    :func:`lm_param_specs`). Both None = dense single-device math."""
+    :func:`lm_param_specs`). Both None = dense single-device math.
+
+    ``return_hidden`` stops after the final LayerNorm and returns
+    [B, L_local, E] — for the fused losses (:func:`next_token_nll_fused`)
+    that consume ``params["head"]`` directly and never materialize the
+    [B, L, vocab] logits."""
     B, L = tokens.shape
     pos_offset = lax.axis_index(sp) * L if sp else 0
     x = params["embed"][tokens]
@@ -177,6 +190,8 @@ def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
         x = _attn_out_residual(layer, attn, x, tp)
         x = _ffn_residual(layer, x, tp)
 
+    if return_hidden:
+        return _final_hidden(params, x)
     return _logits(params, x)
 
 
@@ -476,14 +491,12 @@ def pp_reduce_rest_grads(g_rest: Dict, axis: str = "pp"):
     return out
 
 
-def next_token_nll(logits, tokens, sp: Optional[str] = None):
-    """Mean next-token negative log-likelihood, sequence-shard aware.
+def _shifted_targets(tokens, sp: Optional[str]):
+    """Next-token targets + validity weights, sequence-shard aware.
 
     With ``sp``, each shard's last position needs the NEXT shard's first
-    token as its target — one ppermute — and the final global position is
-    masked out; the mean is taken over the sp axis so every chip returns
-    the same global value. Matches the dense shift exactly."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token as its target — one ppermute — and the final global position
+    is masked out. Returns (targets [B, L], valid [B, L] fp32)."""
     B, L = tokens.shape
     if sp:
         n = lax.axis_size(sp)
@@ -495,8 +508,17 @@ def next_token_nll(logits, tokens, sp: Optional[str] = None):
     else:
         tgt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
         valid = (jnp.arange(L) < L - 1).astype(jnp.float32)[None, :]
+    return tgt, jnp.broadcast_to(valid, tokens.shape)
+
+
+def next_token_nll(logits, tokens, sp: Optional[str] = None):
+    """Mean next-token negative log-likelihood, sequence-shard aware
+    (:func:`_shifted_targets`); the mean is taken over the sp axis so
+    every chip returns the same global value. Matches the dense shift
+    exactly."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt, valid = _shifted_targets(tokens, sp)
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    valid = jnp.broadcast_to(valid, nll.shape)
     local_sum = jnp.sum(nll * valid)
     local_cnt = jnp.sum(valid)
     if sp:
@@ -504,6 +526,46 @@ def next_token_nll(logits, tokens, sp: Optional[str] = None):
         # scaled by the axis size (see parallel/tp.py tp_region_output).
         return sum_across(local_sum, sp) / lax.psum(local_cnt, sp)
     return local_sum / local_cnt
+
+
+def next_token_nll_fused(params: Dict, hidden, tokens,
+                         sp: Optional[str] = None,
+                         tp: Optional[str] = None,
+                         vocab_parallel: bool = False,
+                         t_chunk: int = 512):
+    """:func:`next_token_nll` without the [B, L, vocab] logits tensor.
+
+    ``hidden`` is :func:`lm_apply`'s ``return_hidden=True`` output; the
+    vocab projection happens inside the chunked fused loss
+    (ops/xent.py), so the step's largest HBM tensor never materializes.
+    With ``vocab_parallel`` the head arrives [E, V/tp]-sharded
+    (:func:`lm_param_specs` ``vocab_parallel=True``) and the Megatron-
+    style variant assembles the normalizer over ``tp``. Exactly equal
+    to logits-then-:func:`next_token_nll` (tests/test_parallel_lm.py).
+    """
+    from horovod_tpu.ops.xent import (fused_cross_entropy,
+                                      tp_vocab_cross_entropy)
+
+    B, L = tokens.shape
+    tgt, valid = _shifted_targets(tokens, sp)
+    e = hidden.shape[-1]
+    h2 = hidden.reshape(B * L, e)
+    t2 = tgt.reshape(B * L)
+    w2 = valid.reshape(B * L)
+    cnt = jnp.sum(w2)
+    denom = lax.psum(cnt, sp) if sp else cnt
+    if vocab_parallel:
+        if not tp:
+            raise ValueError("vocab_parallel needs a tp axis")
+        local = tp_vocab_cross_entropy(h2, params["head"], t2, tp,
+                                       t_chunk, weights=w2, denom=denom)
+    else:
+        local = fused_cross_entropy(h2, params["head"], t2, t_chunk,
+                                    weights=w2, denom=denom)
+    # Each sp shard contributes its own tokens' share of the globally-
+    # normalized sum; sum_across (not bare psum) keeps the backward
+    # unscaled, as in next_token_nll.
+    return sum_across(local, sp) if sp else local
 
 
 def reduce_grads(grads, dp: Optional[str] = None, sp: Optional[str] = None):

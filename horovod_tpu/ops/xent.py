@@ -7,7 +7,7 @@ log-softmax, and touches it again on the backward — on a chip whose
 step is HBM-bound, the loss head alone is ~a third of the traffic
 (PERF.md). This op computes
 
-    mean over tokens of  -log softmax(h @ w)[target]
+    sum over tokens of  weight_i * -log softmax(h @ w)[target_i] / denom
 
 by ``lax.scan`` over TOKEN chunks: each step computes one
 [t_chunk, V] logits block, reduces it to per-token (logsumexp,
@@ -17,6 +17,13 @@ round-trips HBM. The backward recomputes each chunk's logits
 (T·E·V MACs again — small next to the GBs of traffic saved on a
 memory-bound step) and accumulates ``dw`` in an fp32 scan carry while
 streaming ``dh`` out per chunk.
+
+``weights``/``denom`` exist for sharded callers: a sequence-parallel
+loss passes per-token validity weights and the GLOBAL (psum'd) token
+count so that summing the per-shard results reproduces the dense mean
+exactly (models/parallel_lm.py:next_token_nll_fused).
+``tp_vocab_cross_entropy`` is the Megatron-style variant for a head
+sharded [E, V/tp] over a mesh axis.
 
 The reference framework has no fused loss (its LM story is absent
 altogether — SURVEY §5 long-context); this is TPU-first perf work in
@@ -31,23 +38,33 @@ scale.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def _pad_tokens(h, targets, t_chunk):
+def _pad_all(h, targets, weights, t_chunk):
     """Pad the token axis to a multiple of t_chunk; padded rows carry
     weight 0 and target 0 (any valid index)."""
     t = h.shape[0]
     pad = (-t) % t_chunk
-    weights = jnp.ones((t,), jnp.float32)
     if pad:
         h = jnp.pad(h, ((0, pad), (0, 0)))
         targets = jnp.pad(targets, (0, pad))
         weights = jnp.pad(weights, (0, pad))
-    return h, targets, weights, t
+    return h, targets, weights
+
+
+def _fill_defaults(h, weights, denom):
+    if weights is None:
+        weights = jnp.ones((h.shape[0],), jnp.float32)
+    else:
+        weights = weights.astype(jnp.float32)
+    if denom is None:
+        denom = jnp.sum(weights)
+    return weights, jnp.asarray(denom, jnp.float32)
 
 
 def _chunk_stats(hc, w, tc):
@@ -58,42 +75,56 @@ def _chunk_stats(hc, w, tc):
     return lse, tgt
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_cross_entropy(h, w, targets, t_chunk: int = 512):
-    """Mean negative log-likelihood without materializing [T, V] logits.
+def fused_cross_entropy(h, w, targets, t_chunk: int = 512,
+                        weights=None, denom=None):
+    """Weighted NLL without materializing [T, V] logits.
 
-    h [T, E] (any float dtype; the matmul accumulates fp32),
-    w [E, V], targets [T] int32 -> scalar fp32 mean NLL over T tokens.
+    h [T, E] (any float dtype; the matmul accumulates fp32), w [E, V],
+    targets [T] int32 -> scalar fp32. Defaults (weights=1, denom=T)
+    give the plain mean NLL; sharded callers pass validity weights and
+    a globally-reduced denom (module docstring).
     """
-    loss, _ = _fce_fwd(h, w, targets, t_chunk)
+    weights, denom = _fill_defaults(h, weights, denom)
+    return _fce(h, w, targets, weights, denom, t_chunk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fce(h, w, targets, weights, denom, t_chunk):
+    loss, _ = _fce_fwd(h, w, targets, weights, denom, t_chunk)
     return loss
 
 
-def _fce_fwd(h, w, targets, t_chunk):
-    hp, tp, weights, t = _pad_tokens(h, targets, t_chunk)
+def _chunked(h, targets, weights, t_chunk):
+    hp, tp_, wp = _pad_all(h, targets, weights, t_chunk)
     n = hp.shape[0] // t_chunk
-    hcs = hp.reshape(n, t_chunk, h.shape[1])
-    tcs = tp.reshape(n, t_chunk)
-    wcs = weights.reshape(n, t_chunk)
+    return (hp.reshape(n, t_chunk, h.shape[1]), tp_.reshape(n, t_chunk),
+            wp.reshape(n, t_chunk))
+
+
+def _fce_fwd(h, w, targets, weights, denom, t_chunk):
+    from horovod_tpu.parallel._vma import match_vma
+
+    hcs, tcs, wcs = _chunked(h, targets, weights, t_chunk)
 
     def step(acc, xs):
         hc, tc, wc = xs
         lse, tgt = _chunk_stats(hc, w, tc)
         return acc + jnp.sum((lse - tgt) * wc), None
 
-    total, _ = lax.scan(step, jnp.float32(0.0), (hcs, tcs, wcs))
-    return total / t, (h, w, targets)
+    # Scan carries must be vma-typed like the body's output (e.g. a
+    # sequence-parallel caller passes sp-varying h/targets/weights).
+    acc0 = match_vma(jnp.float32(0.0), h, w, targets, weights)
+    total, _ = lax.scan(step, acc0, (hcs, tcs, wcs))
+    return total / denom, (h, w, targets, weights, denom)
 
 
 def _fce_bwd(t_chunk, res, g):
-    h, w, targets = res
-    hp, tp, weights, t = _pad_tokens(h, targets, t_chunk)
-    n = hp.shape[0] // t_chunk
+    from horovod_tpu.parallel._vma import match_vma
+
+    h, w, targets, weights, denom = res
+    hcs, tcs, wcs = _chunked(h, targets, weights, t_chunk)
     e = h.shape[1]
-    hcs = hp.reshape(n, t_chunk, e)
-    tcs = tp.reshape(n, t_chunk)
-    wcs = weights.reshape(n, t_chunk)
-    scale = g / t  # d(mean)/d(per-token nll), folded in fp32
+    scale = g / denom
 
     def step(dw_acc, xs):
         hc, tc, wc = xs
@@ -107,13 +138,17 @@ def _fce_bwd(t_chunk, res, g):
                                   preferred_element_type=jnp.float32)
         return dw_acc, dh_c
 
-    dw, dhs = lax.scan(step, jnp.zeros(w.shape, jnp.float32),
-                       (hcs, tcs, wcs))
-    dh = dhs.reshape(n * t_chunk, e)[:h.shape[0]]
-    return dh.astype(h.dtype), dw.astype(w.dtype), None
+    dw0 = match_vma(jnp.zeros(w.shape, jnp.float32),
+                    h, w, targets, weights, denom, g)
+    dw, dhs = lax.scan(step, dw0, (hcs, tcs, wcs))
+    dh = dhs.reshape(-1, e)[:h.shape[0]]
+    # weights/denom carry data-independent bookkeeping (validity masks,
+    # token counts): their true gradients are not needed by any caller.
+    return (dh.astype(h.dtype), dw.astype(w.dtype), None,
+            jnp.zeros_like(weights), jnp.zeros_like(denom))
 
 
-fused_cross_entropy.defvjp(_fce_fwd, _fce_bwd)
+_fce.defvjp(_fce_fwd, _fce_bwd)
 
 
 # --------------------------------------------------------------------------
@@ -136,9 +171,8 @@ def _vp_chunk_stats(hc, w_local, tc, axis, v_local):
     return lse, tgt
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def tp_vocab_cross_entropy(h, w_local, targets, axis: str,
-                           t_chunk: int = 512):
+                           t_chunk: int = 512, weights=None, denom=None):
     """Megatron-style vocab-parallel CE, chunked — for use INSIDE
     ``shard_map`` where the projection weight is sharded [E, V/tp] over
     mesh axis ``axis`` and ``h``/``targets`` are replicated along it.
@@ -146,43 +180,62 @@ def tp_vocab_cross_entropy(h, w_local, targets, axis: str,
     Each rank computes its local [t_chunk, V/tp] logits block; the
     softmax normalizer is assembled with a pmax + psum per chunk (two
     scalars-per-token on the ICI instead of a V-wide all-gather), the
-    target logit with a masked psum. Returns the GLOBAL mean NLL —
+    target logit with a masked psum. Returns the GLOBAL weighted NLL —
     identical on every ``axis`` rank, exactly equal to the dense
     computation (pinned in tests/test_xent.py). The custom VJP
     recomputes blockwise: dw stays rank-local (exactly the dense dw's
     vocab slice), dh is psum-assembled across the shards.
     """
-    loss, _ = _vp_fwd(h, w_local, targets, axis, t_chunk)
+    weights, denom = _fill_defaults(h, weights, denom)
+    return _vp(h, w_local, targets, weights, denom, axis, t_chunk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _vp(h, w_local, targets, weights, denom, axis, t_chunk):
+    loss, _ = _vp_fwd(h, w_local, targets, weights, denom, axis, t_chunk)
     return loss
 
 
-def _vp_fwd(h, w_local, targets, axis, t_chunk):
-    hp, tp_, weights, t = _pad_tokens(h, targets, t_chunk)
-    n = hp.shape[0] // t_chunk
+def _vp_body_vma(axis, *with_axis_removed, extra=()):
+    """vma set of a scan-body output whose ``axis``-variance was
+    collapsed by the in-body psum/pmax, unioned with operands that
+    touch the result after the collectives."""
+    from horovod_tpu.parallel._vma import vma_of
+
+    return ((vma_of(*with_axis_removed) - {axis}) | vma_of(*extra))
+
+
+def _typed_zero(shape_like, vma):
+    z = (jnp.float32(0.0) if shape_like is None
+         else jnp.zeros(shape_like.shape, jnp.float32))
+    if vma:
+        z = lax.pcast(z, tuple(sorted(vma)), to="varying")
+    return z
+
+
+def _vp_fwd(h, w_local, targets, weights, denom, axis, t_chunk):
+    hcs, tcs, wcs = _chunked(h, targets, weights, t_chunk)
     v_local = w_local.shape[1]
-    hcs = hp.reshape(n, t_chunk, h.shape[1])
-    tcs = tp_.reshape(n, t_chunk)
-    wcs = weights.reshape(n, t_chunk)
 
     def step(acc, xs):
         hc, tc, wc = xs
         lse, tgt = _vp_chunk_stats(hc, w_local, tc, axis, v_local)
         return acc + jnp.sum((lse - tgt) * wc), None
 
-    total, _ = lax.scan(step, jnp.float32(0.0), (hcs, tcs, wcs))
-    return total / t, (h, w_local, targets)
+    # (lse, tgt) come out of psum/pmax over ``axis`` — axis-invariant —
+    # but keep any OTHER variance (e.g. sp) the operands carry.
+    acc0 = _typed_zero(None, _vp_body_vma(axis, h, w_local,
+                                          extra=(targets, weights)))
+    total, _ = lax.scan(step, acc0, (hcs, tcs, wcs))
+    return total / denom, (h, w_local, targets, weights, denom)
 
 
 def _vp_bwd(axis, t_chunk, res, g):
-    h, w_local, targets = res
-    hp, tp_, weights, t = _pad_tokens(h, targets, t_chunk)
-    n = hp.shape[0] // t_chunk
+    h, w_local, targets, weights, denom = res
+    hcs, tcs, wcs = _chunked(h, targets, weights, t_chunk)
     e = h.shape[1]
     v_local = w_local.shape[1]
-    hcs = hp.reshape(n, t_chunk, e)
-    tcs = tp_.reshape(n, t_chunk)
-    wcs = weights.reshape(n, t_chunk)
-    scale = g / t
+    scale = g / denom
 
     def step(dw_acc, xs):
         hc, tc, wc = xs
@@ -204,13 +257,16 @@ def _vp_bwd(axis, t_chunk, res, g):
                                   preferred_element_type=jnp.float32)
         return dw_acc, dh_c
 
-    # The accumulator is tp-varying (each rank owns its vocab slice of
-    # dw) — the initial zeros must carry the same vma type.
-    dw0 = lax.pcast(jnp.zeros(w_local.shape, jnp.float32), (axis,),
-                    to="varying")
+    # The accumulator is axis-varying (each rank owns its vocab slice
+    # of dw) on top of whatever variance (e.g. sp) the operands carry.
+    from horovod_tpu.parallel._vma import vma_of
+
+    dw0 = _typed_zero(w_local, vma_of(h, w_local, targets, weights,
+                                      denom, g) | {axis})
     dw, dhs = lax.scan(step, dw0, (hcs, tcs, wcs))
-    dh = dhs.reshape(n * t_chunk, e)[:h.shape[0]]
-    return dh.astype(h.dtype), dw.astype(w_local.dtype), None
+    dh = dhs.reshape(-1, e)[:h.shape[0]]
+    return (dh.astype(h.dtype), dw.astype(w_local.dtype), None,
+            jnp.zeros_like(weights), jnp.zeros_like(denom))
 
 
-tp_vocab_cross_entropy.defvjp(_vp_fwd, _vp_bwd)
+_vp.defvjp(_vp_fwd, _vp_bwd)
