@@ -38,22 +38,23 @@ LANES = [
                                  "--fused-ce"]),
     ("transformer_lm_flash", ["bench.py", "--model", "transformer_lm",
                               "--flash-attention"]),
-    ("resnet101", ["bench.py", "--model", "resnet101"]),
-    # "slow" lanes: first compile over a congested tunnel exceeds the
-    # split-attempt budget (2x560s both timed out on 2026-07-31) — give
-    # them ONE attempt with the whole outer window instead.
-    ("vgg16", ["bench.py", "--model", "vgg16"], "slow"),
-    ("inception_v3", ["bench.py", "--model", "inception_v3"], "slow"),
-    ("inception_v3_fused_bn", ["bench.py", "--model", "inception_v3",
-                               "--fused-bn"], "slow"),
     ("flash_check", ["tools/tpu_flash_check.py"]),
-    ("resnet50_bs128", ["bench.py", "--batch-size", "128"]),
-    ("resnet50_bs256", ["bench.py", "--batch-size", "256"]),
     # ViT: the compute-bound (MXU-friendly) image lane — unlike the
     # memory-bound ResNet family it should approach the chip's matmul
     # rate, quantifying how much of the ResNet gap is the model, not
     # the framework (PERF.md "memory-bound by design").
     ("vit_b16", ["bench.py", "--model", "vit_b16"]),
+    ("resnet101", ["bench.py", "--model", "resnet101"]),
+    ("resnet50_bs128", ["bench.py", "--batch-size", "128"]),
+    ("resnet50_bs256", ["bench.py", "--batch-size", "256"]),
+    # "slow" lanes LAST: first compile over a congested tunnel exceeds
+    # the split-attempt budget (2x560s both timed out on 2026-07-31) —
+    # they get ONE attempt with the whole outer window, and a healthy
+    # window should spend its first minutes on the fast lanes above.
+    ("vgg16", ["bench.py", "--model", "vgg16"], "slow"),
+    ("inception_v3", ["bench.py", "--model", "inception_v3"], "slow"),
+    ("inception_v3_fused_bn", ["bench.py", "--model", "inception_v3",
+                               "--fused-bn"], "slow"),
 ]
 
 
