@@ -48,6 +48,11 @@ def main():
                         help="global batch (shards over dp)")
     parser.add_argument("--steps", type=int, default=300)
     parser.add_argument("--lr", type=float, default=0.3)
+    parser.add_argument("--fused-ce", action="store_true",
+                        help="train through the chunked vocab-parallel "
+                             "loss (ops/xent.py): the head shards "
+                             "[E, V/tp] and the [B, L, vocab] logits "
+                             "tensor never materializes")
     parser.add_argument("--smoke", action="store_true")
     args = parser.parse_args()
     if args.smoke:
@@ -66,10 +71,14 @@ def main():
     if args.heads % max(tp, 1) or args.seq_len % max(sp, 1):
         parser.error("heads must divide by tp and seq-len by sp")
 
+    vp = args.fused_ce and tp > 1
+    if vp and args.vocab % tp:
+        parser.error("--fused-ce vocab-parallel head needs vocab % tp == 0")
     rng = jax.random.PRNGKey(0)
     params = plm.init_lm_params(rng, args.vocab, args.seq_len, args.layers,
                                 args.heads, args.head_dim, args.ffn)
-    specs = plm.lm_param_specs(args.layers, "tp" if tp > 1 else None)
+    specs = plm.lm_param_specs(args.layers, "tp" if tp > 1 else None,
+                               vocab_parallel=vp)
 
     # Learnable synthetic corpus: a fixed random bigram successor table,
     # so next-token NLL can fall far below the uniform-entropy floor.
@@ -82,20 +91,33 @@ def main():
 
     sp_ax = "sp" if sp > 1 else None
 
+    tp_ax = "tp" if tp > 1 else None
+
     def step(p, t):
         def loss_fn(p):
+            if args.fused_ce:
+                h = plm.lm_apply(p, t, sp=sp_ax, tp=tp_ax,
+                                 return_hidden=True)
+                return plm.next_token_nll_fused(
+                    p, h, t, sp=sp_ax, tp=tp_ax, vocab_parallel=vp,
+                    t_chunk=64)
             return plm.next_token_nll(
-                plm.lm_apply(p, t, sp=sp_ax, tp="tp" if tp > 1 else None),
-                t, sp=sp_ax)
+                plm.lm_apply(p, t, sp=sp_ax, tp=tp_ax), t, sp=sp_ax)
 
         loss, g = jax.value_and_grad(loss_fn)(p)
         g = plm.reduce_grads(g, dp="dp" if dp > 1 else None, sp=sp_ax)
         new_p = jax.tree_util.tree_map(lambda a, b: a - args.lr * b, p, g)
         return new_p, jax.lax.pmean(loss, "dp")
 
+    # check_vma opt-out class 4 (docs/parallelism.md): the fused-loss
+    # custom VJP returns per-rank partial dw (reduced later by
+    # reduce_grads), which the strict checker's cotangent-type rule
+    # rejects for the tp-sharded head; values are pinned exact vs the
+    # dense step in tests/test_parallel_lm.py.
     fn = jax.jit(jax.shard_map(
         step, mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=(specs, P())),
+        out_specs=(specs, P()),
+        check_vma=not args.fused_ce),
         donate_argnums=(0,))
 
     first = last = None

@@ -482,9 +482,14 @@ def test_fused_loss_train_step_matches_dense(hvd, setup):
         new_p = jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g)
         return new_p, jax.lax.pmean(loss, "dp")
 
+    # check_vma opt-out class 4 (docs/parallelism.md): the fused-loss
+    # custom VJP returns per-rank partial dw for the tp-sharded head
+    # (reduced later by reduce_grads), which the strict checker's
+    # cotangent-type-equality rule rejects; this very test is the
+    # exactness pin that justifies the opt-out.
     fn = jax.jit(jax.shard_map(
         sharded_step, mesh=mesh, in_specs=(specs, P("dp", "sp")),
-        out_specs=(specs, P())))
+        out_specs=(specs, P()), check_vma=False))
     sharded_params, sharded_loss = fn(params, tokens)
 
     np.testing.assert_allclose(float(sharded_loss), float(dense_loss),
