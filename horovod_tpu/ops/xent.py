@@ -83,8 +83,17 @@ def fused_cross_entropy(h, w, targets, t_chunk: int = 512,
     targets [T] int32 -> scalar fp32. Defaults (weights=1, denom=T)
     give the plain mean NLL; sharded callers pass validity weights and
     a globally-reduced denom (module docstring).
+
+    ``weights`` and ``denom`` are NON-DIFFERENTIABLE bookkeeping
+    (validity masks, token counts): they are passed through
+    ``stop_gradient`` at entry, so differentiating w.r.t. a learnable
+    per-token weighting yields zeros by contract, not by accident. Use
+    an explicit elementwise product outside this op if you need
+    gradients through a weighting.
     """
     weights, denom = _fill_defaults(h, weights, denom)
+    weights = lax.stop_gradient(weights)
+    denom = lax.stop_gradient(denom)
     return _fce(h, w, targets, weights, denom, t_chunk)
 
 
@@ -185,8 +194,14 @@ def tp_vocab_cross_entropy(h, w_local, targets, axis: str,
     computation (pinned in tests/test_xent.py). The custom VJP
     recomputes blockwise: dw stays rank-local (exactly the dense dw's
     vocab slice), dh is psum-assembled across the shards.
+
+    As with :func:`fused_cross_entropy`, ``weights``/``denom`` are
+    non-differentiable bookkeeping and are ``stop_gradient``-ed at
+    entry — a learnable weighting must be applied outside this op.
     """
     weights, denom = _fill_defaults(h, weights, denom)
+    weights = lax.stop_gradient(weights)
+    denom = lax.stop_gradient(denom)
     return _vp(h, w_local, targets, weights, denom, axis, t_chunk)
 
 

@@ -13,6 +13,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -43,16 +45,20 @@ def test_default_lane_contract():
     assert out["probe_tflops"] > 0
 
 
-def test_lm_lane_contract():
-    """Long-context lane: tokens/sec with vs_baseline null. Runs with
-    the round-3 perf flags (--fused-ce --scan-layers --remat) so the
-    whole optimized path is driven end-to-end; the plain dense path is
-    pinned by test_models/test_xent equivalences."""
+@pytest.mark.parametrize("flags", [
+    pytest.param((), id="dense-default"),
+    pytest.param(("--fused-ce", "--scan-layers", "--remat"), id="r3-flags"),
+])
+def test_lm_lane_contract(flags):
+    """Long-context lane: tokens/sec with vs_baseline null. Both the
+    dense default path (the lane PERF_RUNS.tsv headline numbers come
+    from) and the round-3 perf flags (--fused-ce --scan-layers --remat)
+    are driven end-to-end so a regression in either path's arg wiring
+    or JSON contract is caught."""
     out, proc = _run_bench(
         "--model", "transformer_lm", "--batch-size", "2",
         "--seq-len", "128", "--vocab", "512", "--lm-layers", "2",
-        "--lm-dim", "64", "--lm-heads", "4",
-        "--fused-ce", "--scan-layers", "--remat",
+        "--lm-dim", "64", "--lm-heads", "4", *flags,
         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
         "--num-iters", "2")
     assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
