@@ -75,6 +75,27 @@ int hvdtpu_init(int rank, int size, int local_rank, int local_size,
   return s.ok() ? 0 : static_cast<int>(s.type());
 }
 
+// Sub-communicator init (reference hvd.init(comm=[ranks]),
+// common/__init__.py:58-84): rank/size are WORLD values from the
+// launcher; comm lists the sub-world's members. Collective over the
+// launched world — every process must call an init_comm (a sitting-out
+// process passes its own singleton). After success rank()/size() report
+// sub-world values.
+int hvdtpu_init_comm(int world_rank, int world_size, const int* comm,
+                     int comm_n, const char* coord_host, int coord_port,
+                     int timeout_ms) {
+  std::vector<int> members(comm, comm + (comm_n > 0 ? comm_n : 0));
+  Status s = GlobalCoordinator()->Init(
+      world_rank, world_size, /*local_rank=*/0, /*local_size=*/1,
+      coord_host ? coord_host : "127.0.0.1", coord_port, timeout_ms,
+      &members);
+  if (!s.ok()) {
+    std::lock_guard<std::mutex> lock(g_err_mu);
+    g_errors[-1] = s.reason();
+  }
+  return s.ok() ? 0 : static_cast<int>(s.type());
+}
+
 void hvdtpu_shutdown() { GlobalCoordinator()->Shutdown(); }
 
 int hvdtpu_initialized() { return GlobalCoordinator()->initialized() ? 1 : 0; }

@@ -80,8 +80,42 @@ static bool EnvBool(const char* name) {
 
 Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
                          const std::string& coord_host, int coord_port,
-                         int timeout_ms) {
+                         int timeout_ms, const std::vector<int>* comm) {
   if (initialized_.load()) return Status::OK();
+
+  // Sub-communicator path: resolve this process's sub-world through the
+  // collective rendezvous, then run the normal bootstrap against the
+  // sub-world's own star/ring. A full-world comm degenerates to the
+  // plain path (no rendezvous round-trip).
+  std::string effective_host = coord_host;
+  int effective_port = coord_port;
+  int adopt_fd = -1;
+  // Only a null comm means "the whole world"; an EMPTY vector flows into
+  // the rendezvous and is rejected there — no knob parses to nothing.
+  bool full_world = comm == nullptr;
+  if (!full_world && static_cast<int>(comm->size()) == size) {
+    full_world = true;
+    for (int i = 0; i < size; ++i)
+      if ((*comm)[i] != i) {
+        full_world = false;
+        break;
+      }
+  }
+  if (!full_world) {
+    int sub_rank, sub_port, sub_lr, sub_ls;
+    std::string sub_host;
+    Status s = Transport::SubWorldRendezvous(
+        rank, size, *comm, coord_host, coord_port, timeout_ms, &sub_rank,
+        &sub_host, &sub_port, &adopt_fd, &sub_lr, &sub_ls);
+    if (!s.ok()) return s;
+    rank = sub_rank;
+    size = static_cast<int>(comm->size());
+    local_rank = sub_lr;
+    local_size = sub_ls;
+    effective_host = sub_host;
+    effective_port = sub_port;
+  }
+
   rank_ = rank;
   size_ = size;
   local_rank_ = local_rank;
@@ -102,7 +136,8 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
   // the stall path testable without 60 s waits.
   stall_warning_secs_ = EnvDouble("HOROVOD_STALL_WARNING_TIME", 60.0);
 
-  Status s = transport_.Init(rank_, size_, coord_host, coord_port, timeout_ms);
+  Status s = transport_.Init(rank_, size_, effective_host, effective_port,
+                             timeout_ms, adopt_fd);
   if (!s.ok()) return s;
 
   // Hierarchical collectives (reference HOROVOD_HIERARCHICAL_ALLREDUCE /

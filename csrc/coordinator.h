@@ -68,8 +68,16 @@ class Coordinator {
   // rank/size describe this job; local_rank/local_size the within-host
   // grouping (reference derived them by MPI shared-memory split,
   // operations.cc:1760-1797; here the launcher passes them down).
+  // ``comm`` (reference hvd.init(comm=[ranks]), common/__init__.py:58-84):
+  // non-null and a proper subset restricts this process to a
+  // sub-communicator — a collective world rendezvous (every launched
+  // process must call Init, like MPI_Comm_split) resolves the sub-world's
+  // coordinator, and rank()/size()/local_*() then report SUB-world values
+  // (rank = position in comm). local_rank/local_size arguments are
+  // ignored on that path (recomputed from the members' self-IPs).
   Status Init(int rank, int size, int local_rank, int local_size,
-              const std::string& coord_host, int coord_port, int timeout_ms);
+              const std::string& coord_host, int coord_port, int timeout_ms,
+              const std::vector<int>* comm = nullptr);
   void Shutdown();
   bool initialized() const { return initialized_.load(); }
 

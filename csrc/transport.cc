@@ -194,6 +194,35 @@ std::string LocalIpToward(const std::string& host, int port) {
   return ip;
 }
 
+// [u32 len][bytes] framing for per-rank record tables (the root
+// concatenates every rank's gathered record for one broadcast; each rank
+// parses the table back, bounds-checked). Shared by the data-ring address
+// exchange and the sub-world rendezvous.
+void AppendFrames(const std::vector<std::vector<uint8_t>>& records,
+                  std::vector<uint8_t>* table) {
+  for (const auto& a : records) {
+    uint32_t n = static_cast<uint32_t>(a.size());
+    table->insert(table->end(), reinterpret_cast<uint8_t*>(&n),
+                  reinterpret_cast<uint8_t*>(&n) + 4);
+    table->insert(table->end(), a.begin(), a.end());
+  }
+}
+
+bool ParseFrames(const std::vector<uint8_t>& table,
+                 std::vector<std::vector<uint8_t>>* records) {
+  records->clear();
+  for (size_t pos = 0; pos < table.size();) {
+    if (pos + 4 > table.size()) return false;
+    uint32_t n;
+    memcpy(&n, table.data() + pos, 4);
+    pos += 4;
+    if (pos + n > table.size()) return false;
+    records->emplace_back(table.begin() + pos, table.begin() + pos + n);
+    pos += n;
+  }
+  return true;
+}
+
 // Full duplex via poll: both fds nonblocking until each side completes.
 Status DuplexTransfer(int send_fd, int recv_fd, const void* send_data,
                       size_t send_len, void* recv_data, size_t recv_len) {
@@ -274,10 +303,14 @@ void Transport::Close() {
 }
 
 Status Transport::Init(int rank, int size, const std::string& coord_host,
-                       int coord_port, int timeout_ms) {
+                       int coord_port, int timeout_ms, int adopt_listen_fd,
+                       bool control_only) {
   rank_ = rank;
   size_ = size;
-  if (size_ <= 1) return Status::OK();
+  if (size_ <= 1) {
+    if (adopt_listen_fd >= 0) ::close(adopt_listen_fd);
+    return Status::OK();
+  }
   auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
 
   // Per-job secret: every connection (control star + data ring) runs a
@@ -295,8 +328,13 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
 
   // 1. Control star.
   if (rank_ == 0) {
-    int actual_port;
-    Status s = Listen(coord_port, size_, &listen_fd_, &actual_port);
+    Status s = Status::OK();
+    if (adopt_listen_fd >= 0) {
+      listen_fd_ = adopt_listen_fd;
+    } else {
+      int actual_port;
+      s = Listen(coord_port, size_, &listen_fd_, &actual_port);
+    }
     if (!s.ok()) return s;
     worker_fds_.assign(size_, -1);
     // Keep accepting until every worker rank has authenticated or the
@@ -341,6 +379,8 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
     if (!s.ok()) return s;
   }
 
+  if (control_only) return Status::OK();
+
   // 2. Data-ring address exchange: gather "(host:port)" strings, bcast table.
   // Backlog 4: the flat-ring prev plus (when InitHierarchy follows) the
   // local- and cross-ring prevs may all be queued before we accept.
@@ -355,27 +395,16 @@ Status Transport::Init(int rank, int size, const std::string& coord_host,
   s = GatherToRoot(mine, &all);
   if (!s.ok()) return s;
   std::vector<uint8_t> table;
-  if (rank_ == 0) {
-    for (const auto& a : all) {
-      uint32_t n = static_cast<uint32_t>(a.size());
-      table.insert(table.end(), reinterpret_cast<uint8_t*>(&n),
-                   reinterpret_cast<uint8_t*>(&n) + 4);
-      table.insert(table.end(), a.begin(), a.end());
-    }
-  }
+  if (rank_ == 0) AppendFrames(all, &table);
   s = BcastFromRoot(&table);
   if (!s.ok()) return s;
+  std::vector<std::vector<uint8_t>> frames;
+  if (!ParseFrames(table, &frames) ||
+      static_cast<int>(frames.size()) != size_)
+    return Status::Unknown("bad address table");
   std::vector<std::string> addrs;
-  for (size_t pos = 0; pos + 4 <= table.size();) {
-    uint32_t n;
-    memcpy(&n, table.data() + pos, 4);
-    pos += 4;
-    if (pos + n > table.size()) return Status::Unknown("bad address table");
-    addrs.emplace_back(reinterpret_cast<const char*>(table.data() + pos), n);
-    pos += n;
-  }
-  if (static_cast<int>(addrs.size()) != size_)
-    return Status::Unknown("address table size mismatch");
+  for (const auto& f : frames)
+    addrs.emplace_back(reinterpret_cast<const char*>(f.data()), f.size());
   addrs_ = addrs;  // kept for InitHierarchy's local/cross dials
 
   // 3. Ring connect: dial next, accept prev. Dial from a thread so the
@@ -587,6 +616,164 @@ Status Transport::InitHierarchy(int inner, int timeout_ms) {
       << "hierarchy up: local ring " << local_prev << " -> " << rank_
       << " -> " << local_next << ", cross ring " << cross_prev << " -> "
       << rank_ << " -> " << cross_next;
+  return Status::OK();
+}
+
+Status Transport::SubWorldRendezvous(
+    int world_rank, int world_size, const std::vector<int>& comm,
+    const std::string& coord_host, int coord_port, int timeout_ms,
+    int* sub_rank, std::string* sub_host, int* sub_port,
+    int* leader_listen_fd, int* sub_local_rank, int* sub_local_size) {
+  *leader_listen_fd = -1;
+  *sub_rank = -1;
+  *sub_port = 0;
+  *sub_local_rank = 0;
+  *sub_local_size = 1;
+  if (comm.empty()) return Status::InvalidArgument("comm is empty");
+  std::vector<bool> seen(world_size, false);
+  for (int r : comm) {
+    if (r < 0 || r >= world_size)
+      return Status::InvalidArgument(
+          "comm rank " + std::to_string(r) + " outside the world of " +
+          std::to_string(world_size));
+    if (seen[r])
+      return Status::InvalidArgument("duplicate rank " + std::to_string(r) +
+                                     " in comm");
+    seen[r] = true;
+  }
+  for (size_t i = 0; i < comm.size(); ++i)
+    if (comm[i] == world_rank) *sub_rank = static_cast<int>(i);
+  if (*sub_rank < 0)
+    return Status::InvalidArgument(
+        "comm does not contain this process's world rank " +
+        std::to_string(world_rank) +
+        " (every launched process must call init with a comm it belongs "
+        "to; a process sitting the job out passes its own singleton)");
+
+  // Sub-leader pre-binds its control listener BEFORE the rendezvous so
+  // follower dials issued right after the table broadcast land in its
+  // backlog instead of racing a close/rebind.
+  int lfd = -1, lport = 0;
+  if (*sub_rank == 0 && comm.size() > 1) {
+    Status s = Listen(0, static_cast<int>(comm.size()) + 2, &lfd, &lport);
+    if (!s.ok()) return s;
+  }
+  auto fail = [&](const Status& s) {
+    if (lfd >= 0) ::close(lfd);
+    return s;
+  };
+  // Self-IP is the host-identity key for local grouping AND the address
+  // members dial a leader at — numeric via LocalIpToward for EVERY rank
+  // (world rank 0 included: coord_host may be a hostname, and comparing
+  // it against peers' numeric IPs would mis-group rank 0's host).
+  std::string my_ip = LocalIpToward(coord_host, coord_port);
+
+  // Record: [u32 n][u32 x n comm][u32 leader-port (0 unless leader)]
+  //         [u32 ip-len][ip bytes].
+  std::vector<uint8_t> rec;
+  auto put32 = [&rec](uint32_t v) {
+    rec.insert(rec.end(), reinterpret_cast<uint8_t*>(&v),
+               reinterpret_cast<uint8_t*>(&v) + 4);
+  };
+  put32(static_cast<uint32_t>(comm.size()));
+  for (int r : comm) put32(static_cast<uint32_t>(r));
+  put32(static_cast<uint32_t>(lport));
+  put32(static_cast<uint32_t>(my_ip.size()));
+  rec.insert(rec.end(), my_ip.begin(), my_ip.end());
+
+  // Temporary world-level star (control-only: the rendezvous needs just
+  // the gather/bcast) — closed before any sub-world wiring begins.
+  std::vector<std::vector<uint8_t>> frames;
+  {
+    Transport world;
+    Status s = world.Init(world_rank, world_size, coord_host, coord_port,
+                          timeout_ms, /*adopt_listen_fd=*/-1,
+                          /*control_only=*/true);
+    if (!s.ok()) return fail(s);
+    std::vector<std::vector<uint8_t>> all;
+    s = world.GatherToRoot(rec, &all);
+    if (!s.ok()) return fail(s);
+    std::vector<uint8_t> table;
+    if (world_rank == 0) AppendFrames(all, &table);
+    s = world.BcastFromRoot(&table);
+    if (!s.ok()) return fail(s);
+    if (!ParseFrames(table, &frames) ||
+        static_cast<int>(frames.size()) != world_size)
+      return fail(Status::Unknown("bad rendezvous table framing"));
+  }
+
+  // Decode every rank's record; validation below runs identically on all
+  // ranks (everyone holds the same table), so success/failure is global.
+  struct Rec {
+    std::vector<int> comm;
+    int port = 0;
+    std::string ip;
+  };
+  std::vector<Rec> recs;
+  for (const auto& frame : frames) {
+    size_t pos = 0;
+    auto get32 = [&](uint32_t* v) -> bool {
+      if (pos + 4 > frame.size()) return false;
+      memcpy(v, frame.data() + pos, 4);
+      pos += 4;
+      return true;
+    };
+    Rec r;
+    uint32_t n, port, iplen;
+    if (!get32(&n) || n == 0 || n > static_cast<uint32_t>(world_size))
+      return fail(Status::Unknown("bad rendezvous record (comm size)"));
+    r.comm.resize(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      uint32_t v;
+      if (!get32(&v)) return fail(Status::Unknown("bad rendezvous record"));
+      r.comm[i] = static_cast<int>(v);
+    }
+    if (!get32(&port) || !get32(&iplen) || pos + iplen != frame.size())
+      return fail(Status::Unknown("bad rendezvous record (addr)"));
+    r.port = static_cast<int>(port);
+    r.ip.assign(reinterpret_cast<const char*>(frame.data() + pos), iplen);
+    recs.push_back(std::move(r));
+  }
+
+  // Global consistency: every member of every announced comm must have
+  // announced the identical vector (which also rules out overlapping
+  // comms). Checked for ALL ranks, not just this one's comm, so an
+  // inconsistent split fails on every rank together — the collective
+  // failure semantics of MPI communicator creation.
+  for (int r = 0; r < world_size; ++r) {
+    bool self_in = false;
+    for (int m : recs[r].comm) self_in |= (m == r);
+    if (!self_in)
+      return fail(Status::InvalidArgument(
+          "world rank " + std::to_string(r) +
+          " announced a comm that does not contain itself"));
+    for (int m : recs[r].comm) {
+      if (m < 0 || m >= world_size || recs[m].comm != recs[r].comm)
+        return fail(Status::InvalidArgument(
+            "inconsistent sub-communicators: world ranks " +
+            std::to_string(r) + " and " + std::to_string(m) +
+            " called init with different comms"));
+    }
+  }
+
+  const Rec& leader = recs[comm[0]];
+  if (comm.size() > 1 && leader.port == 0)
+    return fail(Status::Unknown("sub-world leader advertised no listener"));
+  *sub_host = leader.ip;
+  *sub_port = leader.port;
+
+  // Within-host grouping among members, in sub-rank order (self-IP as
+  // the host key — the analogue of the reference's shared-memory split).
+  int lr = 0, ls = 0;
+  for (size_t i = 0; i < comm.size(); ++i) {
+    if (recs[comm[i]].ip == my_ip) {
+      if (static_cast<int>(i) == *sub_rank) lr = ls;
+      ++ls;
+    }
+  }
+  *sub_local_rank = lr;
+  *sub_local_size = ls;
+  if (*sub_rank == 0) *leader_listen_fd = lfd;
   return Status::OK();
 }
 

@@ -40,10 +40,39 @@ class Transport {
   Transport& operator=(const Transport&) = delete;
 
   // Establish control star + data ring. size==1 is a no-op (pure local).
-  // timeout_ms bounds every blocking bootstrap step.
+  // timeout_ms bounds every blocking bootstrap step. adopt_listen_fd >= 0
+  // (rank 0 only) uses that already-bound, already-listening socket as the
+  // control listener instead of binding coord_port — how a sub-world
+  // coordinator keeps the listener it advertised during
+  // SubWorldRendezvous, so follower dials queued in its backlog are never
+  // lost to a close/rebind race. control_only skips the data-ring wiring
+  // (steps 2-3) for callers that need only GatherToRoot/BcastFromRoot —
+  // the rendezvous's temporary world star.
   Status Init(int rank, int size, const std::string& coord_host,
-              int coord_port, int timeout_ms = 60000);
+              int coord_port, int timeout_ms = 60000,
+              int adopt_listen_fd = -1, bool control_only = false);
   void Close();
+
+  // Collective world-level rendezvous for sub-communicator formation —
+  // the rank-address registry MPI groups provided for free (reference
+  // horovod/common/__init__.py:58-84 accepted an mpi4py sub-communicator,
+  // whose creation is itself collective over MPI_COMM_WORLD). EVERY
+  // launched process must call this, like MPI_Comm_split: it bootstraps a
+  // TEMPORARY world-level star on the launcher's coordinator address,
+  // gathers each rank's comm vector + (on sub-leaders) a pre-bound
+  // listener address, validates cross-rank consistency, broadcasts the
+  // table, and tears the world star down. ``comm`` is this rank's member
+  // list; sub-rank = position in it (MPI group semantics), sub-leader =
+  // comm[0]. Outputs: this rank's position/size, its comm's leader
+  // address for the subsequent sub-world Init, the within-host grouping
+  // among members (by self-IP, the analogue of the reference's
+  // shared-memory split, operations.cc:1760-1797), and — leader only —
+  // the listener fd Init must adopt.
+  static Status SubWorldRendezvous(
+      int world_rank, int world_size, const std::vector<int>& comm,
+      const std::string& coord_host, int coord_port, int timeout_ms,
+      int* sub_rank, std::string* sub_host, int* sub_port,
+      int* leader_listen_fd, int* sub_local_rank, int* sub_local_size);
 
   int rank() const { return rank_; }
   int size() const { return size_; }

@@ -124,6 +124,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvdtpu_init.argtypes = [c.c_int, c.c_int, c.c_int, c.c_int,
                                 c.c_char_p, c.c_int, c.c_int]
     lib.hvdtpu_init.restype = c.c_int
+    lib.hvdtpu_init_comm.argtypes = [c.c_int, c.c_int, c.POINTER(c.c_int),
+                                     c.c_int, c.c_char_p, c.c_int, c.c_int]
+    lib.hvdtpu_init_comm.restype = c.c_int
     lib.hvdtpu_shutdown.restype = None
     lib.hvdtpu_initialized.restype = c.c_int
     lib.hvdtpu_rank.restype = c.c_int
@@ -231,9 +234,26 @@ class NativeCore:
     # -- lifecycle ---------------------------------------------------------
     def init(self, rank: int = 0, size: int = 1, local_rank: int = 0,
              local_size: int = 1, coord_host: str = "127.0.0.1",
-             coord_port: int = 0, timeout_ms: int = 60000) -> None:
-        rc = self.lib.hvdtpu_init(rank, size, local_rank, local_size,
-                                  coord_host.encode(), coord_port, timeout_ms)
+             coord_port: int = 0, timeout_ms: int = 60000,
+             comm=None) -> None:
+        """``comm`` restricts this process to a sub-communicator of the
+        launched world (reference hvd.init(comm=[ranks]),
+        common/__init__.py:58-84). Collective like MPI_Comm_split: every
+        launched process must call init; after success rank()/size()
+        report sub-world values (rank = position in comm) and local_*
+        are regrouped by members' self-IPs."""
+        if comm is not None and list(comm) == list(range(size)):
+            comm = None  # full world: keep the launcher's local grouping
+        if comm is not None:
+            members = [int(r) for r in comm]
+            arr = (ctypes.c_int * len(members))(*members)
+            rc = self.lib.hvdtpu_init_comm(rank, size, arr, len(members),
+                                           coord_host.encode(), coord_port,
+                                           timeout_ms)
+        else:
+            rc = self.lib.hvdtpu_init(rank, size, local_rank, local_size,
+                                      coord_host.encode(), coord_port,
+                                      timeout_ms)
         if rc != 0:
             raise NativeError(rc, self._error(-1))
 

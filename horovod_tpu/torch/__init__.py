@@ -46,23 +46,17 @@ def init(comm=None) -> None:
     "no cluster needed" mode (SURVEY §4 mechanism 1).
 
     ``comm`` (reference ``hvd.init(comm=[ranks])``, common/__init__.py:
-    58-84: restrict the job to a subset of MPI_COMM_WORLD) is supported
-    on the jax lane, where the sub-mesh is just a device subset. On this
-    TCP lane a sub-world would need every member to learn the sub-
-    coordinator's address — information MPI groups provided for free and
-    the launcher env does not carry — so a proper subset raises rather
-    than being silently ignored; launch a smaller job (or use the jax
-    lane) instead.
+    58-84: restrict the job to a subset of MPI_COMM_WORLD) forms a
+    sub-communicator: a collective rendezvous over the launcher's
+    control star — the rank-address registry MPI groups provided for
+    free — resolves each sub-world's coordinator, and this process then
+    runs on a star/ring of just the members. Like ``MPI_Comm_split``,
+    EVERY launched process must call ``init``; a process sitting the job
+    out passes its own singleton (``comm=[hvd_world_rank]``). After
+    init, ``rank()``/``size()`` report sub-world values (rank =
+    position in ``comm``) and ``local_rank()``/``local_size()`` are
+    regrouped among members by host.
     """
-    if comm is not None:
-        world = int(os.environ.get("HOROVOD_SIZE", "1"))
-        if list(comm) != list(range(world)):
-            raise ValueError(
-                "horovod_tpu.torch.init(comm=...) with a proper subset of "
-                "ranks is not supported on the native TCP lane (no rank "
-                "address registry for a sub-coordinator); launch a "
-                "separate smaller job with hvdrun, or use "
-                "horovod_tpu.jax.init(comm=...) which builds a sub-mesh.")
     if mpi_ops._core is not None and mpi_ops._core.initialized:
         return
     # HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER are consumed inside the
@@ -80,7 +74,7 @@ def init(comm=None) -> None:
               local_size=local_size, coord_host=host or "127.0.0.1",
               coord_port=int(port),
               timeout_ms=int(os.environ.get("HOROVOD_START_TIMEOUT", "60"))
-              * 1000)
+              * 1000, comm=comm)
     mpi_ops._set_core(core)
 
 

@@ -22,6 +22,12 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
     local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))
     core = NativeCore()
     timeout_ms = int(os.environ.get("HVD_TEST_INIT_TIMEOUT_MS", "30000"))
+
+    if scenario == "subcomm":
+        return _run_subcomm(core, rank, size, port, timeout_ms)
+    if scenario == "subcomm_mismatch":
+        return _run_subcomm_mismatch(core, rank, size, port, timeout_ms)
+
     core.init(rank=rank, size=size, local_rank=local_rank,
               local_size=local_size,
               coord_host="127.0.0.1", coord_port=port,
@@ -242,6 +248,66 @@ def run(rank: int, size: int, port: int, scenario: str) -> None:
         raise SystemExit(f"unknown scenario {scenario}")
 
     core.shutdown()
+
+
+def _run_subcomm(core, rank, size, port, timeout_ms):
+    """Sub-communicator formation (reference hvd.init(comm=[ranks]),
+    common/__init__.py:58-84): even world ranks form one sub-world, odd
+    ranks another — with 3 processes that is {0,2} running a collective
+    while {1} sits out on its singleton; with 4 it is two concurrent
+    independent sub-worlds sharing one launcher rendezvous."""
+    comm = [r for r in range(size) if r % 2 == rank % 2]
+    sub_rank = comm.index(rank)
+    core.init(rank=rank, size=size, coord_host="127.0.0.1", coord_port=port,
+              timeout_ms=timeout_ms, comm=comm)
+    core.set_cycle_time_ms(1.0)
+    assert core.rank() == sub_rank and core.size() == len(comm), (
+        core.rank(), core.size(), comm)
+    # All members share 127.0.0.1, so local grouping == the sub-world.
+    assert core.local_rank() == sub_rank and core.local_size() == len(comm)
+
+    # Closed-form allreduce within the sub-world only: the sum runs over
+    # MEMBER world ranks, proving no cross-sub-world mixing.
+    a = np.arange(128, dtype=np.float32) * (rank + 1)
+    h = core.allreduce_async_("sub_ar", a)
+    core.wait(h)
+    core.release(h)
+    scale = sum(r + 1 for r in comm)
+    assert np.allclose(a, np.arange(128, dtype=np.float32) * scale), scale
+
+    # Broadcast from the sub-world's LAST member (non-zero sub-root when
+    # the sub-world has >1 member).
+    b = np.full(16, rank * 10.0, dtype=np.float64)
+    h = core.broadcast_async_("sub_bc", b, len(comm) - 1)
+    core.wait(h)
+    core.release(h)
+    assert (b == comm[-1] * 10.0).all()
+
+    # Ragged allgatherv: member at sub-rank i contributes i+1 rows.
+    g = np.full((sub_rank + 1, 2), rank, dtype=np.int64)
+    h = core.allgather_async("sub_ag", g)
+    core.wait(h)
+    out = core.take_result(h, np.int64, (2,))
+    assert out.shape[0] == sum(i + 1 for i in range(len(comm)))
+    off = 0
+    for i, member in enumerate(comm):
+        assert (out[off:off + i + 1] == member).all()
+        off += i + 1
+
+    core.shutdown()
+
+
+def _run_subcomm_mismatch(core, rank, size, port, timeout_ms):
+    """An inconsistent split (rank 0 claims {0,1}, everyone else claims
+    their singleton) must fail on EVERY rank — collective failure, the
+    MPI communicator-creation semantics."""
+    comm = [0, 1] if rank == 0 else [rank]
+    try:
+        core.init(rank=rank, size=size, coord_host="127.0.0.1",
+                  coord_port=port, timeout_ms=timeout_ms, comm=comm)
+        raise SystemExit("inconsistent comm was accepted")
+    except NativeError as e:
+        assert "inconsistent sub-communicators" in str(e), str(e)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,9 @@ import torch.nn.functional as F
 def run(scenario: str) -> None:
     import horovod_tpu.torch as hvd
 
+    if scenario == "subcomm":
+        return _run_subcomm(hvd)
+
     hvd.init()
     rank, size = hvd.rank(), hvd.size()
 
@@ -157,6 +160,47 @@ def run(scenario: str) -> None:
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 
+    hvd.shutdown()
+
+
+def _run_subcomm(hvd) -> None:
+    """hvd.init(comm=[ranks]) through the public torch API (reference
+    common/__init__.py:58-84): world ranks {0, 2} train together while
+    rank 1 sits out on its singleton."""
+    world_rank = int(os.environ["HOROVOD_RANK"])
+    world_size = int(os.environ["HOROVOD_SIZE"])
+    comm = [r for r in range(world_size) if r % 2 == world_rank % 2]
+    hvd.init(comm=comm)
+    assert hvd.rank() == comm.index(world_rank), (hvd.rank(), comm)
+    assert hvd.size() == len(comm)
+
+    # The collective sums MEMBER world-ranks only: the sit-out singleton
+    # never mixes in.
+    t = torch.ones(32) * (world_rank + 1)
+    out = hvd.allreduce(t, average=False)
+    scale = sum(r + 1 for r in comm)
+    assert torch.allclose(out, torch.full((32,), float(scale))), out[0]
+
+    # DistributedOptimizer over the sub-world: a 2-member averaged step
+    # keeps member params in lockstep (size-1 worlds skip hooks).
+    torch.manual_seed(99)
+    model = nn.Linear(5, 1)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    torch.manual_seed(500 + world_rank)
+    for _ in range(5):
+        opt.zero_grad()
+        X = torch.randn(16, 5)
+        model(X).pow(2).mean().backward()
+        opt.step()
+    flat = torch.cat([p.detach().flatten() for p in model.parameters()])
+    gathered = hvd.allgather(flat.unsqueeze(0))
+    assert gathered.shape[0] == len(comm)
+    for r in range(len(comm)):
+        assert torch.allclose(gathered[r], flat, atol=1e-6), \
+            f"sub-world member {r} diverged"
     hvd.shutdown()
 
 
