@@ -53,6 +53,8 @@ _SLOW_TESTS = {
     "test_models.py::test_graft_entry_multichip_subprocess",
     "test_multiprocess_spmd.py::test_two_process_global_mesh_end_to_end",
     "test_multiprocess_spmd.py::test_two_process_hierarchical_ladder",
+    "test_multiprocess_spmd.py::test_four_process_global_mesh_end_to_end",
+    "test_multiprocess_spmd.py::test_four_process_hierarchical_ladder",
     "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
     "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
     "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",

@@ -16,7 +16,7 @@ REPO = Path(__file__).resolve().parent.parent
 WORKER = Path(__file__).resolve().parent / "spmd_multiproc_worker.py"
 
 
-def _launch_and_check(extra_env=None):
+def _launch_and_check(extra_env=None, np_=2, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("JAX_PLATFORMS", None)
@@ -24,21 +24,22 @@ def _launch_and_check(extra_env=None):
     if extra_env:
         env.update(extra_env)
     proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--jax",
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_), "--jax",
          sys.executable, str(WORKER)],
         env=env, cwd=str(REPO), capture_output=True, text=True,
-        timeout=600)
+        timeout=timeout)
     assert proc.returncode == 0, (
         f"rc={proc.returncode}\nstdout:{proc.stdout[-3000:]}\n"
         f"stderr:{proc.stderr[-3000:]}")
     results = re.findall(r"RESULT rank=(\d) digest=(\w+) loss=([\d.]+)",
                          proc.stdout)
-    assert len(results) == 2, proc.stdout
+    assert len(results) == np_, proc.stdout
     by_rank = {int(r): (d, float(l)) for r, d, l in results}
-    assert set(by_rank) == {0, 1}
+    assert set(by_rank) == set(range(np_))
     # Same averaged gradients + same broadcast start => identical params.
-    assert by_rank[0][0] == by_rank[1][0], by_rank
-    assert by_rank[0][1] == by_rank[1][1]
+    for r in range(1, np_):
+        assert by_rank[0][0] == by_rank[r][0], by_rank
+        assert by_rank[0][1] == by_rank[r][1]
 
 
 def test_two_process_global_mesh_end_to_end():
@@ -56,3 +57,22 @@ def test_two_process_hierarchical_ladder():
     equality) must still hold."""
     _launch_and_check({"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
                        "HOROVOD_HIERARCHICAL_ALLGATHER": "1"})
+
+
+def test_four_process_global_mesh_end_to_end():
+    """np=4 (8 chips): alltoall has 4-way splits, ring attention's K/V
+    blocks traverse 4 process boundaries, ZeRO shards over 8 chips —
+    sizes where a transposed index or an off-by-one rank map that np=2
+    cannot distinguish from its inverse actually shows (reference
+    size-parametric mpirun -np N strategy, test/common.py:25-58)."""
+    _launch_and_check(np_=4, timeout=900)
+
+
+def test_four_process_hierarchical_ladder():
+    """The two-level ladder's first non-degenerate topology: 4 local
+    groups of 2, so the CROSS ring has 4 members (np=2's cross ring of 2
+    is just a pairwise exchange) — ordering bugs in the cross-reduce
+    only exist from 3 members up."""
+    _launch_and_check({"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+                       "HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
+                      np_=4, timeout=900)
