@@ -1,0 +1,30 @@
+"""Launcher-environment contract, shared by the native-core bindings.
+
+The launcher (``horovod_tpu.run``) replaces the reference's
+mpirun-provided MPI_COMM_WORLD with env vars (reference
+operations.cc:1748-1797 derived the same values from MPI); both the
+torch and tf bindings bootstrap their NativeCore from this one parser so
+the contract cannot drift between them."""
+
+from __future__ import annotations
+
+import os
+
+
+def native_init_kwargs() -> dict:
+    """Keyword arguments for :meth:`NativeCore.init` from the launcher
+    env. Single-process (no launcher) degenerates to size 1, the
+    reference's "no cluster needed" mode (SURVEY §4 mechanism 1)."""
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    controller = os.environ.get("HOROVOD_CONTROLLER", "127.0.0.1:29400")
+    host, _, port = controller.rpartition(":")
+    return dict(
+        rank=rank,
+        size=size,
+        local_rank=int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank))),
+        local_size=int(os.environ.get("HOROVOD_LOCAL_SIZE", str(size))),
+        coord_host=host or "127.0.0.1",
+        coord_port=int(port),
+        timeout_ms=int(os.environ.get("HOROVOD_START_TIMEOUT", "60")) * 1000,
+    )

@@ -1,0 +1,53 @@
+"""Gradient compression for the TF binding (parity surface of reference
+horovod/tensorflow/compression.py:24-60: a Compressor interface with
+``none`` and ``fp16`` implementations; decompress restores the original
+dtype)."""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface for compressing/decompressing a tensor around the wire
+    (reference compression.py:24-38)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Ride the ring at half precision; restore the caller's dtype after
+    (reference compression.py:46-60)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating and tensor.dtype != tf.float16:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else tf.cast(tensor, ctx)
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.{none,fp16}``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

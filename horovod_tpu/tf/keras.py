@@ -1,0 +1,58 @@
+"""tf.keras callbacks for the TF binding (parity surface of reference
+horovod/keras/callbacks.py: BroadcastGlobalVariablesCallback and
+MetricAverageCallback; the LR-schedule callbacks live on the flax lane,
+horovod_tpu/flax/callbacks.py, which is the flagship's keras analogue)."""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tf as hvd
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast model + optimizer state from ``root_rank`` so
+    randomly-initialized or checkpoint-restored workers agree before
+    averaged training proceeds. Broadcasts at train begin when the model
+    is already built; a lazily-built model (no input_shape, subclassed)
+    has NO variables yet at that point, so the broadcast defers to the
+    end of the first batch — the reference ran on_batch_end(batch 0) for
+    exactly this reason (reference keras/callbacks.py:24-45), accepting
+    one rank-local step that the full state broadcast then overwrites."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def _broadcast(self) -> None:
+        hvd.broadcast_variables(self.model.variables, self.root_rank)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None) is not None:
+            opt_vars = (opt.variables() if callable(opt.variables)
+                        else opt.variables)
+            if opt_vars:
+                hvd.broadcast_variables(opt_vars, self.root_rank)
+        self._done = True
+
+    def on_train_begin(self, logs=None):
+        if not self._done and self.model.variables:
+            self._broadcast()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if not self._done:
+            self._broadcast()
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch-end metrics over ranks so every worker logs (and
+    checkpoints/early-stops on) the global value, not its shard's
+    (reference keras/callbacks.py:48-86)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            for key, value in list(logs.items()):
+                logs[key] = float(hvd.allreduce(
+                    tf.constant(np.float64(value)), average=True,
+                    name=f"metric.{key}"))

@@ -15,11 +15,11 @@ mpirun-provided MPI_COMM_WORLD, operations.cc:1748-1797).
 from __future__ import annotations
 
 import collections
-import os
 
 import torch
 
 from horovod_tpu.common.basics import check_extension
+from horovod_tpu.common.launcher_env import native_init_kwargs
 from horovod_tpu.native import NativeCore
 from horovod_tpu.torch import mpi_ops
 from horovod_tpu.torch.compression import Compression
@@ -63,18 +63,8 @@ def init(comm=None) -> None:
     # native core (csrc/coordinator.cc): it wires local/cross sub-rings and
     # runs the two-level ladder (reference operations.cc:1284-1436,
     # :929-1032), degrading to the flat ring for untileable topologies.
-    rank = int(os.environ.get("HOROVOD_RANK", "0"))
-    size = int(os.environ.get("HOROVOD_SIZE", "1"))
-    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", str(rank)))
-    local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", str(size)))
-    controller = os.environ.get("HOROVOD_CONTROLLER", "127.0.0.1:29400")
-    host, _, port = controller.rpartition(":")
     core = NativeCore()
-    core.init(rank=rank, size=size, local_rank=local_rank,
-              local_size=local_size, coord_host=host or "127.0.0.1",
-              coord_port=int(port),
-              timeout_ms=int(os.environ.get("HOROVOD_START_TIMEOUT", "60"))
-              * 1000, comm=comm)
+    core.init(comm=comm, **native_init_kwargs())
     mpi_ops._set_core(core)
 
 

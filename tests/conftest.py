@@ -55,6 +55,9 @@ _SLOW_TESTS = {
     "test_multiprocess_spmd.py::test_two_process_hierarchical_ladder",
     "test_multiprocess_spmd.py::test_four_process_global_mesh_end_to_end",
     "test_multiprocess_spmd.py::test_four_process_hierarchical_ladder",
+    "test_tf_binding.py::TestMultiProcess::test_ops",
+    "test_tf_binding.py::TestMultiProcess::test_distributed_gradient_tape_converges",
+    "test_tf_binding.py::TestMultiProcess::test_keras_callbacks",
     "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
     "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
     "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",

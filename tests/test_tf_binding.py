@@ -1,0 +1,138 @@
+"""horovod_tpu.tf binding: size-1 identities in-process, then true
+spawned workers over the native TCP transport (the rebuild's ``mpirun
+-np N test_tensorflow.py``, SURVEY §4; reference surface
+horovod/tensorflow/__init__.py:151-326)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "tf_worker.py"
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(size: int, scenario: str, timeout=300):
+    port = _free_port()
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER": f"127.0.0.1:{port}",
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER), scenario],
+            env=env, cwd=str(REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    failures = []
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        if p.returncode != 0:
+            failures.append(
+                f"rank {rank} rc={p.returncode}\n{err.decode()[-2500:]}")
+    assert not failures, "\n".join(failures)
+
+
+@pytest.fixture(scope="module")
+def hvd_tf():
+    import horovod_tpu.tf as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+class TestSingleProcess:
+    def test_basics(self, hvd_tf):
+        assert hvd_tf.rank() == 0
+        assert hvd_tf.size() == 1
+        assert hvd_tf.mpi_threads_supported() is False
+
+    def test_allreduce_identity(self, hvd_tf):
+        import tensorflow as tf
+
+        t = tf.range(10, dtype=tf.float32)
+        np.testing.assert_allclose(
+            hvd_tf.allreduce(t, average=False).numpy(), t.numpy())
+
+    def test_allreduce_average_int_rejected(self, hvd_tf):
+        import tensorflow as tf
+
+        with pytest.raises(ValueError, match="average=True"):
+            hvd_tf.allreduce(tf.range(4), average=True)
+
+    def test_allgather_identity(self, hvd_tf):
+        import tensorflow as tf
+
+        g = tf.ones((3, 2))
+        np.testing.assert_allclose(hvd_tf.allgather(g).numpy(), 1.0)
+
+    def test_broadcast_identity_and_variables(self, hvd_tf):
+        import tensorflow as tf
+
+        t = tf.fill((4,), 3.0)
+        np.testing.assert_allclose(
+            hvd_tf.broadcast(t, root_rank=0).numpy(), 3.0)
+        v = tf.Variable([1.0, 2.0])
+        hvd_tf.broadcast_variables([v], 0)
+        np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+
+    def test_grad_allreduce(self, hvd_tf):
+        import tensorflow as tf
+
+        x = tf.Variable(tf.ones(4))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allreduce(x, average=False))
+        np.testing.assert_allclose(tape.gradient(y, x).numpy(), 1.0)
+
+    def test_distributed_gradient_tape_delegates(self, hvd_tf):
+        import tensorflow as tf
+
+        x = tf.Variable(2.0)
+        with tf.GradientTape() as tape:
+            y = x * x
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        np.testing.assert_allclose(float(dtape.gradient(y, x)), 4.0)
+
+    def test_compression_fp16_roundtrip(self, hvd_tf):
+        import tensorflow as tf
+
+        t = tf.constant([1.5, -2.25], tf.float64)
+        out = hvd_tf.allreduce(t, average=False,
+                               compression=hvd_tf.Compression.fp16)
+        assert out.dtype == tf.float64
+        np.testing.assert_allclose(out.numpy(), [1.5, -2.25])
+
+
+class TestMultiProcess:
+    def test_ops(self):
+        _spawn(2, "ops")
+
+    def test_distributed_gradient_tape_converges(self):
+        _spawn(2, "tape")
+
+    def test_keras_callbacks(self):
+        _spawn(2, "keras")

@@ -1,0 +1,157 @@
+"""Subprocess worker for horovod_tpu.tf multi-process tests (the
+rebuild's ``mpirun -np N test_tensorflow.py`` equivalent, SURVEY §4)."""
+
+import os
+import sys
+
+import numpy as np
+
+
+def run(scenario: str) -> None:
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    import horovod_tpu.tf as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    if scenario == "ops":
+        # Closed-form allreduce (reference test_tensorflow.py:107-139).
+        t = tf.range(48, dtype=tf.float32) * (rank + 1)
+        out = hvd.allreduce(t, average=False)
+        scale = sum(r + 1 for r in range(size))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.arange(48, dtype=np.float32) * scale)
+        avg = hvd.allreduce(tf.ones(5) * (rank + 1))
+        np.testing.assert_allclose(avg.numpy(), scale / size)
+
+        # fp16 compression round-trip restores the caller's dtype.
+        c = hvd.allreduce(tf.ones(7, tf.float32) * (rank + 1),
+                          average=False, compression=hvd.Compression.fp16)
+        assert c.dtype == tf.float32
+        np.testing.assert_allclose(c.numpy(), scale, atol=0.01)
+
+        # Ragged allgather (reference test_tensorflow.py:430-504 pattern).
+        g = tf.fill((rank + 1, 2), float(rank))
+        gathered = hvd.allgather(g)
+        assert gathered.shape[0] == sum(r + 1 for r in range(size))
+        off = 0
+        for r in range(size):
+            assert (gathered.numpy()[off:off + r + 1] == r).all()
+            off += r + 1
+
+        # Broadcast from a non-zero root.
+        b = hvd.broadcast(tf.fill((4,), float(rank)), root_rank=size - 1)
+        assert (b.numpy() == size - 1).all()
+
+        # Gradient registrations (reference tensorflow/mpi_ops.py:94-183):
+        # grad(allreduce) == allreduce of upstream grad;
+        # grad(broadcast) == summed on root, zero elsewhere.
+        x = tf.Variable(tf.ones(4) * (rank + 1))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd.allreduce(x, average=False))
+        np.testing.assert_allclose(tape.gradient(y, x).numpy(), float(size))
+
+        v = tf.Variable(tf.ones(3))
+        with tf.GradientTape() as tape:
+            z = tf.reduce_sum(hvd.broadcast(v, root_rank=0))
+        gv = tape.gradient(z, v).numpy()
+        np.testing.assert_allclose(gv, float(size) if rank == 0 else 0.0)
+
+    elif scenario == "tape":
+        # DistributedGradientTape end-to-end: disjoint data shards, SGD
+        # on averaged gradients converges and params stay in lockstep
+        # (reference test pattern, tensorflow/__init__.py:151-244).
+        tf.random.set_seed(1234)  # same init everywhere
+        w = tf.Variable(tf.random.normal((6, 1)))
+        b = tf.Variable(tf.zeros((1,)))
+        hvd.broadcast_variables([w, b], root_rank=0)
+
+        rng = np.random.RandomState(100 + rank)  # different data
+        w_true = np.ones((6, 1), np.float32)
+        losses = []
+        for _ in range(40):
+            X = tf.constant(rng.randn(32, 6).astype(np.float32))
+            y = X @ w_true
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean((X @ w + b - y) ** 2)
+            dtape = hvd.DistributedGradientTape(tape)
+            dw, db = dtape.gradient(loss, [w, b])
+            w.assign_sub(0.05 * dw)
+            b.assign_sub(0.05 * db)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+        flat = np.concatenate([w.numpy().ravel(), b.numpy().ravel()])
+        gathered = hvd.allgather(tf.constant(flat[None, :]))
+        for r in range(size):
+            np.testing.assert_allclose(gathered.numpy()[r], flat,
+                                       atol=1e-6,
+                                       err_msg=f"rank {rank} vs {r}")
+
+    elif scenario == "keras":
+        # tf.keras fit with the two callbacks: broadcast start, averaged
+        # epoch metrics (reference keras/callbacks.py).
+        from horovod_tpu.tf.keras import (
+            BroadcastGlobalVariablesCallback,
+            MetricAverageCallback,
+        )
+
+        tf.random.set_seed(42 + rank)  # DIFFERENT init per rank
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(4,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+
+        # Identical data on every rank: with the broadcast equalizing the
+        # differently-seeded starts, identical end params prove the
+        # callback ran (per-shard data + averaged grads is the
+        # DistributedGradientTape scenario above).
+        rng = np.random.RandomState(7)
+        X = rng.randn(64, 4).astype(np.float32)
+        y = (X @ np.ones((4, 1))).astype(np.float32)
+        # shuffle=False: fit's shuffling draws from the global seed,
+        # which deliberately differs per rank here.
+        hist = model.fit(
+            X, y, epochs=2, batch_size=16, verbose=0, shuffle=False,
+            callbacks=[BroadcastGlobalVariablesCallback(0),
+                       MetricAverageCallback()])
+        assert len(hist.history["loss"]) == 2
+
+        # Despite different seeds, the broadcast made starts identical
+        # and identical data kept them identical.
+        flat = np.concatenate(
+            [v.numpy().ravel() for v in model.trainable_variables])
+        gathered = hvd.allgather(tf.constant(flat[None, :]))
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered.numpy()[r], flat, atol=1e-6,
+                err_msg=f"rank {rank} diverged from {r}")
+
+        # LAZILY-BUILT model (no input_shape): zero variables exist at
+        # on_train_begin, so the callback must defer the broadcast to
+        # the first batch end (reference on_batch_end semantics) —
+        # a train-begin-only broadcast would silently no-op and ranks
+        # would diverge.
+        tf.random.set_seed(1000 + rank)
+        lazy = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        lazy.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+        assert not lazy.variables, "premise: unbuilt model has no vars"
+        lazy.fit(X, y, epochs=2, batch_size=16, verbose=0, shuffle=False,
+                 callbacks=[BroadcastGlobalVariablesCallback(0)])
+        flat = np.concatenate(
+            [v.numpy().ravel() for v in lazy.trainable_variables])
+        gathered = hvd.allgather(tf.constant(flat[None, :]))
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered.numpy()[r], flat, atol=1e-6,
+                err_msg=f"lazy-built: rank {rank} diverged from {r}")
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1])
