@@ -34,8 +34,10 @@ import pytest  # noqa: E402
 # Auto-marked here (one registry) instead of per-file decorators.
 _SLOW_TESTS = {
     "test_bench.py::test_default_lane_contract",
-    "test_bench.py::test_lm_lane_contract",
+    "test_bench.py::test_lm_lane_contract[dense-default]",
+    "test_bench.py::test_lm_lane_contract[r3-flags]",
     "test_bench.py::test_zero_composes_with_lm_lane",
+    "test_bench.py::test_compile_only_lane_contract",
     "test_bench.py::test_lm_flash_attention_lane",
     "test_bench.py::test_hung_backend_degrades_to_error_json",
     "test_bench.py::test_crashing_child_degrades_to_error_json",

@@ -147,6 +147,20 @@ def test_lm_flash_attention_lane():
     assert out["value"] > 0
 
 
+def test_compile_only_lane_contract():
+    """--compile-only (the sweep's *_warm lanes): one first step, metric
+    <model>_first_step_secs, vs_baseline null — the warm-cache pass big
+    models run before their measured lane."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--compile-only",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--lm-dim", "32", "--lm-heads", "2")
+    assert out["metric"] == "transformer_lm_first_step_secs"
+    assert out["unit"] == "secs"
+    assert out["value"] > 0
+    assert out["vs_baseline"] is None
+
+
 def test_zero_composes_with_lm_lane():
     out, _ = _run_bench(
         "--model", "transformer_lm", "--zero", "--batch-size", "2",

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Persistent-compilation-cache probe: does THIS backend serialize
+executables into JAX_COMPILATION_CACHE_DIR, and does a fresh process hit
+the entry?
+
+Round-3 verdict item: the sweep sets the cache dir, but whether the axon
+backend actually writes/hits it was never recorded. This tool answers it
+in ~a minute: process A compiles a distinctive program and reports the
+cache-dir entry delta; process B (fresh interpreter, same program)
+reports its compile wall time and the hit/miss log line. Run on CPU it
+validates the wiring; run on the tunnel (default platform) it answers
+the axon question. Appends one line to tools/probe_log.txt either way.
+
+Usage: python tools/cache_probe.py [--cpu] [--dir DIR]
+"""
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import os, sys, time
+if os.environ.get("HVD_CACHE_PROBE_CPU"):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+import jax
+if os.environ.get("HVD_CACHE_PROBE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["HVD_CACHE_PROBE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+import jax.numpy as jnp
+
+# A program distinctive enough not to collide with other cache users,
+# parameterized by env so both processes build the identical HLO.
+n = int(os.environ.get("HVD_CACHE_PROBE_N", "777"))
+f = jax.jit(lambda a, b: jnp.tanh(a @ b) @ a.T + jnp.float32(n))
+x = jnp.ones((n, n), jnp.float32)
+t0 = time.monotonic()
+f(x, x).block_until_ready()
+print(f"CHILD platform={jax.devices()[0].platform} "
+      f"compile+run={time.monotonic() - t0:.3f}s", flush=True)
+"""
+
+
+def run_child(env):
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                          capture_output=True, text=True, timeout=600)
+    wall = time.monotonic() - t0
+    sys.stderr.write(proc.stderr[-2000:] + "\n")
+    return proc.returncode, proc.stdout.strip(), wall, proc.stderr
+
+
+def cache_listing(d):
+    if not os.path.isdir(d):
+        return {}
+    return {f: os.path.getsize(os.path.join(d, f)) for f in os.listdir(d)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (wiring check)")
+    ap.add_argument("--dir", default=os.path.join(REPO, ".jax_cache"))
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HVD_CACHE_PROBE_DIR"] = args.dir
+    # Fresh program shape per invocation: a rerun against a dir already
+    # holding a previous run's entry would otherwise hit on run1, write
+    # nothing, and false-negative the "does this backend serialize?"
+    # question.
+    env["HVD_CACHE_PROBE_N"] = str(701 + os.getpid() % 211)
+    if args.cpu:
+        env["HVD_CACHE_PROBE_CPU"] = "1"
+        env.pop("JAX_PLATFORMS", None)
+
+    before = cache_listing(args.dir)
+    rc1, out1, wall1, _ = run_child(env)
+    after = cache_listing(args.dir)
+    new = {f: s for f, s in after.items() if f not in before}
+    rc2, out2, wall2, err2 = run_child(env)
+    hit_logged = "cache hit" in err2.lower()
+
+    verdict = (
+        f"cache_probe backend={'cpu' if args.cpu else 'default'}: "
+        f"run1 rc={rc1} {wall1:.1f}s wrote {len(new)} entries "
+        f"({sum(new.values())} B); run2 rc={rc2} {wall2:.1f}s "
+        f"hit_logged={hit_logged} | {out1} | {out2}")
+    print(verdict)
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    with open(os.path.join(REPO, "tools", "probe_log.txt"), "a") as f:
+        f.write(f"{stamp} {verdict}\n")
+    # Success = the backend wrote an entry AND the second process was
+    # fast or logged a hit.
+    return 0 if (rc1 == 0 and rc2 == 0 and new) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
