@@ -10,6 +10,7 @@ Bindings:
 
 * ``horovod_tpu.jax``   — flagship (also re-exported at the top level)
 * ``horovod_tpu.torch`` — PyTorch CPU binding over the native C++ core
+* ``horovod_tpu.tf``    — sessionless TensorFlow binding over the same core
 * ``horovod_tpu.flax``  — training-loop callbacks (keras-binding analogue)
 * ``horovod_tpu.parallel`` — mesh construction, TP/PP/SP/EP sharding,
   ring attention, sequence parallelism (beyond-reference, TPU-first)

@@ -26,12 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 # Two-lane suite strategy. The full suite (default) is the CI gate; on a
-# single-CPU box it runs ~20 min, dominated by a dozen whole-program
-# integration tests (subprocess launches, example smokes, big-model
-# compiles). `pytest -m "not slow"` is the fast iteration lane (<10 min)
-# that keeps every closed-form/exactness test and skips only the
-# whole-program wrappers whose INTERNALS those tests already cover.
-# Auto-marked here (one registry) instead of per-file decorators.
+# single-CPU box it runs ~25 min, dominated by whole-program integration
+# tests (subprocess launches, example smokes, big-model compiles).
+# `pytest -m "not slow"` is the fast iteration lane — measured
+# 2026-07-31 (round 4): 9.8 min / 255 tests on the 1-core box (17.9 min
+# before the round-4 re-budget) — that keeps per-op/per-kernel
+# closed-form and exactness tests and skips whole-program wrappers and
+# whole-MODEL composition pins whose internals those tests already
+# cover (each demotion below names its faster stand-ins; the full lane
+# still runs everything). Auto-marked here (one registry) instead of
+# per-file decorators.
 _SLOW_TESTS = {
     "test_bench.py::test_default_lane_contract",
     "test_bench.py::test_lm_lane_contract[dense-default]",
@@ -63,6 +67,31 @@ _SLOW_TESTS = {
     "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
     "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
     "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",
+    # Round-4 re-budget (fast lane had crept to 17.9 min): whole-model
+    # composition pins whose per-op internals have fast stand-ins.
+    # 57s; stand-ins: test_parallel.py TestMoE per-token closed forms
+    "test_parallel_lm.py::test_moe_lm_matches_dense_routing",
+    # 41s; stand-ins: test_train_step_matches_dense + decode_composes_with_tp
+    "test_parallel_lm.py::test_decode_matches_naive_recompute",
+    # 28s; stand-ins: the per-axis exactness pins in the same file
+    "test_parallel_lm.py::test_bf16_composed_step_and_decode",
+    # 26s; stand-ins: test_zero.py equivalence + ring-attention exactness
+    "test_parallel_lm.py::test_zero_composes_with_sequence_parallel",
+    # 30s (two full-model compiles); stand-in: LM lane contract (slow)
+    "test_models.py::test_scan_layers_matches_unrolled",
+    # 25s (two full training runs); numerics covered by optax contract
+    "test_models.py::test_bf16_momentum_tracks_fp32",
+    # 29s whole-ResNet step; stand-ins: the kernel-level exactness tests
+    # (test_fused_equals_unfused_f32, *_grads_equal_*) in the same file
+    "test_conv_bn.py::TestFusedResNet::test_resnet50_style_step_fused_vs_unfused",
+    # 42s public-API wrapper; mechanism covered by the native-lane
+    # TestSubCommunicator tests (fast)
+    "test_torch_binding.py::TestMultiProcess::test_init_comm_subworld",
+    # np=2 variants stay fast; the larger sizes are integration depth
+    "test_torch_binding.py::TestMultiProcess::test_ops[3]",
+    "test_native_core.py::TestMultiProcess::test_collectives[4]",
+    # 20s whole-ViT step; stand-in: vit forward-shape test
+    "test_examples_models.py::TestModelZoo::test_vit_spmd_train_step",
 }
 
 
