@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""tf.keras data-parallel MNIST (reference examples/keras_mnist.py /
+tensorflow_mnist.py) over the native TCP-ring core: per-rank data shard,
+``horovod_tpu.tf.keras.DistributedOptimizer`` averaging gradients in
+``apply_gradients``, broadcast + metric-average callbacks, lr scaled by
+world size.
+
+Run:  python -m horovod_tpu.run -np 2 python examples/tf_keras_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tf as hvd  # noqa: E402
+from horovod_tpu.tf.keras import (  # noqa: E402
+    BroadcastGlobalVariablesCallback,
+    DistributedOptimizer,
+    MetricAverageCallback,
+)
+
+
+def make_dataset(n, seed=0):
+    """Synthetic MNIST-shaped data: 10 class templates + noise (same
+    generator as the torch example, examples/torch_mnist.py)."""
+    templates = np.random.RandomState(0).randn(10, 28, 28, 1).astype(
+        np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    images = templates[labels] + 0.3 * rng.randn(n, 28, 28, 1).astype(
+        np.float32)
+    return images, labels.astype(np.int64)
+
+
+def build_model():
+    """The reference's keras convnet (keras_mnist.py:27-44), sized down
+    to match the synthetic data."""
+    return tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation="relu",
+                               input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10, activation="softmax"),
+    ])
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.003)
+    parser.add_argument("--train-size", type=int, default=2048)
+    args = parser.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(42 + hvd.rank())  # broadcast equalizes the starts
+
+    images, labels = make_dataset(args.train_size)
+    # Partition the data across ranks (the DistributedSampler analogue,
+    # reference keras_mnist.py:49-55). EQUAL shard lengths: a rank with
+    # one extra batch would issue a gradient allreduce its peers never
+    # join (they are already in the epoch-end metric allreduce).
+    per = len(images) // hvd.size()
+    shard = slice(hvd.rank() * per, (hvd.rank() + 1) * per)
+    x_train, y_train = images[shard], labels[shard]
+    x_test, y_test = make_dataset(512, seed=1)
+
+    model = build_model()
+    # Scale lr by size (reference :58), wrap the optimizer, broadcast.
+    opt = DistributedOptimizer(
+        tf.keras.optimizers.SGD(args.lr * hvd.size(), momentum=0.5))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    model.fit(x_train, y_train, batch_size=args.batch_size,
+              epochs=args.epochs, verbose=0, shuffle=False,
+              callbacks=[BroadcastGlobalVariablesCallback(0),
+                         MetricAverageCallback()])
+
+    loss, acc = model.evaluate(x_test, y_test, verbose=0)
+    loss = float(hvd.allreduce(tf.constant(loss), name="eval_loss"))
+    acc = float(hvd.allreduce(tf.constant(acc), name="eval_acc"))
+    if hvd.rank() == 0:
+        print(f"test_loss={loss:.4f} test_acc={acc:.4f}")
+
+    hvd.shutdown()
+    return 0 if acc > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,8 @@
-"""tf.keras callbacks for the TF binding (parity surface of reference
-horovod/keras/callbacks.py: BroadcastGlobalVariablesCallback and
-MetricAverageCallback; the LR-schedule callbacks live on the flax lane,
-horovod_tpu/flax/callbacks.py, which is the flagship's keras analogue)."""
+"""tf.keras surface for the TF binding (parity of reference
+horovod/keras/__init__.py + callbacks.py: DistributedOptimizer,
+BroadcastGlobalVariablesCallback, MetricAverageCallback; the LR-schedule
+callbacks live on the flax lane, horovod_tpu/flax/callbacks.py, which is
+the flagship's keras analogue)."""
 
 from __future__ import annotations
 
@@ -9,6 +10,68 @@ import numpy as np
 import tensorflow as tf
 
 import horovod_tpu.tf as hvd
+from horovod_tpu.tf import Compression, _allreduce_batch
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         average: bool = True):
+    """Make a tf.keras optimizer average gradients over ranks before
+    applying them (reference keras/__init__.py:32-52 wrapped
+    get_gradients; modern keras routes every path — fit(), custom
+    loops — through apply_gradients, so that is the interception
+    point). The instance is re-classed in place, torch-binding style,
+    so isinstance, serialization, and existing references keep working;
+    the batched allreduce keeps the native core's fusion engaged."""
+    base = optimizer.__class__
+
+    def _reduce(grads):
+        if tf.executing_eagerly():
+            return _allreduce_batch(grads, average, compression)
+        # Inside fit()'s compiled train step the gradients are symbolic;
+        # tf.py_function hops back to eager for the native-core
+        # collectives — one graph node per step, so every rank issues
+        # the batch in the same deterministic order. (Dense gradients
+        # only, like the eager path.)
+        present = [g for g in grads if g is not None]
+        outs = tf.py_function(
+            lambda *ts: _allreduce_batch(list(ts), average, compression),
+            inp=present, Tout=[g.dtype for g in present])
+        outs = [outs] if not isinstance(outs, (list, tuple)) else list(outs)
+        it = iter(outs)
+        reduced = []
+        for g in grads:
+            if g is None:
+                reduced.append(None)
+            else:
+                out = next(it)
+                out.set_shape(g.shape)
+                reduced.append(out)
+        return reduced
+
+    if hasattr(base, "apply"):
+        # Keras 3: apply_gradients is a thin wrapper over apply(), and
+        # custom loops (and LossScaleOptimizer's inner calls) invoke
+        # apply() directly — intercepting the funnel point covers every
+        # path with no double-reduce (the base apply_gradients delegates
+        # into this override).
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            reduced = _reduce(list(grads))
+            return super(cls, self).apply(reduced, trainable_variables,
+                                          **kwargs)
+
+        cls = type(base.__name__, (base,), {"apply": apply})
+    else:  # pre-Keras-3 optimizers: apply_gradients IS the funnel
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = _reduce([g for g, _ in gv])
+            return super(cls, self).apply_gradients(
+                [(rg, v) for rg, (_, v) in zip(reduced, gv)],
+                *args, **kwargs)
+
+        cls = type(base.__name__, (base,),
+                   {"apply_gradients": apply_gradients})
+    optimizer.__class__ = cls
+    return optimizer
 
 
 class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):

@@ -52,6 +52,7 @@ _SLOW_TESTS = {
     "test_examples_models.py::TestExamples::test_long_context_ring_attention_smoke",
     "test_examples_models.py::TestExamples::test_jax_mnist",
     "test_examples_models.py::TestExamples::test_torch_mnist_via_launcher",
+    "test_examples_models.py::TestExamples::test_tf_keras_mnist_via_launcher",
     "test_examples_models.py::TestExamples::test_torch_synthetic_benchmark_via_launcher",
     "test_examples_models.py::TestModelZoo::test_forward_shapes[inception_v3-shape1]",
     "test_conv_bn.py::TestFusedResNet::test_inception_fused_matches_unfused",

@@ -213,6 +213,13 @@ class TestExamples:
         _run_via_launcher("torch_mnist.py", "--epochs", "4",
                           "--batch-size", "32", "--train-size", "2048")
 
+    def test_tf_keras_mnist_via_launcher(self):
+        """The TF-binding headline example (reference keras_mnist.py):
+        keras DistributedOptimizer + callbacks converge to >0.9 test
+        accuracy on 2 ranks (the script exits 1 below that)."""
+        _run_via_launcher("tf_keras_mnist.py", "--epochs", "3",
+                          "--batch-size", "32", "--train-size", "2048")
+
     def test_torch_synthetic_benchmark_via_launcher(self):
         """The torch-lane yardstick (reference
         examples/pytorch_synthetic_benchmark.py protocol) runs under the

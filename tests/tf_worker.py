@@ -128,6 +128,29 @@ def run(scenario: str) -> None:
                 gathered.numpy()[r], flat, atol=1e-6,
                 err_msg=f"rank {rank} diverged from {r}")
 
+        # keras DistributedOptimizer: DISJOINT per-rank data this time —
+        # only averaged apply_gradients can keep params in lockstep.
+        from horovod_tpu.tf.keras import DistributedOptimizer
+
+        tf.random.set_seed(3)
+        dmodel = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(4,))])
+        dopt = DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        dmodel.compile(optimizer=dopt, loss="mse")
+        rng = np.random.RandomState(50 + rank)  # different shards
+        Xr = rng.randn(64, 4).astype(np.float32)
+        yr = (Xr @ np.ones((4, 1))).astype(np.float32)
+        dmodel.fit(Xr, yr, epochs=2, batch_size=16, verbose=0,
+                   shuffle=False,
+                   callbacks=[BroadcastGlobalVariablesCallback(0)])
+        flat = np.concatenate(
+            [v.numpy().ravel() for v in dmodel.trainable_variables])
+        gathered = hvd.allgather(tf.constant(flat[None, :]))
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered.numpy()[r], flat, atol=1e-6,
+                err_msg=f"DistributedOptimizer: rank {rank} vs {r}")
+
         # LAZILY-BUILT model (no input_shape): zero variables exist at
         # on_train_begin, so the callback must defer the broadcast to
         # the first batch end (reference on_batch_end semantics) —
