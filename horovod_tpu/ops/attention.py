@@ -54,9 +54,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     with online softmax so only O(block_q x d) state persists.
 
     Mosaic discipline: every ref and every loop-carried value is kept
-    2-D ([block_q, 1] for the m/l statistics, [1, block_q] for the lse
-    output row) — 1-D vregs are the classic TPU-lowering trap that
-    interpret-mode CI cannot catch."""
+    2-D ([block_q, 1] for the m/l statistics, and the SAME [block_q, 1]
+    shape for the lse output block — writing it as a [1, block_q] row
+    would need a sublane->lane relayout inside the kernel, a classic
+    Mosaic-unsupported reshape that interpret-mode CI cannot catch)."""
     from jax.experimental import pallas as pl
 
     q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
@@ -71,7 +72,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         m, l, acc = carry
         k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T  # [block_q, block_k]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)  # [block_q, block_k]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -82,7 +84,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + p @ v_blk
+        acc_new = acc * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     if causal:
@@ -97,7 +100,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Per-row logsumexp (scores already include `scale`): persisted so the
     # backward never re-derives it with an extra pass over the key blocks.
-    lse_ref[...] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(1, block_q)
+    # Written in the statistics' native [block_q, 1] layout — no
+    # cross-lane reshape inside the kernel.
+    lse_ref[...] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -161,13 +166,15 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-            # 2-D [1, block_q] row per program (no squeezed 1-D output
-            # ref — see the kernel's Mosaic-discipline note).
-            pl.BlockSpec((1, block_q), lambda bh, qb: (bh, qb)),
+            # [block_q, 1] column per program — the statistics' native
+            # layout (see the kernel's Mosaic-discipline note); the
+            # trailing singleton is dropped OUTSIDE the kernel where a
+            # relayout is just an XLA reshape.
+            pl.BlockSpec((None, block_q, 1), lambda bh, qb: (bh, qb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Lq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Lq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
