@@ -478,7 +478,10 @@ def supervise(argv, args):
     return 0
 
 
-def main():
+def build_parser():
+    """The bench CLI (exposed so tests/test_sweep_lanes.py can statically
+    validate every tools/hw_sweep.py lane's arg wiring — a round-3
+    hardware window died to a wiring bug no CPU test had covered)."""
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="resnet50")
     parser.add_argument("--batch-size", type=int, default=None,
@@ -545,7 +548,11 @@ def main():
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     parser.add_argument("--_emit", default="", help=argparse.SUPPRESS)
-    args = parser.parse_args()
+    return parser
+
+
+def main():
+    args = build_parser().parse_args()
 
     # Supervision applies only to the single-process driver invocation.
     # Under a multi-process launcher (HOROVOD_RANK set by hvdrun), a
