@@ -121,7 +121,27 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
               compression=Compression.none):
     """Differentiable eager allreduce; gradient = allreduce, the
     transpose of a sum over ranks (reference tensorflow/mpi_ops.py:
-    94-121 registered the same gradient for graph mode)."""
+    94-121 registered the same gradient for graph mode).
+
+    A ``tf.IndexedSlices`` input (sparse gradient, e.g. from an
+    embedding lookup) takes the reference's sparse path
+    (tensorflow/__init__.py:96-110): allgather the slices' values and
+    indices instead of densifying — summing duplicate indices is the
+    consumer's contract, exactly as with local IndexedSlices."""
+    if isinstance(tensor, tf.IndexedSlices):
+        if average and not tensor.values.dtype.is_floating:
+            raise ValueError(
+                f"allreduce with average=True is not supported for integer "
+                f"IndexedSlices values dtype {tensor.values.dtype}; pass "
+                f"average=False (sum) or cast to a floating dtype first.")
+        values = allgather(tensor.values, name=f"{name}.values"
+                           if name else None)
+        if average:
+            values = values / size()
+        indices = allgather(tensor.indices, name=f"{name}.indices"
+                            if name else None)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
     tensor = tf.convert_to_tensor(tensor)
     if average and not tensor.dtype.is_floating:
         raise ValueError(
@@ -217,10 +237,12 @@ class DistributedGradientTape:
     one-line migration."""
 
     def __init__(self, gradtape: tf.GradientTape,
-                 compression=Compression.none, average: bool = True):
+                 compression=Compression.none, average: bool = True,
+                 sparse_as_dense: bool = False):
         self._tape = gradtape
         self._compression = compression
         self._average = average
+        self._sparse_as_dense = sparse_as_dense
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
@@ -229,23 +251,44 @@ class DistributedGradientTape:
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
         flat = tf.nest.flatten(grads)
-        reduced = _allreduce_batch(flat, self._average, self._compression)
+        reduced = _allreduce_batch(flat, self._average, self._compression,
+                                   sparse_as_dense=self._sparse_as_dense)
         return tf.nest.pack_sequence_as(grads, reduced)
 
 
-def _allreduce_batch(tensors, average, compression):
+def _allreduce_batch(tensors, average, compression,
+                     sparse_as_dense: bool = False):
     """Enqueue EVERY tensor's allreduce before waiting on any, so the
     native core's fusion buffer packs small gradients into one ring pass
     (the same reason the torch DistributedOptimizer enqueues from hooks
     and drains in synchronize(); one-at-a-time sync calls would serialize
     N ring latencies and defeat HOROVOD_FUSION_THRESHOLD). Entries may be
-    None (unconnected gradients), preserved as None."""
+    None (unconnected gradients), preserved as None. ``tf.IndexedSlices``
+    entries ride the sparse allgather path (or densify first under
+    ``sparse_as_dense`` — reference DistributedOptimizer's flag,
+    tensorflow/__init__.py:64-66); they resolve inline since the gather
+    has its own wire."""
     core = _require_core()
     entries = []
     for i, t in enumerate(tensors):
         if t is None:
             entries.append(None)
             continue
+        if isinstance(t, tf.IndexedSlices):
+            if sparse_as_dense:
+                t = tf.convert_to_tensor(t)
+            else:
+                # Async like the dense entries: both allgathers enqueue
+                # now and drain in the second loop, keeping the batch's
+                # enqueue-everything-then-wait property.
+                vals = np.ascontiguousarray(t.values.numpy())
+                idxs = np.ascontiguousarray(t.indices.numpy())
+                hv = core.allgather_async(
+                    _next_name("allgather", f"grad.{i}.values"), vals)
+                hi = core.allgather_async(
+                    _next_name("allgather", f"grad.{i}.indices"), idxs)
+                entries.append(("sparse", hv, hi, vals, idxs, t))
+                continue
         compressed, ctx = compression.compress(tf.convert_to_tensor(t))
         arr = _to_writable_numpy(compressed)
         h = core.allreduce_async_(_next_name("allreduce", f"grad.{i}"), arr)
@@ -254,6 +297,19 @@ def _allreduce_batch(tensors, average, compression):
     for entry in entries:
         if entry is None:
             out.append(None)
+            continue
+        if isinstance(entry, tuple) and entry[0] == "sparse":
+            _, hv, hi, vals, idxs, t = entry
+            core.wait(hv)
+            gvals = tf.constant(core.take_result(
+                hv, vals.dtype, tuple(vals.shape[1:])))
+            core.wait(hi)
+            gidxs = tf.constant(core.take_result(
+                hi, idxs.dtype, tuple(idxs.shape[1:])))
+            if average:
+                gvals = gvals / size()
+            out.append(tf.IndexedSlices(gvals, gidxs,
+                                        dense_shape=t.dense_shape))
             continue
         h, arr, ctx = entry
         core.wait(h)

@@ -30,8 +30,12 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
         # Inside fit()'s compiled train step the gradients are symbolic;
         # tf.py_function hops back to eager for the native-core
         # collectives — one graph node per step, so every rank issues
-        # the batch in the same deterministic order. (Dense gradients
-        # only, like the eager path.)
+        # the batch in the same deterministic order. IndexedSlices
+        # (embedding gradients) densify here: py_function transports
+        # dense tensors only — the behavior of the reference's
+        # sparse_as_dense flag, applied where the transport demands it.
+        grads = [tf.convert_to_tensor(g)
+                 if isinstance(g, tf.IndexedSlices) else g for g in grads]
         present = [g for g in grads if g is not None]
         outs = tf.py_function(
             lambda *ts: _allreduce_batch(list(ts), average, compression),

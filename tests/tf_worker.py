@@ -59,6 +59,40 @@ def run(scenario: str) -> None:
         gv = tape.gradient(z, v).numpy()
         np.testing.assert_allclose(gv, float(size) if rank == 0 else 0.0)
 
+        # Sparse path (reference tensorflow/__init__.py:96-110):
+        # IndexedSlices allreduce == allgather of values + indices.
+        # Rank r contributes row r with value r+1; the densified result
+        # must hold every rank's slice.
+        sl = tf.IndexedSlices(
+            tf.fill((1, 3), float(rank + 1)), tf.constant([rank]),
+            dense_shape=tf.constant([size, 3], tf.int64))
+        red = hvd.allreduce(sl, average=False)
+        assert isinstance(red, tf.IndexedSlices), type(red)
+        dense = tf.math.unsorted_segment_sum(red.values, red.indices,
+                                             size).numpy()
+        for r in range(size):
+            np.testing.assert_allclose(dense[r], float(r + 1))
+
+        # The same slices through DistributedGradientTape: an embedding
+        # lookup's gradient arrives as IndexedSlices; averaged values,
+        # and sparse_as_dense=True densifies to the same totals.
+        emb = tf.Variable(tf.zeros((size + 1, 2)))
+        with tf.GradientTape() as tape:
+            y2 = tf.reduce_sum(tf.gather(emb, [rank]) * (rank + 1))
+        dtape = hvd.DistributedGradientTape(tape)
+        (ge,) = dtape.gradient(y2, [emb])
+        assert isinstance(ge, tf.IndexedSlices)
+        ge_dense = tf.math.unsorted_segment_sum(
+            ge.values, ge.indices, size + 1).numpy()
+        with tf.GradientTape() as tape:
+            y3 = tf.reduce_sum(tf.gather(emb, [rank]) * (rank + 1))
+        dtape2 = hvd.DistributedGradientTape(tape, sparse_as_dense=True)
+        (gd2,) = dtape2.gradient(y3, [emb])
+        assert not isinstance(gd2, tf.IndexedSlices)
+        np.testing.assert_allclose(gd2.numpy(), ge_dense, atol=1e-6)
+        for r in range(size):
+            np.testing.assert_allclose(ge_dense[r], (r + 1) / size)
+
     elif scenario == "tape":
         # DistributedGradientTape end-to-end: disjoint data shards, SGD
         # on averaged gradients converges and params stay in lockstep
@@ -150,6 +184,30 @@ def run(scenario: str) -> None:
             np.testing.assert_allclose(
                 gathered.numpy()[r], flat, atol=1e-6,
                 err_msg=f"DistributedOptimizer: rank {rank} vs {r}")
+
+        # Embedding model under compiled fit: the gradients arrive as
+        # IndexedSlices and must densify through the py_function hop;
+        # disjoint data + averaged grads keep ranks in lockstep.
+        tf.random.set_seed(5)
+        emodel = tf.keras.Sequential([
+            tf.keras.layers.Embedding(16, 4),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(1)])
+        eopt = DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        emodel.compile(optimizer=eopt, loss="mse")
+        rng = np.random.RandomState(60 + rank)
+        Xe = rng.randint(0, 16, size=(64, 3)).astype(np.int32)
+        ye = rng.randn(64, 1).astype(np.float32)
+        emodel.fit(Xe, ye, epochs=1, batch_size=16, verbose=0,
+                   shuffle=False,
+                   callbacks=[BroadcastGlobalVariablesCallback(0)])
+        flat = np.concatenate(
+            [v.numpy().ravel() for v in emodel.trainable_variables])
+        gathered = hvd.allgather(tf.constant(flat[None, :]))
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered.numpy()[r], flat, atol=1e-6,
+                err_msg=f"embedding model: rank {rank} vs {r}")
 
         # LAZILY-BUILT model (no input_shape): zero variables exist at
         # on_train_begin, so the callback must defer the broadcast to
