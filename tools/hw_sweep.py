@@ -39,6 +39,25 @@ LANES = [
     ("transformer_lm_flash", ["bench.py", "--model", "transformer_lm",
                               "--flash-attention"]),
     ("flash_check", ["tools/tpu_flash_check.py"]),
+    # Flash-vs-dense ladder at constant 16k tokens/chip: flash's win
+    # grows with the [L, L] score tensor, so the A/B runs at 4096 and
+    # 8192 too (dense@8192's [2, 12, 8192, 8192] fp32 scores are
+    # ~6.4 GB, ~12.9 GB with the softmax output — if that lane OOMs,
+    # the record IS the flash argument; --remat bounds the rest).
+    ("transformer_lm_seq4096", ["bench.py", "--model", "transformer_lm",
+                                "--seq-len", "4096", "--batch-size", "4",
+                                "--remat"]),
+    ("transformer_lm_seq4096_flash", ["bench.py", "--model",
+                                      "transformer_lm", "--seq-len", "4096",
+                                      "--batch-size", "4", "--remat",
+                                      "--flash-attention"]),
+    ("transformer_lm_seq8192", ["bench.py", "--model", "transformer_lm",
+                                "--seq-len", "8192", "--batch-size", "2",
+                                "--remat"]),
+    ("transformer_lm_seq8192_flash", ["bench.py", "--model",
+                                      "transformer_lm", "--seq-len", "8192",
+                                      "--batch-size", "2", "--remat",
+                                      "--flash-attention"]),
     # ViT: the compute-bound (MXU-friendly) image lane — unlike the
     # memory-bound ResNet family it should approach the chip's matmul
     # rate, quantifying how much of the ResNet gap is the model, not
