@@ -289,6 +289,45 @@ class TestUlysses:
                 mesh=mesh, in_specs=P(None, "sp"),
                 out_specs=P(None, "sp")))(q)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_attn_fn_composes(self, hvd, causal):
+        """The long-context flagship composition: after the head
+        reshard, each chip runs FULL-sequence attention locally — which
+        is exactly where the Pallas flash kernel belongs (attn_fn hook,
+        ulysses_attention docstring). Forward AND gradients must match
+        the dense reference; the kernel runs in interpret mode on the
+        CPU mesh (class-1 check_vma opt-out, docs/parallelism.md)."""
+        mesh = _mesh({"sp": 4})
+        key = jax.random.PRNGKey(11)
+        B, L, H, D = 2, 128, 4, 16  # flash blocks cover L after reshard
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, L, H, D))
+                   for i in range(3))
+
+        def flash(qh, kh, vh, causal, scale):
+            return flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                   block_q=32, block_k=32)
+
+        def loss_sharded(q, k, v):
+            fn = jax.shard_map(
+                lambda a, b, c: par.ulysses_attention(
+                    a, b, c, "sp", causal=causal, attn_fn=flash),
+                mesh=mesh, in_specs=P(None, "sp"),
+                out_specs=P(None, "sp"), check_vma=False)
+            return jnp.sum(fn(q, k, v) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=causal) ** 2)
+
+        np.testing.assert_allclose(
+            float(jax.jit(loss_sharded)(q, k, v)),
+            float(loss_dense(q, k, v)), rtol=1e-5)
+        g_sharded = jax.grad(loss_sharded, (0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+        for gs, gd in zip(g_sharded, g_dense):
+            np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                                       atol=1e-4)
+
 
 class TestTensorParallel:
     def test_mlp_matches_dense(self, hvd):
