@@ -1,20 +1,38 @@
 #!/usr/bin/env python
-"""Real-TPU validation of the pallas flash attention kernel + backward.
+"""Real-TPU validation of the pallas flash attention kernel + backward,
+plus a flash-vs-dense micro timing ladder.
 
 CI exercises the kernel in pallas interpret mode on the CPU mesh
 (tests/test_parallel.py::TestFlashAttention); this script is the
 on-hardware counterpart: compile and run the actual Mosaic kernel
 (forward incl. the persisted-logsumexp output, then the custom-VJP
-backward) and check numerics against the dense reference in bf16.
+backward), check numerics against the dense reference in bf16, then
+time fwd+bwd flash vs dense at seq 1024/2048/4096 — so one short
+healthy window yields the crossover evidence even if the full
+transformer_lm sweep lanes (tools/hw_sweep.py seq ladder) time out.
 
 Run on a TPU host:  python tools/tpu_flash_check.py
 """
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
 
 from horovod_tpu.ops.attention import dot_product_attention, flash_attention
+
+
+def _time_fwd_bwd(fn, q, k, v, iters=20):
+    lossgrad = jax.jit(jax.value_and_grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+        argnums=(0, 1, 2)))
+    out = lossgrad(q, k, v)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = lossgrad(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
 
 
 def main():
@@ -39,7 +57,32 @@ def main():
                                  gr.astype(jnp.float32))))
     print(f"backward max err: {gerr:.2e}", file=sys.stderr)
     assert gerr < 5e-2, gerr
-    print("TPU-FLASH: OK")
+    # Sentinel BEFORE the timing ladder: the kernel validation above is
+    # the scarce evidence — a dense-path OOM or tunnel wedge in the
+    # secondary benchmark below must not make it read as a failure.
+    print("TPU-FLASH: OK", flush=True)
+
+    # Micro A/B: fwd+bwd wall time per step, GPT-2-small-ish head shape.
+    # Each rung degrades independently (a seq-4096 dense OOM is itself
+    # a useful record, not a script failure).
+    for seq in (1024, 2048, 4096):
+        qs, ks, vs = (jax.random.normal(jax.random.fold_in(key, 10 + i),
+                                        (2, seq, 8, 64), jnp.bfloat16)
+                      for i in range(3))
+        try:
+            tf_ = _time_fwd_bwd(
+                lambda a, b, c: flash_attention(a, b, c, causal=True),
+                qs, ks, vs)
+            td = _time_fwd_bwd(
+                lambda a, b, c: dot_product_attention(a, b, c, causal=True),
+                qs, ks, vs)
+            print(f"seq {seq}: flash {tf_ * 1e3:.3f} ms  "
+                  f"dense {td * 1e3:.3f} ms  ratio {td / tf_:.2f}x",
+                  file=sys.stderr, flush=True)
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            print(f"seq {seq}: ladder rung failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr,
+                  flush=True)
 
 
 if __name__ == "__main__":
