@@ -6,6 +6,7 @@ reference test/common.py:25-58); this worker is the rebuild's equivalent:
 collective results against its (rank, size).
 """
 
+import os
 import sys
 
 import numpy as np
@@ -14,7 +15,6 @@ from horovod_tpu.native import NativeCore, NativeError
 
 
 def run(rank: int, size: int, port: int, scenario: str) -> None:
-    import os
 
     # Host grouping as the launcher would pass it down (run/__init__.py
     # sets HOROVOD_LOCAL_RANK/LOCAL_SIZE per host); defaults to one group.
@@ -265,6 +265,10 @@ def _run_subcomm(core, rank, size, port, timeout_ms):
         core.rank(), core.size(), comm)
     # All members share 127.0.0.1, so local grouping == the sub-world.
     assert core.local_rank() == sub_rank and core.local_size() == len(comm)
+    want_hier = int(os.environ.get("HVD_TEST_WANT_HIER", "-1"))
+    if want_hier >= 0:
+        assert core.hierarchical_active() == want_hier, (
+            core.hierarchical_active(), want_hier)
 
     # Closed-form allreduce within the sub-world only: the sum runs over
     # MEMBER world ranks, proving no cross-sub-world mixing.

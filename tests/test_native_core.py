@@ -191,6 +191,16 @@ class TestSubCommunicator:
         (the closed forms sum member world-ranks only)."""
         _spawn(4, "subcomm")
 
+    def test_hierarchical_knob_degrades_to_flat_in_subworlds(self):
+        """A sub-world regroups local_size to its member count (one
+        host here), so the two-level ladder cannot tile (inner == size)
+        and must degrade to the flat ring per sub-world — collectives
+        stay correct rather than deadlocking on a mixed dial."""
+        env = {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+               "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+               "HVD_TEST_WANT_HIER": "0"}
+        _spawn(4, "subcomm", extra_env={r: dict(env) for r in range(4)})
+
     def test_inconsistent_split_fails_on_every_rank(self):
         """Rank 0 claims {0,1} while rank 1 claims its singleton (and
         rank 2 its own): the global validation fails every rank together

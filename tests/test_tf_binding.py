@@ -108,6 +108,19 @@ class TestSingleProcess:
             y = tf.reduce_sum(hvd_tf.allreduce(x, average=False))
         np.testing.assert_allclose(tape.gradient(y, x).numpy(), 1.0)
 
+    def test_grad_allgather(self, hvd_tf):
+        """grad(allgather) = allreduce-sum of the upstream grad, then
+        this rank's row slice (reference tensorflow/mpi_ops.py:127-148)
+        — identity-world value 1.0."""
+        import tensorflow as tf
+
+        x = tf.Variable(tf.ones((3, 2)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allgather(x))
+        g = tape.gradient(y, x)
+        assert g.shape == (3, 2)
+        np.testing.assert_allclose(g.numpy(), 1.0)
+
     def test_distributed_gradient_tape_delegates(self, hvd_tf):
         import tensorflow as tf
 

@@ -59,6 +59,16 @@ def run(scenario: str) -> None:
         gv = tape.gradient(z, v).numpy()
         np.testing.assert_allclose(gv, float(size) if rank == 0 else 0.0)
 
+        # grad(allgather): allreduce-sum of dy, sliced to this rank's
+        # rows — with identical per-rank losses, sum-over-ranks
+        # convention gives size (reference tensorflow/mpi_ops.py:127-148).
+        xr = tf.Variable(tf.ones((rank + 1, 2)))  # ragged rows
+        with tf.GradientTape() as tape:
+            yg = tf.reduce_sum(hvd.allgather(xr))
+        gg = tape.gradient(yg, xr)
+        assert gg.shape == (rank + 1, 2), gg.shape
+        np.testing.assert_allclose(gg.numpy(), float(size))
+
         # Sparse path (reference tensorflow/__init__.py:96-110):
         # IndexedSlices allreduce == allgather of values + indices.
         # Rank r contributes row r with value r+1; the densified result
