@@ -58,6 +58,22 @@ LANES = [
                                       "transformer_lm", "--seq-len", "8192",
                                       "--batch-size", "2", "--remat",
                                       "--flash-attention"]),
+    # Fused-CE regime test (round-4): at vocab 32k/16k tokens the fused
+    # loss showed no win (PERF.md) — its claimed regime is a bigger
+    # head, where the dense [tokens, vocab] fp32 logits round-trips
+    # dominate. A/B at vocab 64k prices that claim.
+    ("transformer_lm_v64k", ["bench.py", "--model", "transformer_lm",
+                             "--vocab", "64000"]),
+    ("transformer_lm_v64k_fused_ce", ["bench.py", "--model",
+                                      "transformer_lm", "--vocab", "64000",
+                                      "--fused-ce"]),
+    # Kitchen-sink long-context lane: flash + fused-CE + remat at seq
+    # 8192 — the framework's best-recipe tokens/sec claim.
+    ("transformer_lm_seq8192_flash_fused", ["bench.py", "--model",
+                                            "transformer_lm", "--seq-len",
+                                            "8192", "--batch-size", "2",
+                                            "--remat", "--flash-attention",
+                                            "--fused-ce"]),
     # ViT: the compute-bound (MXU-friendly) image lane — unlike the
     # memory-bound ResNet family it should approach the chip's matmul
     # rate, quantifying how much of the ResNet gap is the model, not
