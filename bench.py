@@ -442,17 +442,32 @@ def supervise(argv, args):
                 payload = json.loads(f.read().strip() or "null")
         except (OSError, ValueError):
             payload = None
+        # The child writes its exception summary to <emit>.err — the
+        # one way the REASON for a crash survives into this record
+        # (stderr flows to the driver log, which sweeps don't keep).
+        try:
+            with open(emit_path + ".err") as f:
+                err_detail = f.read().strip()
+        except OSError:
+            err_detail = ""
         finally:
-            try:
-                os.unlink(emit_path)
-            except OSError:
-                pass
+            for path in (emit_path, emit_path + ".err"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         if payload is not None:
             _disarm()
             print(json.dumps(payload))
             return 0
         if rc is not None:
             last_err = f"attempt {attempt} exited rc={rc} before emitting"
+        if err_detail:
+            # Attach the child's exception summary whether it exited or
+            # hung afterwards (a crash whose teardown blocks on a dead
+            # tunnel is rc=None but the .err was already written).
+            last_err += f" [{err_detail[:300]}]"
+        if rc is not None or err_detail:
             print(f"[bench supervisor] {last_err}", file=sys.stderr,
                   flush=True)
         if rc in (2, _RC_DETERMINISTIC):
@@ -596,14 +611,25 @@ def main():
     except Exception as exc:
         # Tell the supervisor whether a retry can help: backend/tunnel
         # flaps are transient; everything else (unknown model, shape
-        # errors) reruns identically.
+        # errors, OOM — XLA raises RESOURCE_EXHAUSTED with an
+        # underscore, and rerunning the same program OOMs the same way)
+        # reruns identically.  Leave the exception summary where the
+        # supervisor can put it in the error record: a bare "rc=3" cost
+        # round 3 a diagnosis (dense seq-4096's failure reason never
+        # reached PERF_RUNS.tsv).
         transient_markers = ("backend", "unavailable", "deadline",
-                             "tunnel", "connect", "resource exhausted")
-        text = f"{type(exc).__name__}: {exc}".lower()
+                             "tunnel", "connect")
+        text = f"{type(exc).__name__}: {exc}"
+        if args._emit:
+            try:
+                with open(args._emit + ".err", "w") as f:
+                    f.write(text[:2000])
+            except OSError:
+                pass
         import traceback
 
         traceback.print_exc()
-        sys.exit(1 if any(m in text for m in transient_markers)
+        sys.exit(1 if any(m in text.lower() for m in transient_markers)
                  else _RC_DETERMINISTIC)
 
     if hvd.rank() == 0:

@@ -129,6 +129,10 @@ def test_crashing_child_degrades_to_error_json():
     assert out["metric"] == "no_such_model_img_per_sec_per_chip"
     assert out["value"] is None
     assert "deterministic" in out["error"]
+    # The record must be self-diagnosing: the child's exception summary
+    # rides the error field (round 3's dense seq-4096 rc=3 reached
+    # PERF_RUNS.tsv with no reason at all).
+    assert "Unknown model" in out["error"]
     # Fail-fast: exactly one attempt despite HVD_BENCH_ATTEMPTS=3.
     assert proc.stderr.count("attempt 1/") == 1
     assert "attempt 2/" not in proc.stderr
