@@ -74,6 +74,14 @@ LANES = [
                                             "8192", "--batch-size", "2",
                                             "--remat", "--flash-attention",
                                             "--fused-ce"]),
+    # Longest single-chip context rung: seq 16k, batch 1 (16k tok/chip
+    # like every LM lane). Dense would need a [1,12,16384,16384] fp32
+    # score tensor (12.9 GB) — structurally flash-only territory.
+    ("transformer_lm_seq16384_flash", ["bench.py", "--model",
+                                       "transformer_lm", "--seq-len",
+                                       "16384", "--batch-size", "1",
+                                       "--remat", "--flash-attention",
+                                       "--fused-ce"]),
     # ViT: the compute-bound (MXU-friendly) image lane — unlike the
     # memory-bound ResNet family it should approach the chip's matmul
     # rate, quantifying how much of the ResNet gap is the model, not
