@@ -62,6 +62,14 @@ def main():
     # secondary benchmark below must not make it read as a failure.
     print("TPU-FLASH: OK", flush=True)
 
+    if "--block-sweep" in sys.argv:
+        # Sweep mode: keep the cheap numerics canary above, skip the
+        # flash-vs-dense ladder (the separate flash_check lane owns it
+        # — re-paying its 6 timed compiles here would eat the sweep
+        # lane's budget on a congested tunnel).
+        block_sweep(key)
+        return
+
     # Micro A/B: fwd+bwd wall time per step, GPT-2-small-ish head shape.
     # Each rung degrades independently (a seq-4096 dense OOM is itself
     # a useful record, not a script failure).
@@ -83,6 +91,61 @@ def main():
             print(f"seq {seq}: ladder rung failed: "
                   f"{type(exc).__name__}: {exc}", file=sys.stderr,
                   flush=True)
+
+
+def block_sweep(key):
+    """Time flash fwd+bwd across (block_q, block_k) tilings at the
+    dense/flash crossover lengths. The kernel default is 128x128; the
+    round-4 A/B showed dense beating flash by ~5% at seq 2048, so if a
+    bigger tile wins there, flash wins at every length and the default
+    should follow the measurement (larger k-blocks amortize the online
+    softmax rescale; larger q-blocks raise MXU tile occupancy at the
+    cost of VMEM).  Prints one summary line LAST so a sweep-lane record
+    (tools/hw_sweep.py keeps the final line) carries the best config.
+    """
+    results = {}
+    for seq in (2048, 4096):
+        qs, ks, vs = (jax.random.normal(jax.random.fold_in(key, 20 + i),
+                                        (2, seq, 8, 64), jnp.bfloat16)
+                      for i in range(3))
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                if bq > seq or bk > seq:
+                    continue
+                try:
+                    t = _time_fwd_bwd(
+                        lambda a, b, c: flash_attention(
+                            a, b, c, causal=True, block_q=bq, block_k=bk),
+                        qs, ks, vs)
+                    results[(seq, bq, bk)] = t
+                    print(f"seq {seq} bq {bq} bk {bk}: {t * 1e3:.3f} ms",
+                          file=sys.stderr, flush=True)
+                except Exception as exc:  # noqa: BLE001
+                    print(f"seq {seq} bq {bq} bk {bk}: failed "
+                          f"{type(exc).__name__}: {exc}",
+                          file=sys.stderr, flush=True)
+    summary = []
+    for seq in (2048, 4096):
+        per = [(t, bq, bk) for (s, bq, bk), t in results.items()
+               if s == seq]
+        if per:
+            t, bq, bk = min(per)
+            base = results.get((seq, 128, 128))
+            gain = f" ({base / t:.2f}x vs 128x128)" if base else ""
+            summary.append(f"seq {seq}: best {bq}x{bk} "
+                           f"{t * 1e3:.3f} ms{gain}")
+    if not summary:
+        # No measurement = no record: exit nonzero so the sweep lane
+        # (and the watcher's done-check) retries rather than filing a
+        # "flash OK" line with no data in it.
+        print("block sweep: no rung completed", file=sys.stderr,
+              flush=True)
+        sys.exit(4)
+    line = "block sweep: " + "; ".join(summary)
+    # Last stderr line = the sweep-lane record (hw_sweep.py keeps it);
+    # stdout carries it too for direct runs.
+    print(line, file=sys.stderr, flush=True)
+    print(line, flush=True)
 
 
 if __name__ == "__main__":

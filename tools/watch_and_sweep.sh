@@ -53,7 +53,7 @@ probe_ok() {
 # from re-paying the known-deterministic rc=3 dense long-seq lanes
 # every pass, and bounds the post-midnight already_done_today reset to
 # these five.
-PENDING_LANES=transformer_lm_v64k,transformer_lm_v64k_fused_ce,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash,vgg16_warm,vgg16,inception_v3_warm,inception_v3,inception_v3_fused_bn
+PENDING_LANES=transformer_lm_v64k,transformer_lm_v64k_fused_ce,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash_fused,flash_block_sweep,vgg16_warm,vgg16,inception_v3_warm,inception_v3,inception_v3_fused_bn
 
 cache_done() {
   grep -q "cache_probe backend=default: run1 rc=0.*run2 rc=0" "$LOG"
@@ -62,6 +62,12 @@ cache_done() {
 all_done() {
   local lane
   for lane in ${PENDING_LANES//,/ }; do
+    if [ "$lane" = flash_block_sweep ]; then
+      # Non-bench lane: its record is the "flash OK: block sweep ..."
+      # stderr summary, not a JSON line.
+      grep -q "	flash_block_sweep	flash OK:" PERF_RUNS.tsv || return 1
+      continue
+    fi
     grep -q "	${lane}	{\"metric\"" PERF_RUNS.tsv && \
       ! grep "	${lane}	" PERF_RUNS.tsv | tail -1 | grep -q '"error"' \
       || return 1
