@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Render PERF_RUNS.tsv as a per-lane summary table (markdown).
+
+For each lane, the LATEST successful record wins (the sweep appends;
+reruns supersede). Errors are listed only for lanes with no success.
+One command turns the append-only evidence file into the table PERF.md
+and docs/benchmarks.md cite:
+
+    python tools/perf_summary.py            # all records
+    python tools/perf_summary.py --today    # today's (UTC) records only
+"""
+
+import argparse
+import datetime
+import json
+import os
+
+LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "PERF_RUNS.tsv")
+
+
+def load(today_only: bool):
+    ok, err = {}, {}
+    today = datetime.datetime.now(datetime.timezone.utc).date().isoformat()
+    for line in open(LOG):
+        parts = line.rstrip("\n").split("\t")
+        if len(parts) < 3:
+            continue
+        stamp, lane, payload = parts[0], parts[1], parts[2]
+        if today_only and not stamp.startswith(today):
+            continue
+        if payload.startswith("{"):
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                continue
+            if rec.get("value") is not None:
+                ok[lane] = (stamp, rec)
+            else:
+                err[lane] = (stamp, rec.get("error", "?"))
+        elif payload.startswith("flash OK:"):
+            ok[lane] = (stamp, {"metric": "verdict", "value": payload,
+                                "unit": "", "peak": None,
+                                "probe_tflops": None})
+        else:
+            err[lane] = (stamp, payload)
+    return ok, err
+
+
+def fmt(v):
+    if isinstance(v, float):
+        return f"{v:,.0f}" if v >= 1000 else f"{v:,.2f}"
+    return str(v)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--today", action="store_true",
+                    help="restrict to records stamped today (UTC)")
+    args = ap.parse_args()
+    ok, err = load(args.today)
+    print("| lane | value | unit | peak | probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|")
+    for lane in sorted(ok):
+        stamp, rec = ok[lane]
+        peak = rec.get("peak")
+        probe = rec.get("probe_tflops")
+        print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
+              f"| {fmt(peak) if peak is not None else '—'} "
+              f"| {fmt(probe) if probe is not None else '—'} "
+              f"| {stamp[11:19]} |")
+    pending = {k: v for k, v in err.items() if k not in ok}
+    if pending:
+        print()
+        print("Lanes with no successful record:")
+        for lane in sorted(pending):
+            stamp, reason = pending[lane]
+            print(f"- {lane} ({stamp[:19]}): {str(reason)[:160]}")
+
+
+if __name__ == "__main__":
+    main()
