@@ -22,7 +22,11 @@ LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
 def load(today_only: bool):
     ok, err = {}, {}
     today = datetime.datetime.now(datetime.timezone.utc).date().isoformat()
-    for line in open(LOG):
+    try:
+        lines = open(LOG).readlines()
+    except OSError:
+        return ok, err  # fresh checkout: render the empty table
+    for line in lines:
         parts = line.rstrip("\n").split("\t")
         if len(parts) < 3:
             continue
