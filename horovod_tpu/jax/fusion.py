@@ -137,26 +137,74 @@ def fused_reduce(
     for i, c in enumerate(compressed):
         by_dtype.setdefault(jnp.dtype(c.dtype), []).append(i)
 
+    # Per-bucket observability (the SPMD half of the reference's
+    # per-tensor activity taxonomy, operations.h:29-50): each bucket's
+    # collective is built under a jax.named_scope — the name lands in
+    # the HLO metadata, so device profiles (jax.profiler /
+    # tools/profile_step.py) attribute its time by name — and, when
+    # HOROVOD_TIMELINE is active, emits MEMCPY_IN_FUSION_BUFFER /
+    # ALLREDUCE / MEMCPY_OUT_FUSION_BUFFER spans on a per-bucket track
+    # at TRACE time (this code runs once per compile; the spans record
+    # the bucket PLAN — members/bytes/dtype — not per-step device time,
+    # which is stated in the span args; per-step device time is the
+    # profiler's job, per-step host dispatch is XLA_EXECUTE's).
+    import contextlib
+
+    import jax as _jax
+
+    from horovod_tpu.utils import timeline as _tl_names
+    from horovod_tpu.utils.timeline import activity as _activity
+
+    tl = getattr(st, "timeline", None)
+    emit = tl is not None and tl.enabled
+
+    @contextlib.contextmanager
+    def _span(track, act, args=None):
+        """B/E-paired top-level span (activity() covers the nested
+        MEMCPY spans; this pairs start/end the same exception-safe
+        way). No-ops when the timeline is off."""
+        if not emit:
+            yield
+            return
+        tl.start(track, act, args=args)
+        try:
+            yield
+        finally:
+            tl.end(track, act)
+
+    def _act(track, act_name):
+        return (_activity(tl, track, act_name) if emit
+                else contextlib.nullcontext())
+
     results: List = [None] * len(tensors)
     for dtype, idxs in by_dtype.items():
         sizes = [compressed[i].size * dtype.itemsize for i in idxs]
-        for bucket in _plan_buckets(sizes, fusion_threshold):
+        for b, bucket in enumerate(_plan_buckets(sizes, fusion_threshold)):
             members = [idxs[j] for j in bucket]
-            if len(members) == 1:
-                i = members[0]
-                results[i] = reduce_fn(compressed[i], axis)
-                continue
-            flat = jnp.concatenate(
-                [compressed[i].ravel() for i in members]
-            )
-            reduced = reduce_fn(flat, axis)
-            offset = 0
-            for i in members:
-                sz = compressed[i].size
-                results[i] = reduced[offset : offset + sz].reshape(
-                    compressed[i].shape
-                )
-                offset += sz
+            nbytes = sum(sizes[j] for j in bucket)
+            bucket_name = f"{name or 'fused'}.{dtype.name}.b{b}"
+            scope = f"hvd_allreduce_{bucket_name}".replace(".", "_")
+            with _span(bucket_name, _tl_names.ALLREDUCE,
+                       args={"span": "trace", "tensors": len(members),
+                             "bytes": int(nbytes)}), \
+                 _jax.named_scope(scope):
+                if len(members) == 1:
+                    i = members[0]
+                    results[i] = reduce_fn(compressed[i], axis)
+                    continue
+                with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
+                    flat = jnp.concatenate(
+                        [compressed[i].ravel() for i in members]
+                    )
+                reduced = reduce_fn(flat, axis)
+                with _act(bucket_name, _tl_names.MEMCPY_OUT_FUSION_BUFFER):
+                    offset = 0
+                    for i in members:
+                        sz = compressed[i].size
+                        results[i] = reduced[offset : offset + sz].reshape(
+                            compressed[i].shape
+                        )
+                        offset += sz
 
     out = []
     for i, t in enumerate(tensors):

@@ -62,6 +62,7 @@ def allreduce_gradients_transform(
             compression=compression,
             op=op,
             fusion_threshold=fusion_threshold,
+            name="grads",
         )
         return jax.tree_util.tree_unflatten(treedef, reduced), state
 
@@ -118,7 +119,7 @@ def grad(loss_fn, argnums=0, has_aux: bool = False):
         out = gfn(*args, **kwargs)
         grads, aux = (out[0], out[1]) if has_aux else (out, None)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
-        reduced = fused_reduce(leaves, average=True)
+        reduced = fused_reduce(leaves, average=True, name="grads")
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         return (grads, aux) if has_aux else grads
 
@@ -132,7 +133,7 @@ def value_and_grad(loss_fn, argnums=0, has_aux: bool = False):
     def wrapped(*args, **kwargs):
         value, grads = vgfn(*args, **kwargs)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
-        reduced = fused_reduce(leaves, average=True)
+        reduced = fused_reduce(leaves, average=True, name="grads")
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         if current_spmd_axis() is not None:
             if has_aux:

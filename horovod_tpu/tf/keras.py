@@ -1,10 +1,14 @@
 """tf.keras surface for the TF binding (parity of reference
-horovod/keras/__init__.py + callbacks.py: DistributedOptimizer,
-BroadcastGlobalVariablesCallback, MetricAverageCallback; the LR-schedule
-callbacks live on the flax lane, horovod_tpu/flax/callbacks.py, which is
-the flagship's keras analogue)."""
+horovod/keras/__init__.py + callbacks.py + _keras/callbacks.py:
+DistributedOptimizer, BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateScheduleCallback,
+LearningRateWarmupCallback, load_model; the flax lane
+(horovod_tpu/flax/callbacks.py) carries the same surface for the
+flagship jax path)."""
 
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 import tensorflow as tf
@@ -13,16 +17,12 @@ import horovod_tpu.tf as hvd
 from horovod_tpu.tf import Compression, _allreduce_batch
 
 
-def DistributedOptimizer(optimizer, compression=Compression.none,
-                         average: bool = True):
-    """Make a tf.keras optimizer average gradients over ranks before
-    applying them (reference keras/__init__.py:32-52 wrapped
-    get_gradients; modern keras routes every path — fit(), custom
-    loops — through apply_gradients, so that is the interception
-    point). The instance is re-classed in place, torch-binding style,
-    so isinstance, serialization, and existing references keep working;
-    the batched allreduce keeps the native core's fusion engaged."""
-    base = optimizer.__class__
+def _distributed_class(base, compression=Compression.none,
+                       average: bool = True):
+    """Build the rank-averaging subclass of optimizer class ``base``
+    (shared by DistributedOptimizer's in-place re-class and
+    load_model's post-load re-wrap). The subclass keeps ``base``'s
+    __name__ so keras serialization round-trips."""
 
     def _reduce(grads):
         if tf.executing_eagerly():
@@ -63,7 +63,11 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
             return super(cls, self).apply(reduced, trainable_variables,
                                           **kwargs)
 
-        cls = type(base.__name__, (base,), {"apply": apply})
+        # __module__ = base's: the subclass serializes as the plain
+        # class (keras resolves module.class_name at load), so saved
+        # models stay loadable by plain keras; load_model re-wraps.
+        cls = type(base.__name__, (base,),
+                   {"apply": apply, "__module__": base.__module__})
     else:  # pre-Keras-3 optimizers: apply_gradients IS the funnel
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
@@ -73,8 +77,42 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                 *args, **kwargs)
 
         cls = type(base.__name__, (base,),
-                   {"apply_gradients": apply_gradients})
-    optimizer.__class__ = cls
+                   {"apply_gradients": apply_gradients,
+                    "__module__": base.__module__})
+    cls._hvd_distributed = True
+    cls._hvd_wrap_args = (compression, average)
+    return cls
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         average: bool = True):
+    """Make a tf.keras optimizer average gradients over ranks before
+    applying them (reference keras/__init__.py:32-52 wrapped
+    get_gradients; modern keras routes every path — fit(), custom
+    loops — through apply_gradients, so that is the interception
+    point). The instance is re-classed in place, torch-binding style,
+    so isinstance, serialization, and existing references keep working;
+    the batched allreduce keeps the native core's fusion engaged.
+
+    Performance caveat (graph mode): inside ``fit()``'s compiled train
+    step the collectives route through one ``tf.py_function`` hop back
+    to eager per step — correct and deterministic, but it pins a
+    host-side transition on the step's critical path and densifies
+    IndexedSlices (embedding gradients) for transport. This lane is the
+    CPU/process-parallel binding; throughput-critical TPU training
+    belongs on the flagship jax/flax lane, whose collectives compile
+    into the XLA program itself.
+    Re-wrapping an already-distributed optimizer is a no-op when the
+    settings match and re-classes from the original base when they
+    differ (e.g. load_model's default wrap followed by an explicit
+    compression choice) — stacking two reduce layers would average
+    twice."""
+    cls = optimizer.__class__
+    if getattr(cls, "_hvd_distributed", False):
+        if cls._hvd_wrap_args == (compression, average):
+            return optimizer
+        cls = cls.__mro__[1]  # original base: swap, don't stack
+    optimizer.__class__ = _distributed_class(cls, compression, average)
     return optimizer
 
 
@@ -123,3 +161,178 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
                 logs[key] = float(hvd.allreduce(
                     tf.constant(np.float64(value)), average=True,
                     name=f"metric.{key}"))
+
+
+def _get_value(ref):
+    """Read a keras-3 Variable / tf.Variable / plain float uniformly."""
+    if hasattr(ref, "numpy"):
+        return float(ref.numpy())
+    return float(ref)
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Scale the learning rate by ``multiplier(epoch)`` inside
+    ``[start_epoch, end_epoch)`` (reference
+    _keras/callbacks.py:131-203). ``staircase=True`` applies an
+    integer-epoch multiplier on each epoch's first batch;
+    ``staircase=False`` applies a fractional-epoch multiplier
+    ``epoch + batch/steps_per_epoch`` on every batch.
+    ``steps_per_epoch`` is autodetected from fit()'s params when
+    possible.
+
+    Momentum correction (reference _keras/callbacks.py:168-177:
+    temporarily scale SGD momentum by ``new_lr/old_lr`` for the
+    adjusted batch) is applied when the optimizer's ``momentum`` is an
+    assignable variable. Keras 3 stores SGD momentum as a Python float
+    that fit()'s compiled train step captures at trace time, so the
+    correction is skipped there with a one-time warning — silently
+    mutating the attribute would look applied while the compiled step
+    kept the stale constant."""
+
+    def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.current_epoch = None
+        self._restore_momentum = None
+        self._warned_momentum = False
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- hyperparameter plumbing (keras-3 Variables / legacy Variables) --
+
+    def _lr_ref(self):
+        opt = self.model.optimizer
+        ref = getattr(opt, "learning_rate", None)
+        if ref is None:
+            ref = getattr(opt, "lr", None)
+        if ref is None or not hasattr(ref, "assign"):
+            raise ValueError(
+                "optimizer has no assignable learning_rate variable "
+                "(LearningRateSchedule objects cannot be overridden by "
+                "this callback)")
+        return ref
+
+    def _adjust(self, epoch) -> None:
+        lr = self._lr_ref()
+        old_lr = _get_value(lr)
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        lr.assign(new_lr)
+        if not self.momentum_correction or old_lr == 0.0:
+            return
+        mom = getattr(self.model.optimizer, "momentum", None)
+        if hasattr(mom, "assign"):
+            self._restore_momentum = _get_value(mom)
+            mom.assign(self._restore_momentum * new_lr / old_lr)
+        elif isinstance(mom, float) and mom and not self._warned_momentum:
+            self._warned_momentum = True
+            warnings.warn(
+                "momentum correction skipped: this optimizer stores "
+                "momentum as a Python constant that the compiled train "
+                "step captured at trace time (keras 3 SGD); pass "
+                "momentum_correction=False to silence", RuntimeWarning)
+
+    def _restore(self) -> None:
+        if self._restore_momentum is not None:
+            self.model.optimizer.momentum.assign(self._restore_momentum)
+            self._restore_momentum = None
+
+    # -- keras hooks --
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = _get_value(self._lr_ref())
+        if not self.staircase and not self.steps_per_epoch:
+            steps = (self.params or {}).get("steps")
+            if not steps:
+                raise ValueError(
+                    "could not autodetect steps_per_epoch; pass "
+                    "steps_per_epoch= explicitly")
+            self.steps_per_epoch = steps
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust(self.current_epoch)
+        elif not self.staircase:
+            self._adjust(self.current_epoch +
+                         float(batch) / self.steps_per_epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _get_value(self._lr_ref())
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup over ``warmup_epochs`` from ``initial_lr /
+    size`` to ``initial_lr`` (Goyal et al.; reference
+    _keras/callbacks.py:206-229): compile the model with the full
+    size-scaled rate, and this ramps the first epochs smoothly so
+    large effective batches don't diverge at step 0."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # Nudge so the ramp lands exactly on 1.0 at the last batch
+            # of the final warmup epoch (reference keeps TensorBoard
+            # curves round the same way).
+            epoch += 1.0 / self.steps_per_epoch
+            return (1.0 / hvd.size() *
+                    (epoch * (hvd.size() - 1) / warmup_epochs + 1))
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {_get_value(self._lr_ref()):g}.")
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, average: bool = True):
+    """Load a keras model with its optimizer wrapped as a
+    DistributedOptimizer. The reference substituted wrapped optimizer
+    classes into ``custom_objects`` at deserialization time
+    (_keras/__init__.py:93-109); keras 3 resolves built-in classes by
+    registered name before consulting ``custom_objects``
+    (serialization_lib._retrieve_class_or_fn), so class substitution
+    can no longer intercept them — instead the model loads normally
+    (slot variables, lr, iteration count all restored) and the loaded
+    optimizer instance is re-classed in place, which preserves that
+    state exactly. ``custom_optimizers`` / ``custom_objects`` are
+    still merged into the load so user-defined classes resolve."""
+    objects = {}
+    if custom_optimizers is not None:
+        objects.update({cls.__name__: cls for cls in custom_optimizers})
+    if custom_objects is not None:
+        objects.update(custom_objects)
+    model = tf.keras.models.load_model(filepath, custom_objects=objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        DistributedOptimizer(opt, compression=compression, average=average)
+    return model

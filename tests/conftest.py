@@ -62,6 +62,7 @@ _SLOW_TESTS = {
     "test_multiprocess_spmd.py::test_two_process_hierarchical_ladder",
     "test_multiprocess_spmd.py::test_four_process_global_mesh_end_to_end",
     "test_multiprocess_spmd.py::test_four_process_hierarchical_ladder",
+    "test_multiprocess_spmd.py::test_eight_process_asymmetric_ladder_and_ulysses",
     "test_tf_binding.py::TestMultiProcess::test_ops",
     "test_tf_binding.py::TestMultiProcess::test_distributed_gradient_tape_converges",
     "test_tf_binding.py::TestMultiProcess::test_keras_callbacks",

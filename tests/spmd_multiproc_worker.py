@@ -12,9 +12,12 @@ cross-process equality.
 import os
 import sys
 
+# Chips per process (virtual): 2 by default; the np=8 lane runs 1 so the
+# 8-way topology fits in 8 processes.
+_LOCAL = int(os.environ.get("HVD_TEST_LOCAL_CHIPS", "2"))
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=2"
+    + f" --xla_force_host_platform_device_count={_LOCAL}"
 ).strip()
 import jax
 
@@ -34,18 +37,18 @@ def main() -> int:
     hvd.init()
     nproc = int(os.environ["HOROVOD_SIZE"])
     assert hvd.process_count() == nproc, (hvd.process_count(), nproc)
-    assert hvd.size() == 2 * nproc, hvd.size()  # 2 virtual chips/process
-    assert hvd.local_size() == 2
+    assert hvd.size() == _LOCAL * nproc, hvd.size()
+    assert hvd.local_size() == _LOCAL
     me = hvd.process_rank()
 
     # 1. Cross-process SPMD allreduce: per-process host-local shards in,
     # psum over ALL chips out. Process p's chips carry value p+1.
-    x = jnp.full((2, 3), float(me + 1), jnp.float32)
+    x = jnp.full((_LOCAL, 3), float(me + 1), jnp.float32)
     out = hvd.spmd_run(
         lambda v: hvd.allreduce(v, average=False),
         x, in_specs=P("hvd"), out_specs=P("hvd"),
     )
-    expected = 2.0 * sum(p + 1 for p in range(nproc))
+    expected = float(_LOCAL) * sum(p + 1 for p in range(nproc))
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
 
     # 2. Eager process broadcast with a NON-ZERO root.
@@ -59,7 +62,7 @@ def main() -> int:
     rs_in = jnp.full((2 * nproc, 3), float(me + 1), jnp.float32)
     total = float(sum(p + 1 for p in range(nproc)))
     rs_sum = hvd.reducescatter(rs_in, average=False)
-    assert rs_sum.shape == (2, 3), rs_sum.shape
+    assert rs_sum.shape == (2, 3), rs_sum.shape  # dim0 / nproc
     np.testing.assert_allclose(np.asarray(rs_sum), total, rtol=1e-6)
     rs_avg = hvd.reducescatter(rs_in, average=True)
     np.testing.assert_allclose(np.asarray(rs_avg), total / nproc, rtol=1e-6)
@@ -181,7 +184,32 @@ def main() -> int:
     dense = np.einsum("bhqk,bkhd->bqhd", p_att, v)
     np.testing.assert_allclose(np.asarray(ring_local), dense[:, lo:hi],
                                rtol=2e-4, atol=2e-5)
-    assert n_chips == 2 * nproc  # the axis really spanned both hosts
+    assert n_chips == _LOCAL * nproc  # the axis really spanned all hosts
+
+    # 5b. Ulysses across the same boundary: TWO n_chips-way alltoalls
+    # (sequence->heads, heads->sequence) through the cross-process
+    # transport — the np=8 lane's 8-way split exercises source/target
+    # orderings a 2- or 4-way exchange cannot distinguish from their
+    # inverses. Heads == chips is the minimal legal split; exactness vs
+    # the same dense reference restricted to this host's rows.
+    Hu = n_chips
+    qs = rng_sp.randn(B, L, Hu, D).astype(np.float32)
+    ks = rng_sp.randn(B, L, Hu, D).astype(np.float32)
+    vs = rng_sp.randn(B, L, Hu, D).astype(np.float32)
+    ulys_local = hvd.spmd_run(
+        lambda a, b, c: par.ulysses_attention(a, b, c, axis="hvd",
+                                              causal=True),
+        jnp.asarray(qs[:, lo:hi]), jnp.asarray(ks[:, lo:hi]),
+        jnp.asarray(vs[:, lo:hi]),
+        in_specs=(P(None, "hvd"),) * 3, out_specs=P(None, "hvd"),
+    )
+    su = np.einsum("bqhd,bkhd->bhqk", qs, ks) / np.sqrt(D)
+    su = np.where(mask[None, None], su, -1e30)
+    pu = np.exp(su - su.max(-1, keepdims=True))
+    pu /= pu.sum(-1, keepdims=True)
+    dense_u = np.einsum("bhqk,bkhd->bqhd", pu, vs)
+    np.testing.assert_allclose(np.asarray(ulys_local), dense_u[:, lo:hi],
+                               rtol=2e-4, atol=2e-5)
 
     # Params must be IDENTICAL across processes (same broadcast start,
     # same averaged gradients) — the driver compares the digests.

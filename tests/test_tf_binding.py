@@ -140,6 +140,62 @@ class TestSingleProcess:
         np.testing.assert_allclose(out.numpy(), [1.5, -2.25])
 
 
+    def test_lr_schedule_callback_fit(self, hvd_tf):
+        """Staircase schedule inside fit(): lr untouched before
+        start_epoch, scaled after, logged per epoch (reference
+        _keras/callbacks.py:131-203)."""
+        import tensorflow as tf
+
+        from horovod_tpu.tf.keras import LearningRateScheduleCallback
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.2), loss="mse")
+        X = np.ones((8, 3), np.float32)
+        y = np.ones((8, 1), np.float32)
+        hist = model.fit(
+            X, y, epochs=3, batch_size=4, verbose=0, shuffle=False,
+            callbacks=[LearningRateScheduleCallback(
+                lambda e: 0.1 ** e, momentum_correction=False)])
+        np.testing.assert_allclose(hist.history["lr"],
+                                   [0.2, 0.02, 0.002], rtol=1e-5)
+
+    def test_lr_schedule_momentum_correction_warns_on_keras3_float(
+            self, hvd_tf):
+        """Keras 3 SGD stores momentum as a Python float the compiled
+        step captures at trace time — correction must warn-and-skip,
+        not silently mutate a dead attribute."""
+        import tensorflow as tf
+
+        from horovod_tpu.tf.keras import LearningRateScheduleCallback
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.2, momentum=0.9),
+                      loss="mse")
+        X = np.ones((8, 3), np.float32)
+        y = np.ones((8, 1), np.float32)
+        with pytest.warns(RuntimeWarning, match="momentum correction"):
+            model.fit(X, y, epochs=2, batch_size=4, verbose=0,
+                      shuffle=False,
+                      callbacks=[LearningRateScheduleCallback(0.5)])
+        assert model.optimizer.momentum == 0.9  # untouched
+
+    def test_lr_warmup_requires_steps_when_unknown(self, hvd_tf):
+        """Non-staircase callbacks autodetect steps_per_epoch from
+        fit()'s params; outside fit() the failure is loud."""
+        import tensorflow as tf
+
+        from horovod_tpu.tf.keras import LearningRateWarmupCallback
+
+        cb = LearningRateWarmupCallback(warmup_epochs=2)
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+        cb.set_model(model)
+        cb.params = {}
+        model.build((None, 3))
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            cb.on_train_begin()
+
+
 class TestMultiProcess:
     def test_ops(self):
         _spawn(2, "ops")
@@ -149,3 +205,6 @@ class TestMultiProcess:
 
     def test_keras_callbacks(self):
         _spawn(2, "keras")
+
+    def test_keras_lr_callbacks_and_load_model(self):
+        _spawn(2, "keras_lr")

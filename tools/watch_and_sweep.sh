@@ -48,13 +48,15 @@ probe_ok() {
   return 0
 }
 
-# The only sweep lanes still pending after the 18:03–18:43 window —
-# naming them explicitly (instead of bare --resume) keeps the watcher
-# from re-paying the known-deterministic rc=3 dense long-seq lanes
-# every pass, and bounds the post-midnight already_done_today reset to
-# these lanes (ten as of round 5: the five round-4 additions plus the
-# five slow vgg16/inception lanes).
-PENDING_LANES=transformer_lm_v64k,transformer_lm_v64k_fused_ce,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash_fused,flash_block_sweep,vgg16_warm,vgg16,inception_v3_warm,inception_v3,inception_v3_fused_bn
+# Round-5 queue (the round-4 queue drained in the 08:28 UTC window,
+# PERF.md round-5 section): re-price the flash lanes under the kernel's
+# NEW default block tiling (the block sweep's 1.29-1.35x winners are now
+# _default_blocks), stamp a fresh dense/flash A/B pair at seq 2048, and
+# re-run the kitchen-sink long-context lane. Naming lanes explicitly
+# (instead of bare --resume) keeps the watcher from re-paying lanes
+# settled as deterministic, and bounds the post-midnight
+# already_done_today reset to these lanes.
+PENDING_LANES=transformer_lm,transformer_lm_flash,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash_fused,resnet50
 
 cache_done() {
   grep -q "cache_probe backend=default: run1 rc=0.*run2 rc=0" "$LOG"
@@ -93,6 +95,7 @@ all_done() {
   done
   cache_done || return 1
   grep -q "LANE-DONE" tools/diag_seq4096.log 2>/dev/null || return 1
+  grep -q "LANE-DONE" tools/diag_seq16384.log 2>/dev/null || return 1
   grep -q "LANE-DONE" tools/profile_resnet50_base.log 2>/dev/null || return 1
   grep -q "LANE-DONE" tools/profile_resnet50_fused.log 2>/dev/null || return 1
   return 0
@@ -123,6 +126,15 @@ run_pass() {
     capture_once tools/diag_seq4096.log answer 480 \
     python bench.py --model transformer_lm \
     --seq-len 4096 --batch-size 4 --remat
+  probe_ok || return 1
+  # 2b. Same treatment for the seq-16384 flash+fused rc=3 (round-5):
+  #    the supervisor's truncated error hides whether this is HBM OOM
+  #    or a Mosaic rejection at the 16k shapes — the full traceback
+  #    decides whether a smaller remat policy can land the lane.
+  HVD_BENCH_NO_SUPERVISOR=1 \
+    capture_once tools/diag_seq16384.log answer 480 \
+    python bench.py --model transformer_lm \
+    --seq-len 16384 --batch-size 1 --remat --flash-attention --fused-ce
   probe_ok || return 1
   # 3. Fused-BN loss diagnosis: op-family share tables for both
   #    variants (the post-mortem's data), independently resumable.
