@@ -48,30 +48,37 @@ def dot_product_attention(q, k, v, causal: bool = False,
 # Pallas flash attention
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  seq_k: int, causal: bool, scale: float, block_q: int):
-    """One (batch*head, q-block) program: stream K/V blocks through VMEM
-    with online softmax so only O(block_q x d) state persists.
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, block_k: int, n_kblocks: int, causal: bool,
+                  scale: float, block_q: int):
+    """One (batch*head, q-block, K-BLOCK) grid step: the key axis rides
+    the grid (innermost, "arbitrary" semantics), so Mosaic's pipeline
+    streams [block_k, d] K/V tiles through double-buffered VMEM DMA
+    while the online-softmax state (m/l/acc) persists in VMEM scratch
+    across the k steps. VMEM is O(block) — the previous design mapped
+    the FULL [Lk, d] K/V into each program's VMEM, which hit the 16 MB
+    scoped limit at seq 16384 (tools/diag_seq16384.log: 16.25M > 16M).
 
-    Mosaic discipline: every ref and every loop-carried value is kept
-    2-D ([block_q, 1] for the m/l statistics, and the SAME [block_q, 1]
+    Mosaic discipline: every ref and all scratch is kept 2-D
+    ([block_q, 1] for the m/l statistics, and the SAME [block_q, 1]
     shape for the lse output block — writing it as a [1, block_q] row
     would need a sublane->lane relayout inside the kernel, a classic
     Mosaic-unsupported reshape that interpret-mode CI cannot catch)."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
     qi = pl.program_id(1)
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    kb = pl.program_id(2)
 
-    n_kblocks = seq_k // block_k
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
+        k_blk = k_ref[...].astype(jnp.float32)      # [block_k, d]
+        v_blk = v_ref[...].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T,
                     preferred_element_type=jnp.float32)  # [block_q, block_k]
         if causal:
@@ -80,29 +87,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
     if causal:
-        # Only key blocks at or before this q-block's last row contribute.
-        last = (qi * block_q + block_q - 1) // block_k + 1
-        n_iter = jnp.minimum(last, n_kblocks)
-        m, l, acc = jax.lax.fori_loop(
-            0, n_iter, body, (m, l, acc))
+        # A k-block strictly past this q-block's last row is fully
+        # masked: skip its compute (its DMA is pipelined regardless).
+        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
     else:
-        m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
+        _compute()
 
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # Per-row logsumexp (scores already include `scale`): persisted so the
-    # backward never re-derives it with an extra pass over the key blocks.
-    # Written in the statistics' native [block_q, 1] layout — no
-    # cross-lane reshape inside the kernel.
-    lse_ref[...] = m + jnp.log(jnp.maximum(l, 1e-30))
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # Per-row logsumexp (scores already include `scale`): persisted
+        # so the backward never re-derives it with an extra pass over
+        # the key blocks. Written in the statistics' native
+        # [block_q, 1] layout — no cross-lane reshape inside the kernel.
+        lse_ref[...] = m_scr[...] + jnp.log(l)
 
 
 def _pick_block(cap: int, seq_len: int) -> int:
@@ -170,6 +180,7 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     """Returns (out [B, Lq, H, D], lse [B, H, Lq])."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -183,28 +194,40 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kr = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
     vr = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_k=Lk,
-                               causal=causal, scale=scale, block_q=block_q)
+    n_kblocks = Lk // block_k
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               n_kblocks=n_kblocks, causal=causal,
+                               scale=scale, block_q=block_q)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, Lq // block_q),
+        # K blocks ride the grid's INNERMOST axis: sequential
+        # ("arbitrary") so the scratch-carried softmax state is legal,
+        # while Mosaic double-buffers the [block_k, D] K/V tile DMAs.
+        grid=(B * H, Lq // block_q, n_kblocks),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
-            pl.BlockSpec((None, Lk, D), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qb, kb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, qb, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, qb, kb: (bh, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qb, kb: (bh, qb, 0)),
             # [block_q, 1] column per program — the statistics' native
             # layout (see the kernel's Mosaic-discipline note); the
             # trailing singleton is dropped OUTSIDE the kernel where a
             # relayout is just an XLA reshape.
-            pl.BlockSpec((None, block_q, 1), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, qb, kb: (bh, qb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Lq, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum l
+            pltpu.VMEM((block_q, D), jnp.float32),   # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
     return (out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3),

@@ -163,14 +163,19 @@ def run_lane(cmd, env, timeout: float):
         raise
 
 
-def already_done_today(lane: str) -> bool:
+def already_done_today(lane: str, after: str = "") -> bool:
+    """A lane is settled by a record from today — or, when ``after`` is
+    given (ISO UTC), a record stamped at or past that cutoff, so a
+    re-price queue can re-run lanes that already recorded earlier the
+    same day (ISO timestamps compare lexicographically)."""
     if not os.path.exists(LOG):
         return False
     today = datetime.datetime.now(datetime.timezone.utc).date().isoformat()
     for line in open(LOG):
         parts = line.rstrip("\n").split("\t")
         if (len(parts) >= 3 and parts[1] == lane
-                and parts[0].startswith(today)
+                and (parts[0] >= after if after
+                     else parts[0].startswith(today))
                 # A clean record, or an error the bench supervisor
                 # classified as deterministic (re-running reproduces
                 # the same failure — the record IS the artifact).
@@ -193,6 +198,9 @@ def main() -> int:
                     help="wall-clock bound per lane (seconds)")
     ap.add_argument("--resume", action="store_true",
                     help="skip lanes already recorded successfully today")
+    ap.add_argument("--after", default="",
+                    help="with --resume: only records at/past this ISO "
+                         "UTC timestamp count as already done")
     ap.add_argument("--lanes", default="",
                     help="comma list to restrict (names from the table)")
     args = ap.parse_args()
@@ -232,7 +240,7 @@ def main() -> int:
     for lane, cmd, *tags in LANES:
         if pick is not None and lane not in pick:
             continue
-        if args.resume and already_done_today(lane):
+        if args.resume and already_done_today(lane, args.after):
             print(f"[sweep] {lane}: already recorded today, skipping",
                   file=sys.stderr)
             continue

@@ -56,7 +56,16 @@ probe_ok() {
 # (instead of bare --resume) keeps the watcher from re-paying lanes
 # settled as deterministic, and bounds the post-midnight
 # already_done_today reset to these lanes.
-PENDING_LANES=transformer_lm,transformer_lm_flash,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash_fused,resnet50
+# inception A/B re-pay: the first capture showed fused-BN 3x FASTER on
+# inception (17.6k vs 5.7k img/s) with the fused lane on the WORSE
+# probe stamp — opposite sign to ResNet; a back-to-back pair either
+# confirms the first model-dependent fused-BN win or exposes a
+# congestion artifact.
+PENDING_LANES=transformer_lm,transformer_lm_flash,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash_fused,resnet50,inception_v3,inception_v3_fused_bn
+# Only records at/past this cutoff settle the re-price lanes — most of
+# them recorded successfully EARLIER today under the old flash tiling
+# (or, for inception, in a suspect non-adjacent A/B).
+CUTOFF=2026-08-01T09:15
 
 cache_done() {
   grep -q "cache_probe backend=default: run1 rc=0.*run2 rc=0" "$LOG"
@@ -72,6 +81,7 @@ cache_done() {
 lane_done() {
   local last
   last=$(grep "	${1}	" PERF_RUNS.tsv | tail -1)
+  [ "$(echo "$last" | cut -f1)" \> "$CUTOFF" ] || return 1
   echo "$last" | grep -q "	${1}	{\"metric\"" || return 1
   if echo "$last" | grep -q '"error"'; then
     # Exact supervisor stamp (bench.py appends "deterministic failure —
@@ -83,14 +93,17 @@ lane_done() {
 }
 
 all_done() {
-  local lane
+  local lane rec
   for lane in ${PENDING_LANES//,/ }; do
-    if [ "$lane" = flash_block_sweep ]; then
-      # Non-bench lane: its record is the "flash OK: block sweep ..."
-      # stderr summary, not a JSON line.
-      grep -q "	flash_block_sweep	flash OK:" PERF_RUNS.tsv || return 1
-      continue
-    fi
+    case "$lane" in
+      flash_check|flash_block_sweep)
+        # Non-bench lanes: the record is the "flash OK: ..." stderr
+        # summary, not a JSON line — still gated on the cutoff.
+        rec=$(grep "	${lane}	flash OK:" PERF_RUNS.tsv | tail -1)
+        { [ -n "$rec" ] && [ "$(echo "$rec" | cut -f1)" \> "$CUTOFF" ]; } \
+          || return 1
+        continue;;
+    esac
     lane_done "$lane" || return 1
   done
   cache_done || return 1
@@ -146,8 +159,8 @@ run_pass() {
   probe_ok || return 1
   # 4. The slow sweep lanes (vgg16/inception warm+measured), last.
   timeout -k 30 9000 python tools/hw_sweep.py --resume \
-    --lanes "$PENDING_LANES" --timeout 1500 \
-    >> tools/sweep_r4.log 2>&1 9>&-
+    --after "$CUTOFF" --lanes "$PENDING_LANES" --timeout 1500 \
+    >> tools/sweep_r5.log 2>&1 9>&-
   return 0
 }
 
@@ -166,5 +179,7 @@ while true; do
   else
     echo "$(stamp) probe failed-or-wedged (watcher)" >> "$LOG"
   fi
-  sleep 600
+  # Lock fd closed for the sleep too: a killed watcher must not leave
+  # an orphaned sleep holding the single-instance lock for 10 minutes.
+  sleep 600 9>&-
 done
