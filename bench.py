@@ -87,26 +87,57 @@ def probe_chip(log):
     import jax
     import jax.numpy as jnp
 
-    # Accelerator sizing (~20 ms). The hermetic-CI CPU mesh gets a token
-    # probe instead: 3.4 TFLOP of matmuls is ~30 s of host CPU, and the
-    # stamp only means something on real hardware anyway.
+    # Accelerator sizing. The hermetic-CI CPU mesh gets a token probe
+    # instead: 3.4 TFLOP of matmuls is ~30 s of host CPU, and the stamp
+    # only means something on real hardware anyway.
     if jax.devices()[0].platform == "cpu":
-        n, iters = 512, 4
+        n, n1, n2 = 512, 2, 6
     else:
-        n, iters = 4096, 25
+        n, n1, n2 = 4096, 25, 100
     x = (jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
          / jnp.sqrt(n)).astype(jnp.bfloat16)
     f = jax.jit(lambda a: a @ a)
-    f(x).block_until_ready()
-    t0 = time.perf_counter()
-    y = x
-    for _ in range(iters):
-        y = f(y)
-    jax.block_until_ready(y)
-    tflops = 2 * n**3 * iters / (time.perf_counter() - t0) / 1e12
-    log(f"Chip probe: {tflops:.1f} TFLOP/s sustained (bf16 {n}^3 matmul)",
+    # Warm + FORCE REAL SYNC (the axon trap, see run_timed): without a
+    # d2h pull first, this probe times dispatch, not the device — the
+    # pre-round-5 stamps read 3,000-16,000 "TFLOP/s" on a chip whose
+    # true sustained rate is ~180 TFLOP/s.
+    _force_sync(f(x))
+
+    def chain(iters):
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(iters):
+            y = f(y)
+        jax.block_until_ready(y)
+        return time.perf_counter() - t0
+
+    # MARGINAL rate over two chain lengths: each synced chain carries a
+    # fixed ~65 ms tunnel round-trip/sync overhead that a single short
+    # chain folds into the average (25 iters read 41 TF on a chip whose
+    # marginal rate is ~180 TF); the difference quotient cancels it.
+    t1, t2 = chain(n1), chain(n2)
+    if t2 <= t1:
+        # Timer noise on a loaded host can invert short CPU chains; an
+        # inverted delta would clamp into an absurd stamp — the exact
+        # failure class this probe was rebuilt to eliminate. A null
+        # stamp reads as "probe unreliable", never as a fast chip.
+        log(f"Chip probe UNRELIABLE: chain({n2})={t2:.4f}s <= "
+            f"chain({n1})={t1:.4f}s", file=sys.stderr)
+        return None
+    tflops = 2 * n**3 * (n2 - n1) / (t2 - t1) / 1e12
+    log(f"Chip probe: {tflops:.1f} TFLOP/s sustained "
+        f"(bf16 {n}^3 matmul, marginal over {n1}->{n2} chained)",
         file=sys.stderr)
     return round(tflops, 1)
+
+
+def _force_sync(tree) -> None:
+    """Pull one scalar off-device so block_until_ready means what it
+    says on the axon tunnel (see the sync-trap note in run_timed).
+    Shared implementation: horovod_tpu/utils/devsync.py."""
+    from horovod_tpu.utils.devsync import force_device_sync
+
+    force_device_sync(tree)
 
 
 def run_timed(run_step, state, batch, args, units_per_iter, unit, log):
@@ -124,7 +155,7 @@ def run_timed(run_step, state, batch, args, units_per_iter, unit, log):
         # round-3 lane budget; tools/hw_sweep.py runs this lane first).
         t0 = time.perf_counter()
         state, _ = run_step(state, batch)
-        jax.block_until_ready(state)
+        _force_sync(state)  # real first-step time, not dispatch (axon trap)
         secs = time.perf_counter() - t0
         log(f"compile-only: first step (compile included) {secs:.1f}s",
             file=sys.stderr)
@@ -133,6 +164,16 @@ def run_timed(run_step, state, batch, args, units_per_iter, unit, log):
     for _ in range(args.num_warmup_batches):
         state, _ = run_step(state, batch)
     jax.block_until_ready(state)
+    # AXON SYNC TRAP (PERF.md round 5): on the tunneled backend,
+    # block_until_ready does NOT wait for device execution until the
+    # process has performed one device->host transfer — before that,
+    # "timed" windows measure async dispatch only (~19x too fast for
+    # the ResNet lane; every pre-round-5 absolute number carried this).
+    # One scalar pull here flips the process into real-synchronization
+    # semantics: chained dispatch still pipelines (measured: marginal
+    # per-step time matches profiler device time), and each window's
+    # block_until_ready below then observes true completion.
+    _force_sync(state)
 
     rates = []
     for x in range(args.num_iters):

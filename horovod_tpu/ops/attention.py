@@ -136,13 +136,23 @@ def _default_blocks(seq_q: int, seq_k: int):
             _pick_block(512 if seq_k <= 2048 else 256, seq_k))
 
 
+# Import-time default for the backward implementation ("scan" |
+# "pallas" | "" = auto-by-length). Read ONCE so the selection is part
+# of every trace's static key via the bwd_impl argument below —
+# flipping the env mid-process cannot silently desync from cached
+# traces; per-call control is the explicit bwd_impl= argument.
+_FLASH_BWD_ENV_DEFAULT = __import__("os").environ.get("HVD_FLASH_BWD", "")
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "bwd_impl"))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    bwd_impl: Optional[str] = None):
     """Pallas flash attention. Shapes [B, L, H, D] -> [B, L, H, D].
 
     Sequence lengths must be multiples of the block sizes (pad upstream).
@@ -151,11 +161,11 @@ def flash_attention(q, k, v, causal: bool = False,
     ``interpret`` defaults to True off-TPU so the same kernel is testable
     on the CPU mesh.
 
-    Differentiable: the backward is the standard flash recurrence
-    (recompute scores blockwise against the saved output, never
-    materializing the [Lq, Lk] matrix) implemented with ``lax.scan`` over
-    key blocks — O(Lq x block_k) live memory, XLA-fused; gradient
-    exactness vs the dense reference is pinned in
+    Differentiable: the backward is two Pallas kernels (the
+    FlashAttention-2 dQ / dK+dV split), recomputing scores blockwise
+    against the forward's persisted logsumexp with O(block) VMEM per
+    program — the [Lq, Lk] matrix is never materialized in either pass;
+    gradient exactness vs the dense reference is pinned in
     tests/test_parallel.py::TestFlashAttention."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -166,12 +176,17 @@ def flash_attention(q, k, v, causal: bool = False,
         block_q = dq
     if block_k is None:
         block_k = dk
+    if bwd_impl is None:
+        bwd_impl = _FLASH_BWD_ENV_DEFAULT or "auto"
+    if bwd_impl not in ("auto", "scan", "pallas"):
+        raise ValueError(f"bwd_impl must be auto|scan|pallas, "
+                         f"got {bwd_impl!r}")
     return _flash(q, k, v, causal, float(scale), block_q, block_k,
-                  interpret)
+                  interpret, bwd_impl)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bwd_impl):
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                             interpret)
     return out
@@ -234,33 +249,124 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             lse.reshape(B, H, Lq))
 
 
-def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret,
+                   bwd_impl):
     o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                             interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, do):
-    """Flash backward, blockwise over key blocks (lax.scan), fp32 math.
-
-    Standard recurrences against the forward kernel's persisted
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                         dq_ref, dq_scr, *, causal: bool, scale: float,
+                         block_q: int, block_k: int, n_kblocks: int):
+    """dQ: grid (batch*head, q-block, K-BLOCK stream). Standard
+    FlashAttention-2 recurrence against the forward's persisted
     logsumexp:
-        D_i  = rowsum(dO_i * O_i)
-        P_ij = exp(S_ij - lse_i)
-        dV_j = sum_i P_ij^T dO_i
-        dS_ij = P_ij * (dO_i V_j^T - D_i)
-        dQ_i = sum_j dS_ij K_j * scale;  dK_j = sum_i dS_ij^T Q_i * scale
-    Peak live state is O(Lq x block_k) per (batch, head) — the score
-    matrix is never materialized. For causal rectangular Lq < Lk, key
-    blocks past the last visible key are fully masked and are skipped
-    statically (the forward kernel's early-exit mirror)."""
+        P_ij = exp(S_ij - lse_i);  dS_ij = P_ij * (dO_i V_j^T - D_i)
+        dQ_i = sum_j dS_ij K_j * scale
+    The k axis rides the grid (sequential) with the dQ accumulator in
+    VMEM scratch — same O(block) VMEM shape as the forward kernel."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do_blk = do_ref[...].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[...])                    # [bq, bk]
+        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - d_ref[...])
+        dq_scr[...] += jnp.dot(ds, k_blk,
+                               preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          n_qblocks: int):
+    """dK/dV: grid (batch*head, k-block, Q-BLOCK stream), transposing
+    the dQ kernel's roles:
+        dV_j = sum_i P_ij^T dO_i;  dK_j = sum_i dS_ij^T Q_i * scale"""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do_blk = do_ref[...].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[...])                    # [bq, bk]
+        dv_scr[...] += jnp.dot(p.T, do_blk,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - d_ref[...])
+        dk_scr[...] += jnp.dot(ds.T, q,
+                               preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        # Q-blocks fully ABOVE the diagonal (every q_pos < every k_pos)
+        # contribute nothing to this k-block.
+        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == n_qblocks - 1)
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_scan(causal, scale, block_q, block_k, interpret, res, do):
+    """XLA lax.scan backward (the pre-round-5 implementation, kept as a
+    selectable path): one batched einsum pass per key block computing
+    dq/dk/dv together. At seq <= ~4096 its [B, H, Lq, block_k] einsum
+    slabs are MXU-friendly batched matmuls and it MEASURES faster than
+    the kernel split (10.45M vs 9.68M tok/s at seq 2048, PERF.md r5);
+    at long seq those slabs become multi-hundred-MB HBM round-trips
+    per block step. Selected by ``HVD_FLASH_BWD=scan`` or
+    automatically at short key lengths (see _flash_bwd_vjp)."""
     q, k, v, o, lse = res
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     bk = min(block_k, Lk)
     nkb = Lk // bk
-    # Causal early-exit: keys at positions >= Lq are invisible to every
-    # query row (positions both start at 0).
     nkb_live = min(nkb, -(-Lq // bk)) if causal else nkb
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -287,8 +393,6 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, do):
 
     dq, (dks, dvs) = jax.lax.scan(
         bwd_step, jnp.zeros(q.shape, jnp.float32), jnp.arange(nkb_live))
-    # [nkb_live, B, bk, H, D] -> [B, nkb_live*bk, H, D] (+ zero tail for
-    # causally-skipped key blocks).
     dk = dks.transpose(1, 0, 2, 3, 4).reshape(B, nkb_live * bk, H, D)
     dv = dvs.transpose(1, 0, 2, 3, 4).reshape(B, nkb_live * bk, H, D)
     if nkb_live < nkb:
@@ -296,6 +400,109 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, do):
         dk = jnp.pad(dk, pad)
         dv = jnp.pad(dv, pad)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
+    """Flash backward as two Pallas kernels (FlashAttention-2 split):
+    a dQ kernel streaming k-blocks and a dK/dV kernel streaming
+    q-blocks, both against the forward's persisted logsumexp and the
+    precomputed row dot D_i = rowsum(dO_i * O_i). The score matrix is
+    never materialized; VMEM is O(block) per program, so the backward
+    scales to the same contexts the streamed forward unlocked (the
+    prior lax.scan backward materialized [B, H, Lq, block_k] slabs in
+    HBM per step — 2 GB at seq 16k — and serialized the k-block walk).
+    For causal rectangular Lq != Lk, blocks entirely on the masked side
+    of the diagonal skip their compute in both kernels."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, o, lse = res
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    bq = min(block_q, Lq)
+    bk = min(block_k, Lk)
+    assert Lq % bq == 0 and Lk % bk == 0, (Lq, Lk, bq, bk)
+    nqb, nkb = Lq // bq, Lk // bk
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    dor = do.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    # lse arrives [B, H, Lq]; D_i rowsum in fp32. Both as [bh, Lq, 1]
+    # columns — the statistics' native kernel layout.
+    lser = lse.reshape(B * H, Lq, 1)
+    d_row = jnp.sum(dor.astype(jnp.float32)
+                    * o.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+                    .astype(jnp.float32), axis=-1, keepdims=True)
+
+    qspec = pl.BlockSpec((None, bq, D), lambda bh, i, j: (bh, i, 0))
+    kspec = pl.BlockSpec((None, bk, D), lambda bh, i, j: (bh, j, 0))
+    col_q = pl.BlockSpec((None, bq, 1), lambda bh, i, j: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk, n_kblocks=nkb),
+        grid=(B * H, nqb, nkb),
+        in_specs=[qspec, kspec, kspec, qspec, col_q, col_q],
+        out_specs=pl.BlockSpec((None, bq, D), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, d_row)
+
+    # dK/dV grid transposes the stream: (bh, k-block, q-stream).
+    qspec_t = pl.BlockSpec((None, bq, D), lambda bh, j, i: (bh, i, 0))
+    kspec_t = pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0))
+    col_q_t = pl.BlockSpec((None, bq, 1), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          scale=scale, block_q=bq, block_k=bk,
+                          n_qblocks=nqb),
+        grid=(B * H, nkb, nqb),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, col_q_t, col_q_t],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Lk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, d_row)
+
+    def unflat(t, L):
+        return t.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+    return unflat(dq, Lq), unflat(dk, Lk), unflat(dv, Lk)
+
+
+# Key length at/above which the kernel backward takes over from the
+# scan backward by default (measured crossover, PERF.md round 5).
+_FLASH_BWD_PALLAS_MIN_LK = 8192
+
+
+def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, bwd_impl,
+                   res, do):
+    """Backward dispatch, measured not assumed (PERF.md round 5): the
+    scan backward's batched einsums win at short key lengths; the
+    O(block)-VMEM kernel split is required at long ones (the scan's
+    per-block [B, H, Lq, block_k] slabs scale with Lq). ``bwd_impl``
+    arrives as a static ("auto"|"scan"|"pallas") from flash_attention —
+    part of the trace key, so selection can never desync from a cached
+    trace."""
+    impl = bwd_impl
+    if impl == "auto":
+        impl = ("pallas" if res[1].shape[1] >= _FLASH_BWD_PALLAS_MIN_LK
+                else "scan")
+    fn = _flash_bwd_pallas if impl == "pallas" else _flash_bwd_scan
+    return fn(causal, scale, block_q, block_k, interpret, res, do)
 
 
 _flash.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

@@ -1,0 +1,30 @@
+"""Device-synchronization helper for timing harnesses.
+
+On the tunneled axon backend, ``jax.block_until_ready`` does NOT wait
+for device execution until the process has performed one device->host
+transfer; before that first pull, "timed" regions measure async
+dispatch only (~19x fast on the ResNet lane — PERF.md round-5 sync
+trap). Every timing harness must call :func:`force_device_sync` after
+warm-up and before its timed region; afterwards ``block_until_ready``
+observes true completion and chained dispatch still pipelines.
+"""
+
+from __future__ import annotations
+
+
+def force_device_sync(tree) -> float:
+    """Pull one scalar off-device from any array leaf of ``tree``.
+
+    Accepts a pytree (train state, grad tuple, single array). Returns
+    the pulled scalar (summed in f32) so callers can also use it as a
+    cheap checksum. No-op returning 0.0 when the tree has no array
+    leaves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return 0.0
+    return float(jnp.sum(leaves[0].astype(jnp.float32)))

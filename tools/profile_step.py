@@ -158,6 +158,13 @@ def main():
     for _ in range(3):  # compile + warm
         state, _ = run(state, batch)
     jax.block_until_ready(state)
+    # Force real sync semantics (axon trap, PERF.md round 5): without a
+    # d2h pull, the wall-time line below would measure dispatch only —
+    # that was the source of the "~19x profiler dilation" myth (the
+    # profiler shares were always real; the wall number was fake).
+    from horovod_tpu.utils.devsync import force_device_sync
+
+    force_device_sync(state)
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

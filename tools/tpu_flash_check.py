@@ -27,7 +27,12 @@ def _time_fwd_bwd(fn, q, k, v, iters=20):
         lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
         argnums=(0, 1, 2)))
     out = lossgrad(q, k, v)  # compile + warm
-    jax.block_until_ready(out)
+    # Explicit d2h pull: real sync semantics on the axon tunnel (this
+    # harness was previously honest only because main()'s numerics
+    # canary happened to pull first — see PERF.md round-5 sync trap).
+    from horovod_tpu.utils.devsync import force_device_sync
+
+    force_device_sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = lossgrad(q, k, v)

@@ -56,19 +56,18 @@ probe_ok() {
 # (instead of bare --resume) keeps the watcher from re-paying lanes
 # settled as deterministic, and bounds the post-midnight
 # already_done_today reset to these lanes.
-# inception A/B re-pay: the first capture showed fused-BN 3x FASTER on
-# inception (17.6k vs 5.7k img/s) with the fused lane on the WORSE
-# probe stamp — opposite sign to ResNet; a back-to-back pair either
-# confirms the first model-dependent fused-BN win or exposes a
-# congestion artifact.
-PENDING_LANES=transformer_lm,transformer_lm_flash,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash_fused,resnet50,inception_v3,inception_v3_fused_bn
-# Only records at/past this cutoff settle the re-price lanes — most of
-# them recorded successfully EARLIER today under the old flash tiling
-# (or, for inception, in a suspect non-adjacent A/B). Bumped past the
-# 09:15-09:30 pass: those records overlapped a full-suite pytest run on
-# the host, which poisons lane timing (see the resnet50 17.9k record at
-# a healthy 6,249 probe — host contention the chip probe cannot see).
-CUTOFF=2026-08-01T09:45
+# HONEST RE-MEASUREMENT queue (round 5, ~11:30 UTC): bench.py now
+# forces real device synchronization before its timed windows — on the
+# axon tunnel, block_until_ready was a no-op until the process's first
+# device->host pull, so EVERY absolute number recorded before this
+# cutoff timed async dispatch (~19x fast on the ResNet lane; PERF.md
+# "round-5 sync trap"). Every headline lane re-records under the fixed
+# protocol. Fused-BN lanes are excluded: their adjudication rests on
+# profiler device time, which was always real.
+PENDING_LANES=resnet50,resnet50_bs128,resnet50_bs256,resnet101,vgg16,inception_v3,vit_b16,transformer_lm,transformer_lm_flash,transformer_lm_fused_ce,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash_fused,transformer_lm_v64k_fused_ce
+# Only records at/past this cutoff count: everything earlier is
+# dispatch-timed.
+CUTOFF=2026-08-01T11:30
 
 cache_done() {
   grep -q "cache_probe backend=default: run1 rc=0.*run2 rc=0" "$LOG"
