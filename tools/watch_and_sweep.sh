@@ -66,8 +66,9 @@ probe_ok() {
 # profiler device time, which was always real.
 PENDING_LANES=resnet50,resnet50_bs128,resnet50_bs256,resnet101,vgg16,inception_v3,vit_b16,transformer_lm,transformer_lm_flash,transformer_lm_fused_ce,flash_check,transformer_lm_seq4096_flash,transformer_lm_seq8192_flash,transformer_lm_seq8192_flash_fused,transformer_lm_seq16384_flash_fused,transformer_lm_v64k_fused_ce
 # Only records at/past this cutoff count: everything earlier is
-# dispatch-timed.
-CUTOFF=2026-08-01T11:30
+# dispatch-timed. (The sync fix landed at ~10:55; the honest pass ran
+# 11:01-11:45.)
+CUTOFF=2026-08-01T11:00
 
 cache_done() {
   grep -q "cache_probe backend=default: run1 rc=0.*run2 rc=0" "$LOG"
