@@ -76,11 +76,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
-        k_blk = k_ref[...].astype(jnp.float32)      # [block_k, d]
-        v_blk = v_ref[...].astype(jnp.float32)
+        # Matmuls run in the INPUT dtype with f32 accumulation
+        # (preferred_element_type): bf16 inputs hit the MXU's native
+        # bf16xbf16->f32 path (an f32xf32 matmul costs ~3 passes on
+        # TPU); f32 test inputs keep the all-f32 exactness the CI pins.
+        # All softmax statistics stay f32 regardless.
+        q = q_ref[...]                              # [block_q, d]
+        k_blk = k_ref[...]                          # [block_k, d]
+        v_blk = v_ref[...]
         s = jnp.dot(q, k_blk.T,
-                    preferred_element_type=jnp.float32)  # [block_q, block_k]
+                    preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -95,7 +100,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1,
                                                   keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
 
     if causal:
         # A k-block strictly past this q-block's last row is fully
@@ -276,10 +282,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[...].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do_blk = do_ref[...].astype(jnp.float32)
+        # Input-dtype matmuls, f32 accumulation (see _flash_kernel).
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        do_blk = do_ref[...]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -290,7 +297,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         p = jnp.exp(s - lse_ref[...])                    # [bq, bk]
         dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - d_ref[...])
-        dq_scr[...] += jnp.dot(ds, k_blk,
+        dq_scr[...] += jnp.dot(ds.astype(k_blk.dtype), k_blk,
                                preferred_element_type=jnp.float32) * scale
 
     if causal:
@@ -321,10 +328,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
     def _compute():
-        q = q_ref[...].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do_blk = do_ref[...].astype(jnp.float32)
+        # Input-dtype matmuls, f32 accumulation (see _flash_kernel).
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        do_blk = do_ref[...]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -333,11 +341,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_ref[...])                    # [bq, bk]
-        dv_scr[...] += jnp.dot(p.T, do_blk,
+        dv_scr[...] += jnp.dot(p.T.astype(do_blk.dtype), do_blk,
                                preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - d_ref[...])
-        dk_scr[...] += jnp.dot(ds.T, q,
+        dk_scr[...] += jnp.dot(ds.T.astype(q.dtype), q,
                                preferred_element_type=jnp.float32) * scale
 
     if causal:
@@ -368,27 +376,32 @@ def _flash_bwd_scan(causal, scale, block_q, block_k, interpret, res, do):
     bk = min(block_k, Lk)
     nkb = Lk // bk
     nkb_live = min(nkb, -(-Lq // bk)) if causal else nkb
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    d_row = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [B, Lq, H]
-    d_row = d_row.transpose(0, 2, 1)                        # [B, H, Lq]
+    # Einsums run in the input dtype with f32 accumulation
+    # (preferred_element_type) — bf16 inputs keep the MXU's native
+    # path; f32 test inputs keep CI exactness. Softmax stats stay f32.
+    f32 = jnp.float32
+    d_row = jnp.sum(do.astype(f32) * o.astype(f32), axis=-1)  # [B, Lq, H]
+    d_row = d_row.transpose(0, 2, 1)                           # [B, H, Lq]
     q_pos = jnp.arange(Lq)[:, None]
 
     def bwd_step(dq, jb):
-        kb = jax.lax.dynamic_slice_in_dim(kf, jb * bk, bk, 1)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
+        kb = jax.lax.dynamic_slice_in_dim(k, jb * bk, bk, 1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=f32) * scale
         if causal:
             k_pos = jb * bk + jnp.arange(bk)[None, :]
             s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
-        vb = jax.lax.dynamic_slice_in_dim(vf, jb * bk, bk, 1)
+        vb = jax.lax.dynamic_slice_in_dim(v, jb * bk, bk, 1)
         p = jnp.exp(s - lse[..., None])                     # [B,H,Lq,bk]
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vb)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, vb,
+                        preferred_element_type=f32)
         ds = p * (dp - d_row[..., None])
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kb) * scale
-        dkb = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-        dvb = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds.astype(k.dtype), kb,
+                             preferred_element_type=f32) * scale
+        dkb = jnp.einsum("bhqk,bqhd->bkhd", ds.astype(q.dtype), q,
+                         preferred_element_type=f32) * scale
+        dvb = jnp.einsum("bhqk,bqhd->bkhd", p.astype(do.dtype), do,
+                         preferred_element_type=f32)
         return dq, (dkb, dvb)
 
     dq, (dks, dvs) = jax.lax.scan(

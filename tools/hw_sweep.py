@@ -31,6 +31,11 @@ LOG = os.path.join(REPO, "PERF_RUNS.tsv")
 LANES = [
     ("resnet50", ["bench.py"]),
     ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
+    # Honest re-adjudication lanes (round 5): both options were priced
+    # under dispatch timing ("within noise" / never measured) — the
+    # fixed protocol decides them on device time.
+    ("resnet50_bf16_momentum", ["bench.py", "--bf16-momentum"]),
+    ("resnet50_zero", ["bench.py", "--zero"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.
