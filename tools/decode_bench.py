@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""KV-cache decode throughput (tokens/sec/chip) for the packaged LM.
+
+The reference predates LM serving, so this lane is beyond-parity
+evidence for the inference story (docs/inference.md): greedy decode of
+the GPT-2-small-class model (12L/768d, vocab 32k) with the static-shape
+KV cache — prefill + the whole generation loop compile as ONE program
+(models/parallel_lm.py::lm_decode). Prints one JSON line in the bench
+record shape; obeys the axon sync trap (utils/devsync.py).
+"""
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.utils.devsync import force_device_sync
+
+    if args.d_model % args.heads:
+        ap.error(f"--d-model {args.d_model} must be divisible by "
+                 f"--heads {args.heads}")
+    head_dim = args.d_model // args.heads
+    lmax = args.prompt_len + args.steps
+    rng = jax.random.PRNGKey(0)
+    params = plm.init_lm_params(rng, args.vocab, lmax, args.layers,
+                                args.heads, head_dim, 4 * args.d_model)
+    prompt = jax.random.randint(jax.random.fold_in(rng, 1),
+                                (args.batch, args.prompt_len), 0,
+                                args.vocab)
+
+    fn = jax.jit(lambda p, t: plm.lm_decode(p, t, steps=args.steps))
+    t0 = time.perf_counter()
+    out = fn(params, prompt)
+    force_device_sync(out)  # compile+warm AND flip to real sync semantics
+    compile_s = time.perf_counter() - t0
+
+    # run_timed's window discipline: N windows, mean +- 1.96*std, loud
+    # when the CI says the chip was contended (bench.py's protocol —
+    # after the sync flip above, block_until_ready per window is a real
+    # sync with no extra round-trip).
+    rates = []
+    for x in range(args.iters):
+        t0 = time.perf_counter()
+        out = fn(params, prompt)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rates.append(args.batch * args.steps / dt)
+        print(f"Iter #{x}: {rates[-1]:.1f} decode tok/s",
+              file=sys.stderr, flush=True)
+    mean = sum(rates) / len(rates)
+    var = sum((r - mean) ** 2 for r in rates) / len(rates)
+    conf = 1.96 * var ** 0.5
+    if conf > 0.1 * mean:
+        print(f"WARNING: high variance (CI {conf:.0f} vs mean {mean:.0f})"
+              " — contended chip; rerun for a representative number",
+              file=sys.stderr, flush=True)
+    ms_gen = args.batch * args.steps / mean * 1e3
+    print(f"decode: {mean:.1f} +-{conf:.1f} tok/s (batch {args.batch}, "
+          f"{args.steps} steps @ {ms_gen:.1f} ms/gen, "
+          f"compile+prefill first call {compile_s:.1f}s)",
+          file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
+        "value": round(mean, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": None, "peak": round(max(rates), 1),
+        "ms_per_generation": round(ms_gen, 1),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,9 @@ LANES = [
     # fixed protocol decides them on device time.
     ("resnet50_bf16_momentum", ["bench.py", "--bf16-momentum"]),
     ("resnet50_zero", ["bench.py", "--zero"]),
+    # Inference lane (beyond the reference, docs/inference.md): greedy
+    # KV-cache decode throughput of the packaged LM.
+    ("transformer_lm_decode", ["tools/decode_bench.py"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.
