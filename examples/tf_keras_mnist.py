@@ -2,8 +2,11 @@
 """tf.keras data-parallel MNIST (reference examples/keras_mnist.py /
 tensorflow_mnist.py) over the native TCP-ring core: per-rank data shard,
 ``horovod_tpu.tf.keras.DistributedOptimizer`` averaging gradients in
-``apply_gradients``, broadcast + metric-average callbacks, lr scaled by
-world size.
+``apply_gradients``, the FULL reference callback stack — broadcast,
+metric averaging, gradual LR warmup, staircase LR schedule (reference
+examples/keras_imagenet_resnet50.py:132-153) — and checkpoint/resume
+through ``load_model`` with the optimizer re-wrapped
+(keras_imagenet_resnet50.py:97-105).
 
 Run:  python -m horovod_tpu.run -np 2 python examples/tf_keras_mnist.py
 """
@@ -11,6 +14,7 @@ Run:  python -m horovod_tpu.run -np 2 python examples/tf_keras_mnist.py
 import argparse
 import os
 import sys
+import tempfile
 
 import numpy as np
 
@@ -21,7 +25,10 @@ import horovod_tpu.tf as hvd  # noqa: E402
 from horovod_tpu.tf.keras import (  # noqa: E402
     BroadcastGlobalVariablesCallback,
     DistributedOptimizer,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
     MetricAverageCallback,
+    load_model,
 )
 
 
@@ -81,10 +88,49 @@ def main():
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
 
-    model.fit(x_train, y_train, batch_size=args.batch_size,
-              epochs=args.epochs, verbose=0, shuffle=False,
-              callbacks=[BroadcastGlobalVariablesCallback(0),
-                         MetricAverageCallback()])
+    # The reference's imagenet callback stack at MNIST scale
+    # (keras_imagenet_resnet50.py:132-153): warmup ramps the first
+    # epoch from lr/size to the size-scaled lr, then the staircase
+    # schedule decays it. momentum_correction=False: keras-3 SGD stores
+    # momentum as a compile-time constant (see tf/keras.py). The
+    # schedule callbacks capture initial_lr at each fit()'s train
+    # begin, so a resume must hand them FRESH instances with the lr
+    # reset to the base rate — reusing instances would rebase the
+    # multipliers on the already-decayed lr and double-apply the decay.
+    base_lr = args.lr * hvd.size()
+
+    def make_callbacks():
+        return [
+            BroadcastGlobalVariablesCallback(0),
+            MetricAverageCallback(),
+            LearningRateWarmupCallback(warmup_epochs=1,
+                                       momentum_correction=False),
+            LearningRateScheduleCallback(1.0, start_epoch=1, end_epoch=2,
+                                         momentum_correction=False),
+            LearningRateScheduleCallback(0.1, start_epoch=2,
+                                         momentum_correction=False),
+        ]
+
+    half = args.epochs // 2
+    if half > 0:
+        model.fit(x_train, y_train, batch_size=args.batch_size,
+                  epochs=half, verbose=0, shuffle=False,
+                  callbacks=make_callbacks())
+
+    # Each rank checkpoints and resumes through hvd.load_model — slot
+    # state restored, optimizer re-wrapped (the reference resumed the
+    # same way, :97-105); the FRESH broadcast callback below re-syncs
+    # ranks at resume, and the lr resets to base so the absolute-epoch
+    # schedule reapplies from a clean slate.
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "ckpt.keras")
+        model.save(path)
+        model = load_model(path)
+    model.optimizer.learning_rate.assign(base_lr)
+    if args.epochs > half:
+        model.fit(x_train, y_train, batch_size=args.batch_size,
+                  epochs=args.epochs, initial_epoch=half, verbose=0,
+                  shuffle=False, callbacks=make_callbacks())
 
     loss, acc = model.evaluate(x_test, y_test, verbose=0)
     loss = float(hvd.allreduce(tf.constant(loss), name="eval_loss"))
