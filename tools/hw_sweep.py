@@ -36,6 +36,11 @@ LANES = [
     # fixed protocol decides them on device time.
     ("resnet50_bf16_momentum", ["bench.py", "--bf16-momentum"]),
     ("resnet50_zero", ["bench.py", "--zero"]),
+    # bf16-momentum's honest regime: VGG's 138M params make the
+    # optimizer update ~23% of device time (PERF.md VGG profile), so
+    # halving momentum traffic shows where ResNet's ~4% share could not.
+    ("vgg16_bf16_momentum", ["bench.py", "--model", "vgg16",
+                             "--bf16-momentum"]),
     # Inference lane (beyond the reference, docs/inference.md): greedy
     # KV-cache decode throughput of the packaged LM.
     ("transformer_lm_decode", ["tools/decode_bench.py"]),
