@@ -108,3 +108,48 @@ def test_timeline_disabled_no_leak(hvd):
         hvd.allreduce(np.ones(2))
     assert len(tl._tensor_tracks) == before_tracks
     assert tl._queue.empty()
+
+
+def test_flash_default_blocks_rectangular(hvd):
+    """Review r5: the default block policy must derive the q-block from
+    the QUERY length and the k-block from the KEY length — deriving
+    both from Lq picked block_k=512 for Lq=512/Lk=768 (512 does not
+    divide 768) and asserted inside _flash_forward."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.attention import (dot_product_attention,
+                                           flash_attention)
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 512, 1, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 768, 1, 8), jnp.float32)
+    out = flash_attention(q, k, k, causal=True)  # default blocks
+    ref = dot_product_attention(q, k, k, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_tf_keras_rewrap_honors_new_settings():
+    """Review r5: DistributedOptimizer on an already-wrapped optimizer
+    must be a no-op ONLY when the settings match — silently keeping the
+    old compression/average would drop the caller's explicit choice —
+    and must swap from the original base (never stack two reduces)."""
+    tf = pytest.importorskip("tensorflow")
+
+    import horovod_tpu.tf as hvdtf
+    from horovod_tpu.tf import Compression
+    from horovod_tpu.tf.keras import DistributedOptimizer
+
+    hvdtf.init()
+    try:
+        opt = DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        cls1 = opt.__class__
+        assert DistributedOptimizer(opt).__class__ is cls1  # same: no-op
+        re = DistributedOptimizer(opt, compression=Compression.fp16)
+        assert re.__class__ is not cls1
+        assert re.__class__._hvd_wrap_args[0] is Compression.fp16
+        # Swapped, not stacked: exactly one wrapper layer above SGD.
+        assert re.__class__.__mro__[1].__name__ == "SGD"
+        assert len(re.__class__.__mro__) == len(cls1.__mro__)
+    finally:
+        hvdtf.shutdown()
