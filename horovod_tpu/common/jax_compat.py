@@ -1,0 +1,41 @@
+"""Minimal jax version-compat layer.
+
+The framework targets current jax (public ``jax.shard_map``,
+``lax.axis_size``), but must still import and run its core SPMD path on
+older runtimes (e.g. CI/sandbox images pinned to the 0.4.x era, where
+those names live elsewhere or do not exist). Policy: one explicit
+``install()`` at package import, polyfilling ONLY missing names with
+semantically identical implementations — never overriding anything the
+runtime already provides.
+
+Polyfills:
+
+* ``jax.lax.axis_size(name)`` — the named-axis size inside an SPMD
+  region. Older jax spells this ``lax.psum(1, name)``, which constant-
+  folds to a static Python int at trace time (the long-standing idiom
+  the newer helper replaced), so the polyfill is exact — including for
+  shape arithmetic.
+
+The ``jax.shard_map`` vs ``jax.experimental.shard_map`` (check_vma vs
+check_rep) split is resolved in :mod:`horovod_tpu.parallel.spmd`, next
+to its single call site.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    """Idempotently install the polyfills for names this jax lacks."""
+    import jax
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            """Polyfill of lax.axis_size: psum of the constant 1 over
+            the axis constant-folds to the static axis size."""
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+        # lax re-exports live under jax.lax via the same module object;
+        # nothing else to patch.
+        assert hasattr(jax.lax, "axis_size")

@@ -14,11 +14,17 @@ from horovod_tpu.data.sharding import (
     iterate_sharded,
     shard_indices,
 )
-from horovod_tpu.data.prefetch import prefetch_to_device
+from horovod_tpu.data.prefetch import (
+    prefetch_to_device,
+    prefetch_windows,
+    window_batches,
+)
 
 __all__ = [
     "DistributedSampler",
     "shard_indices",
     "iterate_sharded",
     "prefetch_to_device",
+    "prefetch_windows",
+    "window_batches",
 ]

@@ -10,9 +10,11 @@ no threads (the flax-examples prefetch idiom, generalized to shardings).
 from __future__ import annotations
 
 import collections
+import itertools
 from typing import Iterable, Iterator, Optional
 
 import jax
+import numpy as np
 
 
 def prefetch_to_device(
@@ -51,3 +53,55 @@ def prefetch_to_device(
             queue.append(put(next(it)))
         except StopIteration:
             pass
+
+
+def window_batches(
+    iterator: Iterable,
+    steps_per_dispatch: int,
+) -> Iterator:
+    """Group consecutive batches into stacked K-step windows.
+
+    Host-side ``np.stack`` per leaf: every leaf of each yielded pytree
+    carries a leading window axis of length ``steps_per_dispatch`` (the
+    trailing window may be shorter when the iterator does not divide
+    evenly — no batch is dropped). Order is preserved: window ``i``
+    holds batches ``[i*K, (i+1)*K)`` in iteration order.
+    """
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
+    it = iter(iterator)
+    while True:
+        group = list(itertools.islice(it, steps_per_dispatch))
+        if not group:
+            return
+        yield jax.tree_util.tree_map(
+            lambda *leaves: np.stack(leaves), *group)
+
+
+def prefetch_windows(
+    iterator: Iterable,
+    steps_per_dispatch: int,
+    size: int = 2,
+    sharding: Optional[object] = None,
+) -> Iterator:
+    """Double-buffered K-batch device stager for multi-step windows.
+
+    The feeding half of :func:`horovod_tpu.jax.window.run_steps`: K
+    consecutive batches are stacked on the host
+    (:func:`window_batches`) and moved with one asynchronous
+    ``jax.device_put`` per window — ``sharding`` should describe the
+    STACKED layout (e.g. ``NamedSharding(mesh, P(None, "hvd"))``: window
+    axis replicated, batch axis scattered). ``size=2`` double-buffers at
+    window granularity: window N computes while window N+1's
+    host->device copy rides the DMA engines.
+
+    ``steps_per_dispatch == 1`` is the identity path — exactly
+    :func:`prefetch_to_device`, no window axis added.
+    """
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
+    source = (iterator if steps_per_dispatch == 1
+              else window_batches(iterator, steps_per_dispatch))
+    yield from prefetch_to_device(source, size=size, sharding=sharding)

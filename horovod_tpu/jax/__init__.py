@@ -66,6 +66,8 @@ from horovod_tpu.jax.optimizer import (
 )
 from horovod_tpu.jax import zero
 from horovod_tpu.jax.zero import sharded_distributed_optimizer
+from horovod_tpu.jax import window
+from horovod_tpu.jax.window import run_steps, windowed
 from horovod_tpu.parallel.spmd import spmd, spmd_fn, spmd_run
 
 # TF-parity aliases (reference tensorflow/__init__.py:95-115).
@@ -123,4 +125,7 @@ __all__ = [
     "spmd_run",
     "zero",
     "sharded_distributed_optimizer",
+    "window",
+    "run_steps",
+    "windowed",
 ]

@@ -23,6 +23,7 @@ from horovod_tpu.models.train import (
     cross_entropy_loss,
     make_eval_step,
     make_train_step,
+    make_windowed_train_step,
     state_partition_specs,
 )
 from horovod_tpu.models import parallel_lm
@@ -82,5 +83,6 @@ __all__ = [
     "cross_entropy_loss",
     "make_eval_step",
     "make_train_step",
+    "make_windowed_train_step",
     "state_partition_specs",
 ]

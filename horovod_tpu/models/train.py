@@ -27,7 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from flax.core import FrozenDict
+from flax.core import FrozenDict, freeze
 
 from horovod_tpu.common.state import current_spmd_axis
 from horovod_tpu.jax import mpi_ops
@@ -78,7 +78,11 @@ def create_train_state(
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
-    batch_stats = variables.get("batch_stats", FrozenDict())
+    # Deep-freeze so the state's pytree TYPES are stable against what
+    # the step emits (flax's mutable= collection comes back as a plain
+    # dict on some versions) — lax.scan window loops require the carry
+    # structure to match exactly, not just leaf-wise.
+    batch_stats = freeze(variables.get("batch_stats", FrozenDict()))
     if zero:
         from horovod_tpu.jax.zero import sharded_distributed_optimizer
 
@@ -146,7 +150,9 @@ def make_train_step(model, optimizer: optax.GradientTransformation, average_loss
             rngs={"dropout": rng},
         )
         loss = cross_entropy_loss(outputs, batch["label"])
-        return loss, (mutated.get("batch_stats", FrozenDict()), outputs)
+        # freeze: scan-carry type stability (see create_train_state).
+        return loss, (freeze(mutated.get("batch_stats", FrozenDict())),
+                      outputs)
 
     def train_step(state, batch):
         # Deterministic per-step dropout key, decorrelated across ranks
@@ -167,6 +173,33 @@ def make_train_step(model, optimizer: optax.GradientTransformation, average_loss
         return new_state, {"loss": loss, "accuracy": accuracy}
 
     return train_step
+
+
+def make_windowed_train_step(model, optimizer: optax.GradientTransformation,
+                             steps_per_dispatch: int,
+                             average_loss: bool = True):
+    """Window-loop form of :func:`make_train_step`: K steps compiled
+    into ONE ``lax.scan`` program (:mod:`horovod_tpu.jax.window`), so
+    the host dispatches once per window instead of once per step — the
+    fix for the measured 27-32% host-dispatch gap on short-step models
+    (PERF.md round 5).
+
+    The returned function takes ``(state, stacked_batches)`` where every
+    batch leaf carries a leading window axis of length
+    ``steps_per_dispatch`` (stage them with
+    :func:`horovod_tpu.data.prefetch_windows`), and returns
+    ``(new_state, metric_means)``. ``steps_per_dispatch=1`` degrades to
+    exactly :func:`make_train_step`'s per-step form. For the full
+    stage-and-dispatch loop use ``hvd.run_steps`` directly::
+
+        step = make_train_step(model, optimizer)
+        state, metrics = hvd.run_steps(step, state, batch_iter,
+                                       steps_per_dispatch=30)
+    """
+    from horovod_tpu.jax.window import windowed
+
+    return windowed(make_train_step(model, optimizer, average_loss),
+                    steps_per_dispatch)
 
 
 def state_partition_specs(state: TrainState):

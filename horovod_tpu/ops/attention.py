@@ -121,12 +121,29 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[...] = m_scr[...] + jnp.log(l)
 
 
+# Native TPU sublane tile: the f32 min tile is (8, 128), so blocks
+# below 8 rows are rejected (or pathologically slow) by real Mosaic —
+# interpret-mode CI would accept them and hide the hardware failure.
+_MIN_BLOCK = 8
+
+
 def _pick_block(cap: int, seq_len: int) -> int:
-    """Largest ladder block <= cap that divides ``seq_len``."""
-    for b in (cap, 256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if b <= cap and b <= seq_len and seq_len % b == 0:
+    """Largest ladder block <= cap that divides ``seq_len``, floored at
+    the native 8-sublane tile.
+
+    Lengths with no multiple-of-8 factor (L=100 -> old ladder degraded
+    to 4; L=33 -> 1) are a caller error, not a tiling choice: raise the
+    explicit "pad upstream" contract instead of emitting a sub-tile
+    kernel that only fails once it reaches a chip (ADVICE r5 #1).
+    """
+    for b in (cap, 256, 128, 64, 32, 16, _MIN_BLOCK):
+        if _MIN_BLOCK <= b <= cap and b <= seq_len and seq_len % b == 0:
             return b
-    return 1
+    raise ValueError(
+        f"flash_attention has no legal default block tile for sequence "
+        f"length {seq_len}: no divisor >= the native {_MIN_BLOCK}-sublane "
+        f"TPU tile. Pad the sequence length upstream to a multiple of "
+        f"{_MIN_BLOCK} (ideally 128), or pass explicit block_q/block_k.")
 
 
 def _default_blocks(seq_q: int, seq_k: int):

@@ -28,3 +28,31 @@ def force_device_sync(tree) -> float:
     if not leaves:
         return 0.0
     return float(jnp.sum(leaves[0].astype(jnp.float32)))
+
+
+def window_sync(tree, timeline=None, track: str = "hvd.window",
+                steps=None) -> float:
+    """One REAL device sync at a multi-step window boundary.
+
+    ``block_until_ready`` + the d2h scalar pull of
+    :func:`force_device_sync` (so the sync means what it says on the
+    tunneled backend), with the whole span recorded on the Horovod
+    timeline as ``WINDOW_SYNC`` when one is active — profiles of the
+    window loop (horovod_tpu/jax/window.py) then attribute host time to
+    dispatch vs boundary sync even though K steps share one program.
+    Returns the pulled checksum scalar.
+    """
+    import jax
+
+    tl_on = timeline is not None and getattr(timeline, "enabled", False)
+    if tl_on:
+        from horovod_tpu.utils.timeline import WINDOW_SYNC
+
+        timeline.start(track, WINDOW_SYNC,
+                       args=None if steps is None else {"steps": steps})
+    try:
+        jax.block_until_ready(tree)
+        return force_device_sync(tree)
+    finally:
+        if tl_on:
+            timeline.end(track, WINDOW_SYNC)

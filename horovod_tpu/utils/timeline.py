@@ -43,6 +43,12 @@ ALLTOALL = "ALLTOALL"
 XLA_TRACE = "XLA_TRACE"
 XLA_COMPILE = "XLA_COMPILE"
 XLA_EXECUTE = "XLA_EXECUTE"
+# Multi-step window activities (horovod_tpu/jax/window.py): WINDOW spans
+# the ONE host dispatch of a K-step scanned window; WINDOW_SYNC spans the
+# boundary block_until_ready + d2h pull, so a trace attributes host time
+# to dispatch vs sync even when K steps share one program.
+WINDOW = "WINDOW"
+WINDOW_SYNC = "WINDOW_SYNC"
 
 _NEGOTIATING = "NEGOTIATING"
 _TOP_LEVEL = "TOP_LEVEL"
@@ -222,6 +228,25 @@ class Timeline:
                 "pid": 0,
                 "tid": self._tid(tensor_name),
                 "ts": self._now_us(),
+            }
+        )
+
+    def mark_window(self, index: int, steps: int) -> None:
+        """Instant global marker at a multi-step window boundary
+        (horovod_tpu/jax/window.py): the window-loop analogue of
+        ``mark_cycle_start``, carrying the window index and the number
+        of steps its single dispatch covers."""
+        if not self._enabled:
+            return
+        self._emit(
+            {
+                "name": "WINDOW_START",
+                "ph": "i",
+                "s": "g",
+                "pid": 0,
+                "tid": 0,
+                "ts": self._now_us(),
+                "args": {"window": index, "steps": steps},
             }
         )
 

@@ -30,6 +30,12 @@ LOG = os.path.join(REPO, "PERF_RUNS.tsv")
 
 LANES = [
     ("resnet50", ["bench.py"]),
+    # Window lane (round-6 tentpole, horovod_tpu/jax/window.py): 30
+    # steps per dispatch via lax.scan — prices the host-gap fix right
+    # next to the protocol headline (ResNet-50 device-only ceiling
+    # ~2,580 img/s; the measured --num-batches-per-iter 30 proxy gave
+    # 2,320). Record carries metric ..._win30, vs_baseline null.
+    ("resnet50_win30", ["bench.py", "--steps-per-dispatch", "30"]),
     ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
     # Honest re-adjudication lanes (round 5): both options were priced
     # under dispatch timing ("within noise" / never measured) — the
@@ -124,6 +130,11 @@ LANES = [
     ("inception_v3", ["bench.py", "--model", "inception_v3"], "slow"),
     ("inception_v3_fused_bn", ["bench.py", "--model", "inception_v3",
                                "--fused-bn"], "slow"),
+    # Inception window lane: the model with the LARGEST measured host
+    # gap (32% at 29 ms steps; device-only ceiling ~3,250 img/s) —
+    # after the plain inception lane so the A/B shares chip condition.
+    ("inception_v3_win30", ["bench.py", "--model", "inception_v3",
+                            "--steps-per-dispatch", "30"], "slow"),
 ]
 
 

@@ -63,13 +63,17 @@ def main():
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | peak | probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|")
+    print("| lane | value | unit | window | peak | probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
         probe = rec.get("probe_tflops")
+        # steps-per-dispatch of the record (bench.py --steps-per-dispatch);
+        # pre-window records carry no key and render as the 1-step protocol.
+        window = rec.get("window")
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
+              f"| {window if window is not None else '—'} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")

@@ -93,8 +93,14 @@ def build_step(args):
             "label": jax.random.randint(rng, (bs,), 0, 1000),
         }
 
-    run = hvd.spmd_fn(step_fn, in_specs=(P(), P("hvd")), out_specs=(P(), P()),
-                      donate_argnums=(0,))
+    # Shared window stager: the profile attributes host vs device time
+    # under the SAME dispatch shape bench.py --steps-per-dispatch runs.
+    from horovod_tpu.jax.window import stage_synthetic_window
+
+    step_fn, batch, batch_spec = stage_synthetic_window(
+        step_fn, batch, args.steps_per_dispatch)
+    run = hvd.spmd_fn(step_fn, in_specs=(P(), batch_spec),
+                      out_specs=(P(), P()), donate_argnums=(0,))
     return run, state, batch
 
 
@@ -148,6 +154,10 @@ def main():
     ap.add_argument("--scan-layers", action="store_true")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--steps-per-dispatch", type=int, default=1,
+                    help="K training steps per dispatch (lax.scan "
+                         "window) — profile the window lane's host/"
+                         "device split; --steps counts DISPATCHES")
     ap.add_argument("--trace-dir", default="")
     args = ap.parse_args()
 
@@ -170,8 +180,12 @@ def main():
     for _ in range(args.steps):
         state, _ = run(state, batch)
     jax.block_until_ready(state)
-    clean = (time.perf_counter() - t0) / args.steps
-    print(f"step wall time (no profiler): {clean * 1e3:.3f} ms",
+    clean = ((time.perf_counter() - t0)
+             / (args.steps * args.steps_per_dispatch))
+    print(f"step wall time (no profiler): {clean * 1e3:.3f} ms"
+          + (f" ({args.steps} dispatches x "
+             f"{args.steps_per_dispatch}-step windows)"
+             if args.steps_per_dispatch > 1 else ""),
           file=sys.stderr)
 
     trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="hvd_prof_")
