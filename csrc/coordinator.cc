@@ -232,7 +232,7 @@ Status Coordinator::Init(int rank, int size, int local_rank, int local_size,
     EnableAutotune(log ? log : "");
     // With the sub-rings dialed, hierarchy becomes a categorical
     // dimension of the sweep (reference parameter_manager.h:149-205).
-    autotuner_->SetHierarchyAvailable(transport_.hierarchy_ready());
+    autotuner_.load()->SetHierarchyAvailable(transport_.hierarchy_ready());
   }
 
   initialized_ = true;
@@ -271,21 +271,25 @@ int Coordinator::hierarchical_active() const {
 }
 
 void Coordinator::EnableAutotune(const std::string& log_path) {
-  if (autotuner_ == nullptr) {
-    autotuner_ = new ParameterManager();
-    autotuner_->Initialize(rank_, log_path);
-    autotuner_->SetAutoTuning(true);
+  std::lock_guard<std::mutex> lock(lifecycle_mu_);
+  if (autotuner_.load() == nullptr) {
+    // Fully construct before publishing: the background loop reads the
+    // pointer without lifecycle_mu_.
+    auto* pm = new ParameterManager();
+    pm->Initialize(rank_, log_path);
+    pm->SetAutoTuning(true);
+    autotuner_.store(pm);
   }
 }
 
 void Coordinator::Shutdown() {
+  std::lock_guard<std::mutex> shutdown_lock(lifecycle_mu_);
   if (!initialized_.load()) return;
   shutdown_requested_ = true;
   if (background_.joinable()) background_.join();
   transport_.Close();
   timeline_.Shutdown();
-  delete autotuner_;
-  autotuner_ = nullptr;
+  delete autotuner_.exchange(nullptr);
   initialized_ = false;
   {
     std::lock_guard<std::mutex> lock(results_mu_);
@@ -365,6 +369,9 @@ void Coordinator::BackgroundLoop() {
 }
 
 bool Coordinator::RunLoopOnce() {
+  // One load per cycle: EnableAutotune can publish mid-run from an app
+  // thread, and a consistent view within the cycle is all that matters.
+  ParameterManager* autotuner = autotuner_.load();
   timeline_.MarkCycleStart();
   // 1. Drain the local queue.
   RequestList my_list;
@@ -444,7 +451,7 @@ bool Coordinator::RunLoopOnce() {
     // Reference semantics: shutdown once every rank has voted
     // (operations.cc:2125-2128) so in-flight collectives still finish.
     to_perform.shutdown = shutdown_votes_ == size_;
-    if (autotuner_ != nullptr) {
+    if (autotuner != nullptr) {
       // Piggyback the current tunables so workers adopt rank-0's winners
       // (reference SyncParams, parameter_manager.h:95-96,232). The control
       // round runs at the pace of the slowest rank, so tuning the cycle
@@ -496,7 +503,7 @@ bool Coordinator::RunLoopOnce() {
   // 3. Execute the identical plan in identical order on every rank.
   int64_t cycle_bytes = 0;
   for (const auto& response : to_perform.responses) {
-    if (autotuner_ != nullptr && response.response_type != Response::ERROR) {
+    if (autotuner != nullptr && response.response_type != Response::ERROR) {
       std::lock_guard<std::mutex> lock(table_mu_);
       for (const auto& nm : response.tensor_names) {
         auto it = tensor_table_.find(nm);
@@ -507,7 +514,7 @@ bool Coordinator::RunLoopOnce() {
     }
     PerformOperation(response);
   }
-  if (autotuner_ != nullptr) {
+  if (autotuner != nullptr) {
     double new_cycle_ms;
     int64_t new_threshold;
     int new_hier;
@@ -516,7 +523,7 @@ bool Coordinator::RunLoopOnce() {
     // a phantom hierarchical mode would poison the surrogate and the
     // converged log line.
     int cur_hier = hierarchical_active();
-    if (autotuner_->Update(cycle_bytes, cycle_time_ms_.load(),
+    if (autotuner->Update(cycle_bytes, cycle_time_ms_.load(),
                            fusion_threshold_.load(), cur_hier,
                            &new_cycle_ms, &new_threshold, &new_hier)) {
       cycle_time_ms_ = new_cycle_ms;

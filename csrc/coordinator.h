@@ -133,10 +133,16 @@ class Coordinator {
   const char* AllgatherActivity() const;
 
   int rank_ = 0, size_ = 1, local_rank_ = 0, local_size_ = 1;
-  bool hier_allreduce_ = false;   // HOROVOD_HIERARCHICAL_ALLREDUCE
-  bool hier_allgather_ = false;   // HOROVOD_HIERARCHICAL_ALLGATHER
+  // Written by the background thread (worker ranks adopting rank-0's
+  // autotuned winners, RunLoopOnce) while app threads read them through
+  // hierarchical_active(): atomics, or TSAN rightly objects.
+  std::atomic<bool> hier_allreduce_{false};  // HOROVOD_HIERARCHICAL_ALLREDUCE
+  std::atomic<bool> hier_allgather_{false};  // HOROVOD_HIERARCHICAL_ALLGATHER
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
+  // Serializes Shutdown against concurrent Shutdown/EnableAutotune (both
+  // reachable from arbitrary app threads via the C API).
+  std::mutex lifecycle_mu_;
   Transport transport_;
   std::thread background_;
 
@@ -167,7 +173,9 @@ class Coordinator {
   std::unordered_map<int, std::vector<uint8_t>> results_;  // handle -> bytes
 
   NativeTimeline timeline_;
-  ParameterManager* autotuner_ = nullptr;  // owned; deleted in Shutdown
+  // Owned; deleted in Shutdown. Atomic: installed at runtime by
+  // EnableAutotune (app thread) while the background loop checks it.
+  std::atomic<ParameterManager*> autotuner_{nullptr};
 };
 
 Coordinator* GlobalCoordinator();

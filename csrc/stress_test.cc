@@ -1,0 +1,304 @@
+// Coordinator stress test: N app threads submitting tensors through
+// negotiation / fusion / stall detection concurrently, with knob and
+// timeline churn — built as a standalone, fully-instrumented binary so it
+// runs under TSAN/ASAN (horovod_tpu.native.build_stress_binary /
+// tools/check.sh --sanitize; HVD_SANITIZE selects the sanitizer).
+//
+// Shape: main() picks a free port and forks; parent runs rank 0, child
+// runs rank 1 (fork happens before any thread exists, which both
+// sanitizers support). Each rank then runs:
+//   * kSubmitters threads x kIters ops — allreduce (verified against the
+//     closed-form cross-rank sum), ragged allgather (verified row counts
+//     and payload), broadcast (verified against the root's fill) — names
+//     coordinated by (thread, iteration) so negotiation, fusion and the
+//     duplicate-name check all fire under real contention;
+//   * a knob-churn thread banging set_fusion_threshold / cycle time /
+//     hierarchical_active / poll from outside the background loop;
+//   * on rank 0, a timeline churn thread cycling
+//     hvdtpu_timeline_start/end against the live coordinator;
+//   * a deliberate stall: rank 1 submits one tensor 150 ms late under
+//     HOROVOD_STALL_WARNING_TIME=0.05, so CheckForStalled's reporting
+//     path executes (then the op completes normally).
+//
+// Exit code 0 = every op verified on both ranks. Data races are the
+// sanitizer's to report (TSAN exits non-zero via halt_on_error or trips
+// the "WARNING: ThreadSanitizer" scan in tests/test_native_stress.py).
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <netinet/in.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int hvdtpu_init(int rank, int size, int local_rank, int local_size,
+                const char* coord_host, int coord_port, int timeout_ms);
+void hvdtpu_shutdown();
+int hvdtpu_enqueue_allreduce(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims);
+int hvdtpu_enqueue_allgather(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims);
+int hvdtpu_enqueue_broadcast(const char* name, void* data, int dtype,
+                             int ndims, const int64_t* dims, int root_rank);
+int hvdtpu_poll(int handle);
+int hvdtpu_wait(int handle);
+int hvdtpu_error(int handle, char* buf, int buf_len);
+int64_t hvdtpu_result_size(int handle);
+int hvdtpu_result_copy(int handle, void* dst);
+void hvdtpu_release(int handle);
+void hvdtpu_set_fusion_threshold(int64_t bytes);
+int64_t hvdtpu_fusion_threshold();
+void hvdtpu_set_cycle_time_ms(double ms);
+double hvdtpu_cycle_time_ms();
+int hvdtpu_hierarchical_active();
+int hvdtpu_timeline_start(const char* path, int mark_cycles);
+void hvdtpu_timeline_end();
+}
+
+namespace {
+
+constexpr int kSubmitters = 4;
+constexpr int kIters = 48;
+constexpr int kDtypeF32 = 7;  // csrc/common.h DataType::FLOAT32
+
+std::atomic<int> g_failures{0};
+
+void Fail(const std::string& what) {
+  std::fprintf(stderr, "STRESS FAIL: %s\n", what.c_str());
+  g_failures.fetch_add(1);
+}
+
+void CheckWait(int handle, const std::string& ctx) {
+  if (handle < 0) {
+    Fail(ctx + ": enqueue rejected");
+    return;
+  }
+  int rc = hvdtpu_wait(handle);
+  if (rc != 0) {
+    char buf[512] = {0};
+    hvdtpu_error(handle, buf, sizeof(buf));
+    Fail(ctx + ": wait rc=" + std::to_string(rc) + " (" + buf + ")");
+  }
+}
+
+// Deterministic per-(thread, iter) element count; identical across ranks
+// as allreduce/broadcast shape validation demands.
+int64_t ElemCount(int t, int i) { return 4 + 16 * ((t * 31 + i) % 7); }
+
+void SubmitterLoop(int rank, int size, int t) {
+  for (int i = 0; i < kIters; ++i) {
+    std::string name = "t" + std::to_string(t) + "_i" + std::to_string(i);
+    if (i % 8 == 5) {
+      // Ragged allgather: rank r contributes (r + 1) rows of 3 floats.
+      int64_t rows = rank + 1;
+      std::vector<float> in(static_cast<size_t>(rows) * 3,
+                            static_cast<float>(rank + 1));
+      int64_t dims[2] = {rows, 3};
+      int h = hvdtpu_enqueue_allgather(name.c_str(), in.data(), kDtypeF32,
+                                       2, dims);
+      CheckWait(h, name);
+      if (h >= 0) {
+        int64_t total_rows = 0;
+        for (int r = 0; r < size; ++r) total_rows += r + 1;
+        int64_t nbytes = hvdtpu_result_size(h);
+        if (nbytes != total_rows * 3 * static_cast<int64_t>(sizeof(float))) {
+          Fail(name + ": allgather size " + std::to_string(nbytes));
+        } else {
+          std::vector<float> out(static_cast<size_t>(total_rows) * 3);
+          hvdtpu_result_copy(h, out.data());
+          size_t off = 0;
+          for (int r = 0; r < size; ++r) {
+            for (int64_t k = 0; k < (r + 1) * 3; ++k, ++off) {
+              if (out[off] != static_cast<float>(r + 1)) {
+                Fail(name + ": allgather payload mismatch");
+                r = size;
+                break;
+              }
+            }
+          }
+        }
+        hvdtpu_release(h);
+      }
+    } else if (i % 8 == 2) {
+      // Broadcast from a rotating root, in place. (i is always even in
+      // this arm, so i % size would pin root to rank 0 forever and the
+      // root!=self receive path would never run under the sanitizers.)
+      int root = (i / 8 + t) % size;
+      int64_t n = ElemCount(t, i);
+      std::vector<float> buf(static_cast<size_t>(n),
+                             static_cast<float>(rank == root ? root + 7 : -1));
+      int64_t dims[1] = {n};
+      int h = hvdtpu_enqueue_broadcast(name.c_str(), buf.data(), kDtypeF32,
+                                       1, dims, root);
+      CheckWait(h, name);
+      if (h >= 0) {
+        for (int64_t k = 0; k < n; ++k) {
+          if (buf[k] != static_cast<float>(root + 7)) {
+            Fail(name + ": broadcast payload mismatch");
+            break;
+          }
+        }
+        hvdtpu_release(h);
+      }
+    } else {
+      // In-place allreduce: rank r contributes (r + 1); expect the
+      // closed-form cross-rank sum in every element. Small tensors so
+      // consecutive responses fuse whenever the churn thread's current
+      // threshold allows.
+      int64_t n = ElemCount(t, i);
+      std::vector<float> buf(static_cast<size_t>(n),
+                             static_cast<float>(rank + 1));
+      int64_t dims[1] = {n};
+      int h = hvdtpu_enqueue_allreduce(name.c_str(), buf.data(), kDtypeF32,
+                                       1, dims);
+      CheckWait(h, name);
+      if (h >= 0) {
+        float expect = static_cast<float>(size * (size + 1) / 2);
+        for (int64_t k = 0; k < n; ++k) {
+          if (buf[k] != expect) {
+            Fail(name + ": allreduce got " + std::to_string(buf[k]) +
+                 " want " + std::to_string(expect));
+            break;
+          }
+        }
+        hvdtpu_release(h);
+      }
+    }
+  }
+}
+
+void KnobChurnLoop(std::atomic<bool>* done) {
+  int64_t thresholds[3] = {0, 1 << 20, 64 << 20};
+  int i = 0;
+  while (!done->load()) {
+    hvdtpu_set_fusion_threshold(thresholds[i % 3]);
+    (void)hvdtpu_fusion_threshold();
+    hvdtpu_set_cycle_time_ms(i % 2 ? 0.5 : 1.0);
+    (void)hvdtpu_cycle_time_ms();
+    (void)hvdtpu_hierarchical_active();
+    (void)hvdtpu_poll(0);
+    ++i;
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+void TimelineChurnLoop(const std::string& path, std::atomic<bool>* done) {
+  int cycles = 0;
+  while (!done->load() && cycles < 6) {
+    hvdtpu_timeline_start(path.c_str(), 1);
+    std::this_thread::sleep_for(std::chrono::milliseconds(25));
+    hvdtpu_timeline_end();
+    ++cycles;
+  }
+}
+
+int WorkerMain(int rank, int size, int port) {
+  // Fast cycles + a 50 ms stall threshold so the stall reporter actually
+  // runs inside the test's budget.
+  setenv("HOROVOD_CYCLE_TIME", "1", 1);
+  setenv("HOROVOD_STALL_WARNING_TIME", "0.05", 1);
+  if (hvdtpu_init(rank, size, /*local_rank=*/rank, /*local_size=*/size,
+                  "127.0.0.1", port, 20000) != 0) {
+    std::fprintf(stderr, "rank %d: init failed\n", rank);
+    return 2;
+  }
+
+  std::atomic<bool> done{false};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kSubmitters; ++t)
+    threads.emplace_back(SubmitterLoop, rank, size, t);
+  threads.emplace_back(KnobChurnLoop, &done);
+  std::thread timeline_thread;
+  if (rank == 0) {
+    std::string path =
+        "/tmp/hvd_stress_timeline." + std::to_string(getpid()) + ".json";
+    timeline_thread = std::thread(TimelineChurnLoop, path, &done);
+  }
+
+  for (int t = 0; t < kSubmitters; ++t) threads[t].join();
+
+  // Deliberate stall: rank 1 shows up 150 ms late (> the 50 ms warning
+  // threshold), so rank 0's CheckForStalled reports the pending tensor
+  // before the op completes.
+  if (rank == 1)
+    std::this_thread::sleep_for(std::chrono::milliseconds(150));
+  std::vector<float> buf(8, static_cast<float>(rank + 1));
+  int64_t dims[1] = {8};
+  int h = hvdtpu_enqueue_allreduce("stalled_tensor", buf.data(), kDtypeF32,
+                                   1, dims);
+  CheckWait(h, "stalled_tensor");
+  if (h >= 0) hvdtpu_release(h);
+
+  done = true;
+  for (size_t t = kSubmitters; t < threads.size(); ++t) threads[t].join();
+  if (timeline_thread.joinable()) timeline_thread.join();
+
+  hvdtpu_shutdown();
+  int failures = g_failures.load();
+  if (failures != 0) {
+    std::fprintf(stderr, "rank %d: %d verification failure(s)\n", rank,
+                 failures);
+    return 1;
+  }
+  std::fprintf(stderr, "rank %d: stress OK\n", rank);
+  return 0;
+}
+
+int FreePort() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int port = ntohs(addr.sin_port);
+  close(fd);
+  return port;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc == 4) {
+    // Internal re-entry: stress_test worker <rank> <size> is not needed —
+    // kept for manual runs: ./hvdstress <rank> <size> <port>.
+    return WorkerMain(std::atoi(argv[1]), std::atoi(argv[2]),
+                      std::atoi(argv[3]));
+  }
+  int port = FreePort();
+  if (port <= 0) {
+    std::fprintf(stderr, "no free port\n");
+    return 2;
+  }
+  // Fork BEFORE any thread exists (sanitizer-safe); child = rank 1.
+  pid_t child = fork();
+  if (child < 0) {
+    std::perror("fork");
+    return 2;
+  }
+  if (child == 0) return WorkerMain(1, 2, port);
+  int rc0 = WorkerMain(0, 2, port);
+  int status = 0;
+  waitpid(child, &status, 0);
+  int rc1 = WIFEXITED(status) ? WEXITSTATUS(status) : 3;
+  if (rc0 == 0 && rc1 == 0) {
+    std::fprintf(stderr, "stress: both ranks clean\n");
+    return 0;
+  }
+  std::fprintf(stderr, "stress: rank0 rc=%d rank1 rc=%d\n", rc0, rc1);
+  return rc0 != 0 ? rc0 : rc1;
+}

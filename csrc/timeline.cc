@@ -43,7 +43,8 @@ int64_t NativeTimeline::NowUs() const {
 }
 
 void NativeTimeline::Initialize(const std::string& path, bool mark_cycles) {
-  if (initialized_) return;
+  std::lock_guard<std::mutex> lifecycle(lifecycle_mu_);
+  if (initialized_.load()) return;
   file_.open(path, std::ios::out | std::ios::trunc);
   if (!file_.good()) {
     HVD_LOG(ERROR) << "failed to open timeline file " << path;
@@ -56,13 +57,31 @@ void NativeTimeline::Initialize(const std::string& path, bool mark_cycles) {
   // it survives abrupt process death (same choice as the reference,
   // timeline.cc comment on format).
   file_ << "[\n";
-  stop_ = false;
+  {
+    // A recorder that passed the initialized_ gate just as the previous
+    // Shutdown drained could have parked one stale record here; its ts
+    // belongs to the OLD session's epoch, so a fresh session must start
+    // from an empty queue.
+    std::lock_guard<std::mutex> lock(mu_);
+    while (!queue_.empty()) queue_.pop();
+    stop_ = false;
+  }
+  // Per-session writer state: stale ids would suppress the pid metadata
+  // rows in the new file (lanes would render unnamed). Safe to touch
+  // here — the owning writer thread is joined and not yet respawned.
+  // open_depth_ (coordinator-thread-owned) needs no cross-thread reset:
+  // Start/NegotiateStart assign depth = 1, so any stale depth is
+  // overwritten before the session's first End.
+  tensor_ids_.clear();
   writer_ = std::thread(&NativeTimeline::WriterLoop, this);
-  initialized_ = true;
+  initialized_ = true;  // published last: recorders gate on it
 }
 
 void NativeTimeline::Shutdown() {
-  if (!initialized_) return;
+  std::lock_guard<std::mutex> lifecycle(lifecycle_mu_);
+  if (!initialized_.load()) return;
+  // Reject new events first so the writer can actually drain to empty.
+  initialized_ = false;
   {
     std::lock_guard<std::mutex> lock(mu_);
     stop_ = true;
@@ -70,7 +89,6 @@ void NativeTimeline::Shutdown() {
   cv_.notify_all();
   if (writer_.joinable()) writer_.join();
   file_.close();
-  initialized_ = false;
 }
 
 void NativeTimeline::Enqueue(EventType type, const std::string& tensor,

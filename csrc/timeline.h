@@ -9,6 +9,7 @@
 // queue — the enqueue cost is a few hundred ns, far below the 5 ms cycle.
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <fstream>
@@ -53,9 +54,14 @@ class NativeTimeline {
   int64_t NowUs() const;
   int TensorId(const std::string& tensor);  // writer thread only
 
-  bool initialized_ = false;
-  bool mark_cycles_ = false;
-  int64_t start_us_ = 0;
+  // Initialize/Shutdown run on app threads (hvdtpu_timeline_start/end)
+  // while the coordinator background thread calls the recording API:
+  // the lifecycle state must be atomic (TSAN-clean), and the lifecycle
+  // transitions themselves serialized.
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> mark_cycles_{false};
+  std::atomic<int64_t> start_us_{0};
+  std::mutex lifecycle_mu_;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -75,9 +75,30 @@ except ImportError:  # pragma: no cover
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
+# HVD_SANITIZE=thread|address: rebuild the native core under
+# TSAN/ASAN. Sanitized artifacts live under distinct cache names
+# (-tsan/-asan suffix), so sanitized and plain builds coexist and
+# switching the env var never serves a stale flavor. Sanitizers want
+# frame pointers and modest optimization for usable reports.
+_SANITIZERS = {
+    "thread": ("tsan", ["-fsanitize=thread"]),
+    "address": ("asan", ["-fsanitize=address"]),
+}
+
 
 class NativeBuildError(RuntimeError):
     pass
+
+
+def sanitize_mode() -> str:
+    """'' | 'thread' | 'address' from HVD_SANITIZE (invalid -> error)."""
+    mode = os.environ.get("HVD_SANITIZE", "").strip().lower()
+    if mode in ("", "0", "none", "off", "false"):
+        return ""
+    if mode not in _SANITIZERS:
+        raise NativeBuildError(
+            f"HVD_SANITIZE={mode!r}: expected 'thread' or 'address'")
+    return mode
 
 
 def _source_hash() -> str:
@@ -87,10 +108,11 @@ def _source_hash() -> str:
     return h.hexdigest()[:16]
 
 
-def build_library(force: bool = False) -> Path:
-    """Compile csrc/ into a cached shared library; returns its path."""
+def _compile(sources, out_name: str, extra_flags, shared: bool,
+             force: bool) -> Path:
+    """One g++ invocation into the content-hashed cache (atomic publish)."""
     _CACHE_DIR.mkdir(parents=True, exist_ok=True)
-    out = _CACHE_DIR / f"libhvdtpu-{_source_hash()}.so"
+    out = _CACHE_DIR / out_name
     if out.exists() and not force:
         return out
     # Per-process temp name: N freshly-launched workers may race to build
@@ -98,12 +120,12 @@ def build_library(force: bool = False) -> Path:
     tmp = f"{out}.{os.getpid()}.tmp"
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O3",
+        *extra_flags,
         "-std=c++17",
         "-fPIC",
-        "-shared",
+        *(["-shared"] if shared else []),
         "-pthread",
-        *(str(_CSRC / s) for s in _SOURCES),
+        *(str(_CSRC / s) for s in sources),
         "-I",
         str(_CSRC),
         "-o",
@@ -112,10 +134,44 @@ def build_library(force: bool = False) -> Path:
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(
-            f"native core build failed:\n{proc.stderr[-4000:]}"
+            f"native build failed ({out_name}):\n{proc.stderr[-4000:]}"
         )
     os.replace(tmp, out)
     return out
+
+
+def _mode_suffix_flags(mode: str):
+    if not mode:
+        return "", ["-O3"]
+    tag, san_flags = _SANITIZERS[mode]
+    return f"-{tag}", [*san_flags, "-O1", "-g", "-fno-omit-frame-pointer"]
+
+
+def build_library(force: bool = False) -> Path:
+    """Compile csrc/ into a cached shared library; returns its path.
+
+    Honors HVD_SANITIZE (see sanitize_mode). Note that dlopen-ing a
+    TSAN/ASAN .so into an uninstrumented interpreter needs the sanitizer
+    runtime preloaded (LD_PRELOAD=libtsan.so/libasan.so); the fully
+    supported sanitizer lane is the standalone stress binary
+    (build_stress_binary), which instruments main() too.
+    """
+    suffix, flags = _mode_suffix_flags(sanitize_mode())
+    return _compile(_SOURCES, f"libhvdtpu-{_source_hash()}{suffix}.so",
+                    flags, shared=True, force=force)
+
+
+def build_stress_binary(force: bool = False) -> Path:
+    """Compile the coordinator stress test (csrc/stress_test.cc) as a
+    standalone executable — the TSAN/ASAN lane's entry point, since a
+    whole-program build is the only configuration the sanitizers fully
+    support. Honors HVD_SANITIZE for the sanitizer choice."""
+    h = hashlib.sha256(_source_hash().encode())
+    h.update((_CSRC / "stress_test.cc").read_bytes())
+    suffix, flags = _mode_suffix_flags(sanitize_mode())
+    return _compile(_SOURCES + ["stress_test.cc"],
+                    f"hvdstress-{h.hexdigest()[:16]}{suffix}",
+                    flags, shared=False, force=force)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -199,9 +255,23 @@ def load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is None:
             path = build_library()
-            # RTLD_GLOBAL mirrors the reference loader
-            # (horovod/common/__init__.py:55).
-            _lib = _bind(ctypes.CDLL(str(path), mode=ctypes.RTLD_GLOBAL))
+            try:
+                # RTLD_GLOBAL mirrors the reference loader
+                # (horovod/common/__init__.py:55).
+                _lib = _bind(ctypes.CDLL(str(path), mode=ctypes.RTLD_GLOBAL))
+            except OSError as e:
+                mode = sanitize_mode()
+                if mode:
+                    rt = "libtsan.so.0" if mode == "thread" else "libasan.so.6"
+                    raise NativeBuildError(
+                        f"could not dlopen the HVD_SANITIZE={mode} build "
+                        f"({e}). Sanitizer runtimes must be loaded before "
+                        f"the interpreter: re-run under LD_PRELOAD={rt}, or "
+                        "use the fully-instrumented stress binary lane "
+                        "(horovod_tpu.native.build_stress_binary / "
+                        "tools/check.sh --sanitize) instead."
+                    ) from e
+                raise
     return _lib
 
 

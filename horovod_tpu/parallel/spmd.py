@@ -230,8 +230,9 @@ def spmd_fn(
             and tuner.claim(dispatch)
             and tuner.step_done()
         ):
-            jax.block_until_ready(out)  # observe real device time
-            tuner.end_window()
+            # The tuner blocks AND forces a d2h pull before reading its
+            # clock (sync-honest probe; see StepAutotuner.end_window).
+            tuner.end_window(out)
         if multi_host:
             out = _localize(out)
         return out

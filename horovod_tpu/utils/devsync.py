@@ -27,7 +27,17 @@ def force_device_sync(tree) -> float:
               if hasattr(l, "dtype")]
     if not leaves:
         return 0.0
-    return float(jnp.sum(leaves[0].astype(jnp.float32)))
+    leaf = leaves[0]
+    if getattr(leaf, "is_fully_addressable", True) is False:
+        # Multi-host: a global jax.Array spanning processes cannot be
+        # consumed eagerly (jnp.sum raises on non-fully-addressable
+        # input). Any d2h transfer flips the sync semantics, so pull
+        # this process's first addressable shard instead.
+        shards = leaf.addressable_shards
+        if not shards:
+            return 0.0
+        leaf = shards[0].data
+    return float(jnp.sum(leaf.astype(jnp.float32)))
 
 
 def window_sync(tree, timeline=None, track: str = "hvd.window",

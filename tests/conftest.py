@@ -94,6 +94,12 @@ _SLOW_TESTS = {
     "test_native_core.py::TestMultiProcess::test_collectives[4]",
     # 20s whole-ViT step; stand-in: vit forward-shape test
     "test_examples_models.py::TestModelZoo::test_vit_spmd_train_step",
+    # Sanitizer builds recompile all of csrc/ (~60s each) and rerun the
+    # stress binary under TSAN/ASAN; the plain stress test (fast lane)
+    # covers deadlock/corruption, these cover races/memory. Run via
+    # tools/check.sh --sanitize or pytest -m slow.
+    "test_native_stress.py::test_stress_clean_under_tsan",
+    "test_native_stress.py::test_stress_clean_under_asan",
 }
 
 

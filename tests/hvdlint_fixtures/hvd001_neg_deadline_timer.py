@@ -1,0 +1,23 @@
+"""NEGATIVE: wall-clock arithmetic that is not a device-timing bracket —
+launcher deadlines and pure-host work. Deadline sums never register a
+timer variable, and host-only regions have no dispatch call; both must
+stay silent.
+"""
+
+import time
+
+
+def wait_with_deadline(proc, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def host_only_timing(records):
+    t0 = time.perf_counter()
+    total = sum(len(r) for r in records)
+    parsed = [r.strip() for r in records]
+    return total, len(parsed), time.perf_counter() - t0

@@ -1,0 +1,26 @@
+"""NEGATIVE: the corrected round-5 discipline — a forced device sync
+(block_until_ready / force_device_sync) inside the timed region. This is
+bench.py's run_timed shape after the correction; hvdlint must stay
+silent.
+"""
+
+import time
+
+import jax
+
+from horovod_tpu.utils.devsync import force_device_sync
+
+
+def timed_window(run_step, state, batch, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = run_step(state, batch)
+    jax.block_until_ready(state)
+    return iters / (time.perf_counter() - t0)
+
+
+def timed_once(run_step, state, batch):
+    t0 = time.perf_counter()
+    state, metrics = run_step(state, batch)
+    force_device_sync(state)
+    return time.perf_counter() - t0

@@ -1,0 +1,19 @@
+"""POSITIVE: a locally-bound jitted callable dispatched inside a
+perf_counter bracket with no sync — the shape of the pre-round-5 chip
+probe (dispatch-only "TFLOP/s" stamps of 3,000-16,000 on a ~180 TF/s
+chip). hvdlint tracks the ``jax.jit`` binding to know ``f`` dispatches.
+"""
+
+import time
+
+import jax
+
+
+def probe(fn, x, iters):
+    f = jax.jit(fn)
+    t0 = time.monotonic()
+    y = x
+    for _ in range(iters):
+        y = f(y)
+    elapsed = time.monotonic() - t0  # EXPECT: HVD001
+    return elapsed / iters

@@ -1,0 +1,17 @@
+"""HISTORICAL POSITIVE (round 5, PERF.md "ROUND-5 CORRECTION"): the
+pre-round-5 benchmark timed async XLA dispatch, not the device — on the
+tunneled backend nothing in the timed region forced completion, and the
+ResNet lane read ~22x the chip's true rate. Minimized from the
+pre-correction bench.py window loop / chip probe.
+
+Fixture corpus only — never executed, only parsed by hvdlint.
+"""
+
+import time
+
+
+def timed_window(run_step, state, batch, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = run_step(state, batch)
+    return iters / (time.perf_counter() - t0)  # EXPECT: HVD001

@@ -1,0 +1,14 @@
+"""NEGATIVE: rank-conditional side effects with no collective inside the
+branch (rank-0 logging/saving) — the canonical correct use of rank().
+The collective runs unconditionally before the branch.
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def train_log(metrics, path):
+    averaged = hvd.allreduce(metrics, average=True)
+    if hvd.rank() == 0:
+        with open(path, "a") as f:
+            f.write(f"{averaged}\n")
+    return averaged

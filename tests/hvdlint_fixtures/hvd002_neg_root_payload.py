@@ -1,0 +1,16 @@
+"""NEGATIVE: the legitimate root-prepares-payload pattern — only the
+branch body is rank-conditional (filling the buffer); the collective
+itself is OUTSIDE the branch and every rank reaches it. This is how
+broadcast_object works on both binding lanes; hvdlint must stay silent.
+"""
+
+import numpy as np
+
+import horovod_tpu.jax as hvd
+
+
+def broadcast_object_bytes(payload, root_rank, nbytes):
+    buf = np.zeros(nbytes, dtype=np.uint8)
+    if hvd.rank() == root_rank:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    return hvd.broadcast(buf, root_rank)

@@ -1,0 +1,13 @@
+"""POSITIVE: the same deadlock spelled as a rank-guarded early return —
+non-zero ranks leave the function before the collective below, so rank 0
+waits forever in the allgather negotiation.
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def checkpoint_metrics(metrics):
+    if hvd.rank() != 0:
+        return None  # EXPECT: HVD002
+    gathered = hvd.allgather(metrics)
+    return gathered

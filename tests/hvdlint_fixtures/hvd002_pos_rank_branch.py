@@ -1,0 +1,20 @@
+"""POSITIVE: a collective under rank-divergent control flow — only rank 0
+enters the allreduce, every other rank never joins the negotiation and
+the job deadlocks (reference semantics: collectives are collective).
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def summarize(metrics):
+    if hvd.rank() == 0:
+        total = hvd.allreduce(metrics, average=True)  # EXPECT: HVD002
+        return total
+    return None
+
+
+def gather_on_root(st, x):
+    if st.process_index == 0:
+        from horovod_tpu.jax import eager
+        return eager.process_allgather(x)  # EXPECT: HVD002
+    return x

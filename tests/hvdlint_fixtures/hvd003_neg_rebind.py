@@ -1,0 +1,14 @@
+"""NEGATIVE: the supported donation pattern — the variable is rebound
+from the call result (``state = f(state, batch)``), so every later read
+sees the new buffer. This is how bench.py and the window loop consume
+donated train states; hvdlint must stay silent.
+"""
+
+import jax
+
+
+def train_loop(step, state, batches):
+    f = jax.jit(step, donate_argnums=(0,))
+    for batch in batches:
+        state = f(state, batch)
+    return state.params.sum()

@@ -1,0 +1,13 @@
+"""NEGATIVE: reuse of a NON-donated argument — only position 0 is
+donated; ``batch`` (position 1) survives the call and may be read
+freely.
+"""
+
+import jax
+
+
+def train(step, state, batch):
+    f = jax.jit(step, donate_argnums=(0,))
+    new_state = f(state, batch)
+    stats = batch.mean()
+    return new_state, stats

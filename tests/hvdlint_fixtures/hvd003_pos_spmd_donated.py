@@ -1,0 +1,14 @@
+"""POSITIVE: same bug through the SPMD wrapper (spmd_fn forwards
+donate_argnums to jax.jit) and with the scalar donate_argnums spelling;
+two donated positions, both later reads flagged.
+"""
+
+from horovod_tpu.parallel.spmd import spmd_fn
+
+
+def run(step, state, opt_state, batch):
+    f = spmd_fn(step, donate_argnums=(0, 1))
+    out = f(state, opt_state, batch)
+    stale = state  # EXPECT: HVD003
+    also_stale = opt_state  # EXPECT: HVD003
+    return out, stale, also_stale

@@ -1,0 +1,13 @@
+"""POSITIVE: use-after-donation — ``state`` is donated to the jitted
+step (donate_argnums=(0,)) and then read afterwards. XLA has invalidated
+the buffer; on hardware the read returns garbage or raises.
+"""
+
+import jax
+
+
+def train(step, state, batch):
+    f = jax.jit(step, donate_argnums=(0,))
+    new_state = f(state, batch)
+    checksum = state.params.sum()  # EXPECT: HVD003
+    return new_state, checksum

@@ -1,0 +1,11 @@
+"""NEGATIVE: a class with deterministic cleanup and NO finalizer at all
+— nothing for the rule to say (whether to add a backstop __del__ is a
+judgement call, not a lint)."""
+
+
+class LogSink:
+    def __init__(self, path):
+        self.f = open(path, "a")
+
+    def close(self):
+        self.f.close()

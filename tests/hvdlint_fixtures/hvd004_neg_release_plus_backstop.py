@@ -1,0 +1,24 @@
+"""NEGATIVE: the repaired Handle shape — explicit ``release()`` (and
+context-manager exit) as the deterministic path, ``__del__`` kept only
+as a GC backstop. This is what horovod_tpu/jax/mpi_ops.py ships.
+"""
+
+
+class OpHandle:
+    def __init__(self, name, registry):
+        self.name = name
+        self.registry = registry
+        registry.add(name)
+
+    def release(self):
+        self.registry.discard(self.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __del__(self):
+        self.release()

@@ -1,0 +1,16 @@
+"""POSITIVE: resource release only in ``__del__`` — the Handle
+fragility (VERDICT round-5 weak #6): under delayed GC or reference
+cycles the resource (an in-flight op name, a file, a socket) stays
+poisoned until collection, and interpreter teardown may skip the
+finalizer entirely.
+"""
+
+
+class OpHandle:
+    def __init__(self, name, registry):
+        self.name = name
+        self.registry = registry
+        registry.add(name)
+
+    def __del__(self):  # EXPECT: HVD004
+        self.registry.discard(self.name)

@@ -1,0 +1,18 @@
+"""POSITIVE: having other methods does not help — none of them is a
+deterministic release path (release/close/shutdown/__exit__/...); the
+only cleanup is still the finalizer.
+"""
+
+
+class TimelineWriter:
+    def __init__(self, path):
+        self.f = open(path, "w")
+
+    def write_event(self, event):
+        self.f.write(event)
+
+    def flush(self):
+        self.f.flush()
+
+    def __del__(self):  # EXPECT: HVD004
+        self.f.close()

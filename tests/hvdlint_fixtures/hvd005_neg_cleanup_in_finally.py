@@ -1,0 +1,21 @@
+"""NEGATIVE: the repaired shape — cleanup in the finally, guarded by an
+is-active check, alongside the env restore (what __graft_entry__'s
+_dryrun_hier_dp does since PR 1)."""
+
+import os
+
+import horovod_tpu.jax as hvd
+
+
+def dryrun_hier_dp(run_lane, check):
+    saved = dict(os.environ)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    try:
+        hvd.init()
+        result = run_lane()
+        assert check(result)
+    finally:
+        if hvd.is_initialized():
+            hvd.shutdown()
+        os.environ.clear()
+        os.environ.update(saved)

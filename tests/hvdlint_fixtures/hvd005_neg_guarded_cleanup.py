@@ -1,0 +1,19 @@
+"""NEGATIVE: the guarded-cleanup idiom — the try exists to protect the
+cleanup call itself (first statement of the body); there is nothing to
+move into a finally. Also silent: a try body that repeats the cleanup in
+its finally."""
+
+
+def quiet_close(sock):
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def stop_with_retry(server):
+    try:
+        server.drain()
+        server.stop()
+    finally:
+        server.stop()

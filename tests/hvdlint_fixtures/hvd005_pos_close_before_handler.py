@@ -1,0 +1,14 @@
+"""POSITIVE: mid-try ``close()`` with an except handler — when the
+transfer raises, the handler runs and the socket leaks; the close
+belongs in a finally."""
+
+
+def send_all(make_socket, payload):
+    sock = make_socket()
+    try:
+        sock.connect()
+        sock.sendall(payload)
+        sock.close()  # EXPECT: HVD005
+    except OSError:
+        return False
+    return True

@@ -1,0 +1,24 @@
+"""HISTORICAL POSITIVE (ADVICE round-5 #2): the ``_dryrun_hier_dp``
+leak, minimized. ``hvd.shutdown()`` sat in the try body after the lane's
+assertions; when an assertion failed, the finally restored the env vars
+but hvd stayed initialized with the hierarchical mesh, muddying every
+subsequent lane's failure mode. The shutdown belonged in the finally
+(guarded by an is-initialized check) — where the repo moved it in PR 1.
+"""
+
+import os
+
+import horovod_tpu.jax as hvd
+
+
+def dryrun_hier_dp(run_lane, check):
+    saved = dict(os.environ)
+    os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    try:
+        hvd.init()
+        result = run_lane()
+        assert check(result)
+        hvd.shutdown()  # EXPECT: HVD005
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)

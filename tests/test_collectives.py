@@ -231,6 +231,37 @@ def test_duplicate_inflight_name_raises(hvd):
     hvd.synchronize(h2)
 
 
+def test_handle_release_frees_name_without_gc(hvd):
+    # VERDICT round-5 ask #7: a dropped handle's name must be reusable
+    # via explicit release(), with no GC assistance — the handle object
+    # stays referenced (so __del__ cannot have run) and the collector is
+    # off for the duration.
+    import gc
+
+    x = np.ones((4,), np.float32)
+    h = hvd.allreduce_async(x, name="rel")
+    gc.disable()
+    try:
+        h.release()
+        h.release()  # idempotent
+        h2 = hvd.allreduce_async(x, name="rel")
+        hvd.synchronize(h2)
+    finally:
+        gc.enable()
+    assert h is not None  # keep the first handle alive past the re-register
+
+
+def test_handle_context_manager_releases(hvd):
+    x = np.ones((4,), np.float32)
+    with hvd.allreduce_async(x, name="ctx") as h:
+        out = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), x)
+    # Exited: the name is free even though h is still referenced.
+    h2 = hvd.allreduce_async(x, name="ctx")
+    h2.release()
+    assert h is not None
+
+
 def test_alltoall_indivisible_raises(hvd):
     with pytest.raises(Exception):
         hvd.spmd_run(

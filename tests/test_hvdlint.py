@@ -1,0 +1,190 @@
+"""Tests for tools/hvdlint: every rule must fire on its historical-bug
+fixtures (tests/hvdlint_fixtures/) and stay silent on the negatives.
+
+Fixture contract: a ``# EXPECT: HVDxxx`` comment marks the exact line a
+finding must anchor to; ``*_neg_*`` files carry no markers and must
+produce zero findings. The corpus includes the two named historical
+incidents — the round-5 timing bug (hvd001_pos_round5_timing) and the
+_dryrun_hier_dp shutdown leak (hvd005_pos_hier_dp_leak).
+"""
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "hvdlint_fixtures"
+
+sys.path.insert(0, str(REPO))
+
+from tools.hvdlint import lint_file, lint_paths, lint_source  # noqa: E402
+from tools.hvdlint.rules import RULES  # noqa: E402
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(HVD\d{3})")
+
+
+def _expected(path: Path):
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _EXPECT_RE.finditer(line):
+            out.add((i, m.group(1)))
+    return out
+
+
+def _fixture_files():
+    files = sorted(FIXTURES.glob("*.py"))
+    assert files, "fixture corpus missing"
+    return files
+
+
+@pytest.mark.parametrize("path", _fixture_files(),
+                         ids=lambda p: p.stem)
+def test_fixture(path):
+    found = {(f.line, f.rule) for f in lint_file(path)}
+    expected = _expected(path)
+    if "_neg_" in path.name:
+        assert not expected, f"negative fixture {path.name} has EXPECT markers"
+        assert not found, (
+            f"negative fixture {path.name} produced findings: {found}")
+    else:
+        assert expected, f"positive fixture {path.name} lacks EXPECT markers"
+        assert found == expected, (
+            f"{path.name}: expected {sorted(expected)}, got {sorted(found)}")
+
+
+def test_corpus_covers_every_rule_both_ways():
+    """At least 2 positive and 2 negative fixtures per rule (the ISSUE's
+    corpus floor), counting hvd00X-prefixed files."""
+    for rule in RULES:
+        prefix = rule.lower()
+        pos = list(FIXTURES.glob(f"{prefix}_pos_*.py"))
+        neg = list(FIXTURES.glob(f"{prefix}_neg_*.py"))
+        assert len(pos) >= 2, f"{rule}: {len(pos)} positive fixtures (<2)"
+        assert len(neg) >= 2, f"{rule}: {len(neg)} negative fixtures (<2)"
+
+
+def test_historical_fixtures_present():
+    assert (FIXTURES / "hvd001_pos_round5_timing.py").exists()
+    assert (FIXTURES / "hvd005_pos_hier_dp_leak.py").exists()
+
+
+def test_line_suppression():
+    src = (
+        "class H:\n"
+        "    def __del__(self):  # hvdlint: disable=HVD004\n"
+        "        pass\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+def test_preceding_line_suppression():
+    src = (
+        "class H:\n"
+        "    # hvdlint: disable=HVD004\n"
+        "    def __del__(self):\n"
+        "        pass\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+def test_file_level_suppression_and_other_rules_unaffected():
+    src = (
+        "# hvdlint: disable-file=HVD004\n"
+        "class A:\n"
+        "    def __del__(self):\n"
+        "        pass\n"
+        "class B:\n"
+        "    def __del__(self):\n"
+        "        pass\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 2 and all(f.suppressed for f in findings)
+    # An unrelated code does not suppress.
+    src2 = src.replace("disable-file=HVD004", "disable-file=HVD001")
+    findings2 = lint_source(src2)
+    assert len(findings2) == 2 and not any(f.suppressed for f in findings2)
+
+
+def test_suppression_in_string_literal_is_inert():
+    """Docstrings/strings that QUOTE the suppression syntax (docs,
+    examples, this very suite) must not create live suppressions."""
+    src = (
+        '"""Docs: use # hvdlint: disable-file=HVD004 to silence."""\n'
+        "EXAMPLE = '# hvdlint: disable=HVD004'\n"
+        "class H:\n"
+        "    def __del__(self):\n"
+        "        pass\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 1 and not findings[0].suppressed
+
+
+def test_wrong_code_on_line_does_not_suppress():
+    src = (
+        "class H:\n"
+        "    def __del__(self):  # hvdlint: disable=HVD001\n"
+        "        pass\n"
+    )
+    findings = lint_source(src)
+    assert len(findings) == 1 and not findings[0].suppressed
+
+
+def test_select_filters_rules():
+    path = FIXTURES / "hvd004_pos_del_only.py"
+    assert lint_file(path, select=["HVD001"]) == []
+    assert lint_file(path, select=["HVD004"])
+
+
+def test_repo_sweep_is_clean():
+    """The shipping gate (acceptance criterion): zero unsuppressed
+    findings across the swept surface."""
+    findings = [f for f in lint_paths(
+        [str(REPO / "horovod_tpu"), str(REPO / "tools"),
+         str(REPO / "bench.py")]) if not f.suppressed]
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("class H:\n    def __del__(self):\n        pass\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    env_cwd = str(REPO)
+    rc_bad = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", str(bad)],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert rc_bad.returncode == 1
+    assert "HVD004" in rc_bad.stdout
+    rc_good = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", str(good)],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert rc_good.returncode == 0, rc_good.stdout + rc_good.stderr
+    rc_rules = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--list-rules"],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert rc_rules.returncode == 0
+    for rule in RULES:
+        assert rule in rc_rules.stdout
+
+
+def test_syntax_error_reported_not_crash(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = lint_paths([str(bad)])
+    assert len(findings) == 1 and findings[0].rule == "HVD000"
+
+
+def test_non_python_file_argument_rejected(tmp_path):
+    """An existing non-.py file must error, not silently shrink the
+    sweep to zero files (a green gate that linted nothing)."""
+    sh = tmp_path / "script.sh"
+    sh.write_text("echo hi\n")
+    with pytest.raises(ValueError):
+        lint_paths([str(sh)])
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "missing.py")])

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Development gate: hvdlint sweep + the fast lint-fixture tests, with an
+# opt-in sanitizer lane.
+#
+#   tools/check.sh              hvdlint (horovod_tpu/ tools/ bench.py must
+#                               be at zero unsuppressed findings) + the
+#                               hvdlint fixture/suppression test suite
+#   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
+#                               TSAN (HVD_SANITIZE=address|thread through
+#                               the self-building loader) and run the
+#                               native stress lane race/memory-clean
+#
+# Documented in README "Tests & benchmarks" and docs/static_analysis.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SANITIZE=0
+for arg in "$@"; do
+  case "$arg" in
+    --sanitize) SANITIZE=1 ;;
+    *) echo "usage: tools/check.sh [--sanitize]" >&2; exit 2 ;;
+  esac
+done
+
+echo "== hvdlint sweep (horovod_tpu/ tools/ bench.py) =="
+python -m tools.hvdlint horovod_tpu/ tools/ bench.py
+
+echo "== hvdlint rule fixtures =="
+python -m pytest tests/test_hvdlint.py -q -p no:cacheprovider
+
+if [[ "$SANITIZE" == "1" ]]; then
+  echo "== native stress lane under ASAN + TSAN =="
+  # -m '' overrides the slow deselection: the sanitizer tests are
+  # slow-marked so the fast iteration lane never pays the rebuilds.
+  python -m pytest tests/test_native_stress.py -q -p no:cacheprovider \
+    -m '' -k 'tsan or asan or sanitize'
+fi
+
+echo "check.sh: OK"

@@ -1,0 +1,33 @@
+"""hvdlint: distributed-training static analysis for this repository.
+
+An AST-based lint pass whose rules encode the bug classes this project
+has actually been burned by (VERDICT.md / ADVICE.md history), rather
+than generic style:
+
+* **HVD001** un-synced timing: ``time.perf_counter``/``time.monotonic``
+  bracketing device dispatch with no forced sync in the timed region —
+  the round-5 measurement bug that invalidated four rounds of
+  benchmark history (PERF.md "ROUND-5 CORRECTION").
+* **HVD002** collectives under rank-divergent Python control flow
+  (``if hvd.rank() == 0: hvd.allreduce(...)`` deadlocks every other
+  rank in the negotiation loop).
+* **HVD003** use-after-donation: reading a buffer after passing it at
+  a ``donate_argnums`` position of a jitted callable.
+* **HVD004** resource release via ``__del__`` only (the ``Handle``
+  fragility, VERDICT round-5 weak #6).
+* **HVD005** shutdown/cleanup calls in a ``try`` body that belong in
+  ``finally`` (the ``_dryrun_hier_dp`` leak, ADVICE round-5 #2).
+
+Run as ``python -m tools.hvdlint <paths...>``; suppress a finding with
+a ``# hvdlint: disable=HVDxxx`` comment on (or immediately above) the
+flagged line. See docs/static_analysis.md for the full catalogue.
+"""
+
+from tools.hvdlint.core import (  # noqa: F401
+    Finding,
+    lint_file,
+    lint_paths,
+    lint_source,
+    main,
+)
+from tools.hvdlint.rules import RULES  # noqa: F401

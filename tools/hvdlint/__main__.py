@@ -1,0 +1,7 @@
+"""``python -m tools.hvdlint <paths...>`` entry point."""
+
+import sys
+
+from tools.hvdlint.core import main
+
+sys.exit(main())

@@ -1,0 +1,192 @@
+"""hvdlint engine: file walking, suppression comments, CLI.
+
+Suppression syntax (checked per finding):
+
+* ``# hvdlint: disable=HVD001`` on the flagged line, or on the line
+  directly above it (for lines too long to carry a trailing comment).
+  Several codes separate with commas; ``disable=all`` silences every
+  rule for that line.
+* ``# hvdlint: disable-file=HVD004`` anywhere in the file silences the
+  named rules for the whole file.
+
+Exit status: 0 when every finding is suppressed (or none exist),
+1 otherwise — so ``python -m tools.hvdlint horovod_tpu/ tools/ bench.py``
+is a CI gate (tools/check.sh wires it into one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import re
+import sys
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from tools.hvdlint.rules import RULES
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<codes>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+#: Directory names never descended into.
+_SKIP_DIRS = {"__pycache__", ".git", "_cache", ".pytest_cache"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}{tag}")
+
+
+def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(per-line codes, file-level codes). Codes are upper-cased; the
+    special code ALL matches every rule.
+
+    Only real COMMENT tokens count — a docstring or string literal that
+    merely *quotes* the suppression syntax (as this module's own
+    docstring does) must not become a live suppression."""
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return per_line, file_level  # engine reports the syntax error
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group("codes").split(",")}
+        if m.group("scope"):
+            file_level |= codes
+        else:
+            per_line.setdefault(tok.start[0], set()).update(codes)
+    return per_line, file_level
+
+
+def _is_suppressed(line: int, rule: str,
+                   per_line: Dict[int, Set[str]],
+                   file_level: Set[str]) -> bool:
+    if "ALL" in file_level or rule in file_level:
+        return True
+    for at in (line, line - 1):
+        codes = per_line.get(at)
+        if codes and ("ALL" in codes or rule in codes):
+            return True
+    return False
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Sequence[str] = ()) -> List[Finding]:
+    """Lint one source string; returns ALL findings with .suppressed set
+    (callers filter). Raises SyntaxError for unparsable input."""
+    tree = ast.parse(source, filename=path)
+    per_line, file_level = _suppressions(source)
+    rules = {k: v for k, v in RULES.items()
+             if not select or k in select}
+    findings: List[Finding] = []
+    for rule_id, check in sorted(rules.items()):
+        for raw in check(tree):
+            findings.append(Finding(
+                path=path, line=raw.line, col=raw.col, rule=raw.rule,
+                severity=raw.severity, message=raw.message,
+                suppressed=_is_suppressed(raw.line, raw.rule, per_line,
+                                          file_level)))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: Path, select: Sequence[str] = ()) -> List[Finding]:
+    return lint_source(path.read_text(encoding="utf-8"), str(path), select)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                if not (_SKIP_DIRS & set(sub.parts)):
+                    out.append(sub)
+        elif not path.exists():
+            # Checked before the suffix: a typo'd *.py argument must get
+            # this clean error, not a raw read_text traceback later.
+            raise FileNotFoundError(f"no such file or directory: {p}")
+        elif path.suffix == ".py":
+            out.append(path)
+        else:
+            # An existing non-.py file argument must not silently shrink
+            # the sweep to nothing — a green gate that linted nothing.
+            raise ValueError(
+                f"not a Python file or directory: {p} (hvdlint only "
+                "checks .py sources)")
+    return out
+
+
+def lint_paths(paths: Iterable[str],
+               select: Sequence[str] = ()) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        try:
+            findings.extend(lint_file(f, select))
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=str(f), line=e.lineno or 0, col=e.offset or 0,
+                rule="HVD000", severity="error",
+                message=f"syntax error: {e.msg}"))
+    return findings
+
+
+def main(argv: Sequence[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="Distributed-training static analysis "
+                    "(rules HVD001-HVD005; docs/static_analysis.md).")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, check in sorted(RULES.items()):
+            doc = (check.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule_id}  {doc}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given")
+
+    select = [s.strip().upper() for s in args.select.split(",") if s.strip()]
+    findings = lint_paths(args.paths, select)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    shown = findings if args.show_suppressed else active
+    for f in shown:
+        print(f.format())
+    print(f"hvdlint: {len(active)} finding(s), "
+          f"{len(suppressed)} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

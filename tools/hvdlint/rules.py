@@ -1,0 +1,464 @@
+"""The hvdlint rule catalogue: AST checks for the five distributed-training
+bug classes in this repo's incident history (see tools/hvdlint/__init__.py
+and docs/static_analysis.md for the case studies behind each rule).
+
+Every rule is a function ``(tree: ast.AST) -> list[RawFinding]``; the
+engine in core.py handles file walking, suppression comments, and exit
+codes. Rules are deliberately heuristic — a linter for dispatch-vs-sync
+or rank divergence cannot be sound AND complete — and tuned so the
+historical positives fire while the repo's legitimate patterns (deadline
+timers, root-prepares-payload branches, rebind-after-donation) stay
+silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, NamedTuple, Optional, Set, Tuple
+
+
+class RawFinding(NamedTuple):
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+
+# ---------------------------------------------------------------- helpers
+
+#: Wall-clock sources whose deltas are treated as timing measurements.
+TIMER_CALLS = {"perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns"}
+
+#: Callables that build a compiled/async-dispatching step function; a name
+#: bound to one of these becomes a "dispatch variable" in its scope.
+JIT_MAKERS = {"jit", "pjit", "spmd_fn", "windowed", "make_windowed_train_step"}
+
+#: Direct call names that asynchronously dispatch device work.
+DISPATCH_NAMES = {
+    "psum", "pmean", "pmin", "pmax", "psum_scatter", "all_gather",
+    "all_to_all", "allreduce", "allreduce_", "allreduce_async",
+    "allreduce_async_", "grouped_allreduce", "allgather", "allgather_async",
+    "allgatherv", "alltoall", "reducescatter", "allreduce_sparse",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "run_step", "train_step", "step_fn",
+}
+
+#: Calls that force (or directly perform) device synchronization. On the
+#: tunneled backend a bare block_until_ready only means completion after
+#: the process's first d2h pull — the *discipline* (one force_device_sync
+#: after warmup, utils/devsync.py) is what HVD001 checks for inside the
+#: timed region.
+SYNC_NAMES = {
+    "block_until_ready", "force_device_sync", "_force_sync", "window_sync",
+    "device_get", "synchronize", "wait",
+}
+
+#: Calls/attributes whose value differs per rank: branching on one of
+#: these makes control flow rank-divergent.
+RANK_SOURCE_NAMES = {
+    "rank", "local_rank", "cross_rank", "process_index", "axis_index",
+    "node_rank",
+}
+
+#: Collective operations: every rank of the world (or mesh axis) must
+#: execute these the same number of times in the same order.
+COLLECTIVE_NAMES = {
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "allgather", "allgather_async", "allgatherv",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "reducescatter", "allreduce_sparse", "psum", "pmean",
+    "pmin", "pmax", "psum_scatter", "all_gather", "all_to_all",
+    "process_allreduce", "process_allgather", "process_broadcast",
+    "barrier",
+}
+
+#: Resource-release method names: a class with any of these (or context
+#: manager exit) has a deterministic cleanup path beyond __del__.
+RELEASE_METHOD_NAMES = {
+    "release", "close", "shutdown", "stop", "free", "destroy", "__exit__",
+    "__aexit__",
+}
+
+#: Cleanup calls that must survive an exception in the preceding
+#: statements — i.e. belong in a finally (or context manager), not mid-try.
+CLEANUP_NAMES = {
+    "shutdown", "close", "stop", "terminate", "kill", "kill_all", "cleanup",
+}
+
+
+def trailing_name(func: ast.AST) -> Optional[str]:
+    """``jax.block_until_ready`` -> 'block_until_ready'; ``rank`` -> 'rank'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def iter_scopes(tree: ast.AST) -> Iterator[ast.AST]:
+    """Module + every (async) function definition."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node
+
+
+def scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """All AST nodes belonging to ``scope``, excluding nested functions
+    (which are their own scopes) but including nested statements."""
+    body = scope.body if isinstance(scope.body, list) else [scope.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def end_line(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", None) or node.lineno
+
+
+# ----------------------------------------------------------------- HVD001
+
+
+def check_hvd001(tree: ast.AST) -> List[RawFinding]:
+    """Un-synced timing: a perf_counter/monotonic bracket whose timed
+    region dispatches device work but contains no forced sync.
+
+    Timed regions are recognized as ``t0 = time.perf_counter()`` followed
+    (same scope) by a subtraction against ``t0``. Deadline arithmetic
+    (``time.monotonic() + timeout``) never registers a timer variable, so
+    launcher/watchdog timeouts stay silent.
+
+    Known limitation (deliberate): brackets split across methods via
+    instance attributes (``self._t0 = perf_counter()`` in one call, read
+    in a later call) are out of reach — the dispatch being timed
+    typically lives in a *different function or file* (the autotuner's
+    probe times dispatches made by spmd.py's handle), so no single-file
+    AST region exists to check. Those probes are guarded dynamically
+    instead: tests/test_autotune_jax.py asserts the tuner's clock read
+    happens only after a real d2h pull.
+    """
+    findings: List[RawFinding] = []
+    for scope in iter_scopes(tree):
+        nodes = list(scope_nodes(scope))
+        # Dispatch variables: names bound to jit/spmd_fn/... results.
+        dispatch_vars: Set[str] = set()
+        for node in nodes:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and trailing_name(node.value.func) in JIT_MAKERS):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        dispatch_vars.add(tgt.id)
+        # Timer variables: name -> line of the bare timer-call assignment.
+        # (Two passes: scope_nodes yields AST order, not source order.)
+        timer_starts: Dict[str, List[int]] = {}
+        for node in nodes:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and trailing_name(node.value.func) in TIMER_CALLS):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        timer_starts.setdefault(tgt.id, []).append(
+                            node.lineno)
+        reads: List[Tuple[str, int]] = []  # (timer var, read line)
+        for node in nodes:
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if (isinstance(node.right, ast.Name)
+                        and node.right.id in timer_starts):
+                    reads.append((node.right.id, node.lineno))
+        for var, read_line in reads:
+            starts = [l for l in timer_starts[var] if l < read_line]
+            if not starts:
+                continue
+            start_line = max(starts)  # innermost bracket
+            region = [
+                n for n in nodes
+                if isinstance(n, ast.Call)
+                and start_line < n.lineno <= read_line
+            ]
+            has_dispatch = any(
+                trailing_name(c.func) in DISPATCH_NAMES
+                or (isinstance(c.func, ast.Name)
+                    and c.func.id in dispatch_vars)
+                for c in region
+            )
+            has_sync = any(
+                trailing_name(c.func) in SYNC_NAMES for c in region
+            )
+            if has_dispatch and not has_sync:
+                findings.append(RawFinding(
+                    read_line, 0, "HVD001", "error",
+                    f"timed region (lines {start_line}-{read_line}) "
+                    "dispatches device work with no forced sync "
+                    "(block_until_ready / force_device_sync) inside the "
+                    "region; on an async backend this times dispatch, not "
+                    "the device (the round-5 measurement bug)"))
+    return findings
+
+
+# ----------------------------------------------------------------- HVD002
+
+
+def _mentions_rank(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            if trailing_name(sub.func) in RANK_SOURCE_NAMES:
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr in RANK_SOURCE_NAMES:
+                return True
+        elif isinstance(sub, ast.Name):
+            if sub.id in RANK_SOURCE_NAMES:
+                return True
+    return False
+
+
+def _collective_calls(nodes: List[ast.AST]) -> List[ast.Call]:
+    return [n for n in nodes
+            if isinstance(n, ast.Call)
+            and trailing_name(n.func) in COLLECTIVE_NAMES]
+
+
+def _subtree_nodes(stmts: List[ast.stmt]) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for s in stmts:
+        out.extend(ast.walk(s))
+    return out
+
+
+def check_hvd002(tree: ast.AST) -> List[RawFinding]:
+    """Collectives under rank-divergent control flow.
+
+    Two shapes: (a) a collective call lexically inside a branch taken
+    only by some ranks — the other ranks never enter the negotiation and
+    the job deadlocks; (b) a rank-guarded early ``return`` with a
+    collective later in the same function — same deadlock, different
+    spelling. Root-prepares-payload (``if rank()==root: buf[:] = ...``
+    with the collective *outside* the branch) is the legitimate pattern
+    and stays silent.
+    """
+    findings: List[RawFinding] = []
+    for scope in iter_scopes(tree):
+        nodes = list(scope_nodes(scope))
+        divergent_ifs = [
+            n for n in nodes
+            if isinstance(n, ast.If) and _mentions_rank(n.test)
+        ]
+        for if_node in divergent_ifs:
+            for branch in (if_node.body, if_node.orelse):
+                for call in _collective_calls(_subtree_nodes(branch)):
+                    findings.append(RawFinding(
+                        call.lineno, call.col_offset, "HVD002", "error",
+                        f"collective '{trailing_name(call.func)}' inside a "
+                        f"rank-divergent branch (if at line "
+                        f"{if_node.lineno}): ranks not taking this branch "
+                        "never join the collective -> deadlock"))
+            # (b) rank-guarded early return before a later collective.
+            for branch in (if_node.body, if_node.orelse):
+                rets = [s for s in branch if isinstance(s, ast.Return)]
+                if not rets:
+                    continue
+                later = [
+                    c for c in _collective_calls(nodes)
+                    if c.lineno > end_line(if_node)
+                ]
+                if later:
+                    findings.append(RawFinding(
+                        rets[0].lineno, rets[0].col_offset, "HVD002",
+                        "error",
+                        "rank-guarded early return skips the collective "
+                        f"'{trailing_name(later[0].func)}' at line "
+                        f"{later[0].lineno} on some ranks -> deadlock"))
+    # De-duplicate (nested ifs can report the same call twice).
+    seen: Set[Tuple[int, int, str]] = set()
+    out = []
+    for f in findings:
+        key = (f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# ----------------------------------------------------------------- HVD003
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """donate_argnums positions of a jit/pjit/spmd_fn call, if static."""
+    if trailing_name(call.func) not in JIT_MAKERS:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for elt in v.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    out.add(elt.value)
+            return out or None
+    return None
+
+
+def check_hvd003(tree: ast.AST) -> List[RawFinding]:
+    """Use-after-donation: a variable passed at a ``donate_argnums``
+    position of a locally-bound jitted callable is read again afterwards.
+    XLA invalidates the donated buffer, so the read returns garbage (or
+    errors) on hardware even when the CPU backend happens to tolerate
+    it. Rebinding the variable from the call result (``state =
+    f(state)``) is the supported pattern and kills tracking.
+    """
+    findings: List[RawFinding] = []
+    for scope in iter_scopes(tree):
+        nodes = list(scope_nodes(scope))
+        donators: Dict[str, Set[int]] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donators[tgt.id] = pos
+        if not donators:
+            continue
+        # All loads/stores of plain names, by line.
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in nodes:
+            if isinstance(node, ast.Name):
+                d = loads if isinstance(node.ctx, ast.Load) else stores
+                d.setdefault(node.id, []).append(node.lineno)
+        for node in nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donators):
+                continue
+            call_line = node.lineno
+            for i in donators[node.func.id]:
+                if i >= len(node.args) or not isinstance(node.args[i],
+                                                         ast.Name):
+                    continue
+                var = node.args[i].id
+                rebinds = [l for l in stores.get(var, [])
+                           if l >= call_line]
+                horizon = min(rebinds) if rebinds else None
+                for load_line in loads.get(var, []):
+                    if load_line <= call_line:
+                        continue
+                    if horizon is not None and load_line >= horizon:
+                        continue
+                    findings.append(RawFinding(
+                        load_line, 0, "HVD003", "error",
+                        f"'{var}' is read after being donated to "
+                        f"'{node.func.id}' (donate_argnums includes {i}) "
+                        f"at line {call_line}; the donated buffer is "
+                        "invalid after the call"))
+    # One finding per (line, var) is enough.
+    seen: Set[Tuple[int, str]] = set()
+    out = []
+    for f in findings:
+        key = (f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# ----------------------------------------------------------------- HVD004
+
+
+def check_hvd004(tree: ast.AST) -> List[RawFinding]:
+    """Resource release via ``__del__`` only: finalizer-based cleanup is
+    at the mercy of GC timing (reference cycles, delayed collection)
+    and is skipped entirely on interpreter teardown paths. A class
+    defining ``__del__`` must also offer deterministic release
+    (``release``/``close``/``shutdown``/``__exit__``/...); ``__del__``
+    stays as the backstop.
+    """
+    findings: List[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {
+            n.name for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if "__del__" in methods and not (methods & RELEASE_METHOD_NAMES):
+            dtor = next(n for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and n.name == "__del__")
+            findings.append(RawFinding(
+                dtor.lineno, dtor.col_offset, "HVD004", "warning",
+                f"class '{node.name}' releases resources only in "
+                "__del__; add a deterministic release()/close()/"
+                "context-manager path and keep __del__ as the backstop"))
+    return findings
+
+
+# ----------------------------------------------------------------- HVD005
+
+
+def check_hvd005(tree: ast.AST) -> List[RawFinding]:
+    """Cleanup in a ``try`` body that belongs in ``finally``: if any
+    earlier statement in the try raises, the shutdown/close never runs
+    while the except/finally paths execute — leaking the resource into
+    subsequent code (the ``_dryrun_hier_dp`` leak: hvd stayed
+    initialized after a failed assertion because ``hvd.shutdown()`` sat
+    in the try body while only the env-var restore was in finally).
+
+    A cleanup call that *is* the first statement of the try is the
+    guarded-cleanup idiom (``try: sock.close() except OSError: pass``)
+    and stays silent, as does a try whose finally (or handlers) repeat
+    the same cleanup.
+    """
+    findings: List[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try) or len(node.body) < 2:
+            continue
+        guard_stmts = node.finalbody if node.finalbody else [
+            s for h in node.handlers for s in h.body]
+        if not guard_stmts and not node.handlers:
+            continue
+        guarded_names = {
+            trailing_name(c.func)
+            for c in _subtree_nodes(guard_stmts)
+            if isinstance(c, ast.Call)
+        }
+        first_end = end_line(node.body[0])
+        for call in _subtree_nodes(node.body):
+            if not isinstance(call, ast.Call):
+                continue
+            name = trailing_name(call.func)
+            if name not in CLEANUP_NAMES or name in guarded_names:
+                continue
+            if call.lineno <= first_end:
+                continue  # guarded-cleanup idiom: try exists for the call
+            where = ("finally block still runs" if node.finalbody
+                     else "except handlers still run")
+            findings.append(RawFinding(
+                call.lineno, call.col_offset, "HVD005", "warning",
+                f"'{name}()' in the try body is skipped when an earlier "
+                f"statement raises, while the {where}; move the "
+                "cleanup into finally (guarded by an is-active check)"))
+    return findings
+
+
+RULES = {
+    "HVD001": check_hvd001,
+    "HVD002": check_hvd002,
+    "HVD003": check_hvd003,
+    "HVD004": check_hvd004,
+    "HVD005": check_hvd005,
+}
