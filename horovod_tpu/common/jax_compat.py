@@ -19,6 +19,10 @@ Polyfills:
 The ``jax.shard_map`` vs ``jax.experimental.shard_map`` (check_vma vs
 check_rep) split is resolved in :mod:`horovod_tpu.parallel.spmd`, next
 to its single call site.
+
+Pallas names are polyfilled lazily via :func:`pallas_tpu` (pallas is a
+heavy import most entrypoints never touch, so ``install()`` must not
+pay for it at package import).
 """
 
 from __future__ import annotations
@@ -39,3 +43,51 @@ def install() -> None:
         # lax re-exports live under jax.lax via the same module object;
         # nothing else to patch.
         assert hasattr(jax.lax, "axis_size")
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_name, to=None):
+            """Polyfill of lax.pcast for runtimes without vma typing:
+            pcast is identity-VALUED by contract (it only changes the
+            static varying-axes type), and on a runtime with no such
+            type system the identity is the whole operation."""
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
+
+    if not hasattr(jax, "shard_map"):
+        def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kwargs):
+            """Polyfill of the public jax.shard_map over its 0.4.x home
+            (jax.experimental.shard_map), mapping the current
+            ``check_vma`` kwarg onto the old ``check_rep`` (same
+            replication/varying check, renamed). Imports lazily: the
+            experimental module is not paid for at package import."""
+            import inspect
+
+            from jax.experimental.shard_map import shard_map as esm
+
+            if check_vma is not None:
+                key = ("check_vma"
+                       if "check_vma" in inspect.signature(esm).parameters
+                       else "check_rep")
+                kwargs[key] = check_vma
+            return esm(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+
+def pallas_tpu():
+    """``jax.experimental.pallas.tpu`` with current-jax names polyfilled.
+
+    Current jax spells the Mosaic compile options ``pltpu.CompilerParams``;
+    the 0.4.x era shipped the identical class as ``TPUCompilerParams``.
+    Alias only when missing (same never-override policy as install());
+    kernels import pltpu through this helper instead of directly.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+    return pltpu

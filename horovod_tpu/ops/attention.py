@@ -9,7 +9,12 @@ long-context support is a first-class extension of this rebuild (SURVEY
 
 ``flash_attention`` is a Pallas TPU kernel (online-softmax tiling so the
 L x L score matrix never materializes in HBM); off-TPU it runs in
-interpreter mode so tests cover the same code path.
+interpreter mode so tests cover the same code path. On the causal square
+path all three streamed kernels (forward, dQ, dK/dV) execute a PACKED
+at-or-below-diagonal grid — the strictly-masked half of the (q-block,
+k-block) plane never occupies a grid step, so neither its K/V DMA bytes
+nor its loop overhead is paid (closing the traffic debt PERF.md's
+"Streamed-causal K/V traffic tradeoff" recorded).
 """
 
 from __future__ import annotations
@@ -20,8 +25,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30  # finite stand-in for -inf: exp() of it is exactly 0
+
+# Measured dense/flash crossover on the LM lane (PERF.md round-5 honest
+# adjudication #2): dense still wins at seq 2048 (-6%), flash wins 1.31x
+# at seq 4096 and is the only structurally-compiling path beyond it.
+# ``bench.py --attention auto`` selects by this threshold so nobody
+# hand-picks the measured loser at either end.
+FLASH_ATTENTION_MIN_SEQ = 4096
 
 
 def dot_product_attention(q, k, v, causal: bool = False,
@@ -45,19 +58,149 @@ def dot_product_attention(q, k, v, causal: bool = False,
 
 
 # --------------------------------------------------------------------------
+# Causal grid truncation policy (shared by kernels + accounting)
+
+
+def _grid_truncates(causal: bool, seq_q: int, seq_k: int, q_offset: int,
+                    k_offset: int, truncate: Optional[bool]) -> bool:
+    """Static policy for the packed at-or-below-diagonal grid.
+
+    It applies exactly when the mask is the standard square lower
+    triangle: causal, Lq == Lk, equal global offsets. Cross-attention
+    (Lq != Lk) and global-offset causal (ring shard geometry) keep the
+    FULL grid with per-block compute skips — their diagonal can leave a
+    q-block with zero live k-blocks, which a packed grid cannot
+    represent (a block the grid never visits is never initialized or
+    written). ``truncate=None`` is the auto policy; ``False`` forces
+    the full grid (the truncated-vs-full A/B lanes); ``True`` asserts
+    eligibility instead of silently degrading.
+    """
+    eligible = causal and seq_q == seq_k and q_offset == k_offset
+    if truncate is None:
+        return eligible
+    if truncate and not eligible:
+        raise ValueError(
+            "truncate=True requires plain causal square attention "
+            f"(causal={causal}, Lq={seq_q}, Lk={seq_k}, "
+            f"q_offset={q_offset}, k_offset={k_offset}): cross-attention "
+            "and offset-causal grids stay full (compute-skip only)")
+    return bool(truncate)
+
+
+@functools.lru_cache(maxsize=None)
+def _causal_step_tables(n_qblocks: int, n_kblocks: int, block_q: int,
+                        block_k: int, k_major: bool = False):
+    """Scalar-prefetch step tables for the packed causal grid.
+
+    Enumerates ONLY the (q-block, k-block) pairs that intersect the
+    at-or-below-diagonal region (``qi*block_q + block_q - 1 >=
+    kb*block_k``) — on an n x n grid with square blocks that is
+    n(n+1)/2 of the n^2 full steps. q-major order streams k-blocks per
+    q-block (forward + dQ); ``k_major`` streams q-blocks per k-block
+    (dK/dV, whose dead region is the symmetric above-diagonal half over
+    the q axis). Square-causal only: every q-block's first live k-block
+    is 0 and every k-block's last live q-block is n_qblocks - 1, which
+    is what the kernels' init/finalize conditions assume.
+    """
+    pairs = []
+    if k_major:
+        for kb in range(n_kblocks):
+            # ceil((kb*bk - bq + 1) / bq) == floor(kb*bk / bq): the
+            # first q-block whose last row reaches this k-block.
+            pairs.extend((qi, kb)
+                         for qi in range((kb * block_k) // block_q,
+                                         n_qblocks))
+    else:
+        for qi in range(n_qblocks):
+            last = min(n_kblocks - 1,
+                       (qi * block_q + block_q - 1) // block_k)
+            pairs.extend((qi, kb) for kb in range(last + 1))
+    qi_tab = np.asarray([p[0] for p in pairs], np.int32)
+    kb_tab = np.asarray([p[1] for p in pairs], np.int32)
+    return qi_tab, kb_tab
+
+
+def flash_grid_info(seq_q: int, seq_k: int, *, causal: bool,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    q_offset: int = 0, k_offset: int = 0,
+                    truncate: Optional[bool] = None,
+                    head_dim: Optional[int] = None,
+                    batch_heads: int = 1, dtype_bytes: int = 2):
+    """Static grid + K/V-DMA accounting for a ``flash_attention`` call.
+
+    Mirrors exactly the tiling (:func:`_default_blocks`) and truncation
+    (:func:`_grid_truncates`) policy the kernels use, without tracing
+    anything — ``bench.py`` stamps this into the flash-lane JSON and
+    ``tools/tpu_flash_check.py`` into its micro A/B report so every
+    wall-time record is attributable to a concrete grid, not just a
+    block pair.
+
+    Returns a dict: chosen blocks, grid shape, per-``batch_heads``-step
+    counts (``steps`` vs ``steps_full``), ``kv_fetch_frac`` (the
+    truncated/full step ratio — (n+1)/2n on a causal square grid), and
+    — when ``head_dim`` is given — the estimated K/V bytes the grid
+    DMAs in (one [block_k, head_dim] tile each for K and V per step,
+    times ``batch_heads``).
+    """
+    dq, dk = _default_blocks(seq_q, seq_k)
+    bq = min(block_q if block_q is not None else dq, seq_q)
+    bk = min(block_k if block_k is not None else dk, seq_k)
+    nqb, nkb = seq_q // bq, seq_k // bk
+    truncated = _grid_truncates(causal, seq_q, seq_k, q_offset, k_offset,
+                                truncate)
+    steps_full = nqb * nkb
+    if truncated:
+        qi_tab, _ = _causal_step_tables(nqb, nkb, bq, bk)
+        steps = int(qi_tab.size)
+    else:
+        steps = steps_full
+    info = {
+        "block_q": bq, "block_k": bk,
+        "n_qblocks": nqb, "n_kblocks": nkb,
+        "truncated": truncated,
+        "grid": ([batch_heads, steps] if truncated
+                 else [batch_heads, nqb, nkb]),
+        "steps": steps, "steps_full": steps_full,
+        "kv_fetch_frac": round(steps / steps_full, 4),
+        "kv_bytes": None, "kv_bytes_full": None,
+    }
+    if head_dim is not None:
+        tile = 2 * bk * head_dim * dtype_bytes * batch_heads
+        info["kv_bytes"] = steps * tile
+        info["kv_bytes_full"] = steps_full * tile
+    return info
+
+
+# --------------------------------------------------------------------------
 # Pallas flash attention
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, block_k: int, n_kblocks: int, causal: bool,
-                  scale: float, block_q: int):
-    """One (batch*head, q-block, K-BLOCK) grid step: the key axis rides
-    the grid (innermost, "arbitrary" semantics), so Mosaic's pipeline
-    streams [block_k, d] K/V tiles through double-buffered VMEM DMA
-    while the online-softmax state (m/l/acc) persists in VMEM scratch
-    across the k steps. VMEM is O(block) — the previous design mapped
-    the FULL [Lk, d] K/V into each program's VMEM, which hit the 16 MB
-    scoped limit at seq 16384 (tools/diag_seq16384.log: 16.25M > 16M).
+def _flash_kernel(*refs, block_k: int, n_kblocks: int, causal: bool,
+                  scale: float, block_q: int, delta: int, packed: bool):
+    """One streamed-forward grid step. Two grid layouts share this body:
+
+    * full (``packed=False``) — grid (batch*head, q-block, K-BLOCK): the
+      key axis rides the grid (innermost, "arbitrary" semantics), so
+      Mosaic's pipeline streams [block_k, d] K/V tiles through
+      double-buffered VMEM DMA while the online-softmax state (m/l/acc)
+      persists in VMEM scratch across the k steps. Causal dead blocks
+      skip their COMPUTE only — their K/V DMA is pipelined regardless.
+    * packed (``packed=True``) — grid (batch*head, STEP) over the
+      scalar-prefetched (q-block, k-block) tables of
+      :func:`_causal_step_tables`: causal square grids enumerate only
+      the at-or-below-diagonal pairs, so the dead half's DMA bytes and
+      loop steps never exist. Every enumerated step is live — no
+      compute skip needed; the diagonal block still applies the
+      in-block row mask.
+
+    ``delta = q_offset - k_offset`` shifts the causal mask for
+    global-offset callers (always 0 on the packed path, which
+    _grid_truncates restricts to equal offsets).
+
+    VMEM is O(block) — the pre-streaming design mapped the FULL [Lk, d]
+    K/V into each program's VMEM, which hit the 16 MB scoped limit at
+    seq 16384 (tools/diag_seq16384.log: 16.25M > 16M).
 
     Mosaic discipline: every ref and all scratch is kept 2-D
     ([block_q, 1] for the m/l statistics, and the SAME [block_q, 1]
@@ -66,9 +209,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     Mosaic-unsupported reshape that interpret-mode CI cannot catch)."""
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(1)
-    kb = pl.program_id(2)
+    if packed:
+        qi_tab, kb_tab = refs[:2]
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs[2:]
+        t = pl.program_id(1)
+        qi = qi_tab[t]
+        kb = kb_tab[t]
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        qi = pl.program_id(1)
+        kb = pl.program_id(2)
 
+    # k-block 0 is the first step of every q-block in BOTH layouts (the
+    # packed tables' q-major walk always starts a q-block at kb == 0).
     @pl.when(kb == 0)
     def _init():
         m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
@@ -87,7 +242,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         s = jnp.dot(q, k_blk.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = delta + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -103,14 +258,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and not packed:
         # A k-block strictly past this q-block's last row is fully
         # masked: skip its compute (its DMA is pipelined regardless).
-        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
+        pl.when(qi * block_q + block_q - 1 + delta
+                >= kb * block_k)(_compute)
     else:
-        _compute()
+        _compute()  # packed grids enumerate live steps only
 
-    @pl.when(kb == n_kblocks - 1)
+    if packed:
+        last_kb = jnp.minimum(n_kblocks - 1,
+                              (qi * block_q + block_q - 1) // block_k)
+    else:
+        last_kb = n_kblocks - 1
+
+    @pl.when(kb == last_kb)
     def _finalize():
         l = jnp.maximum(l_scr[...], 1e-30)
         o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
@@ -169,13 +331,16 @@ _FLASH_BWD_ENV_DEFAULT = __import__("os").environ.get("HVD_FLASH_BWD", "")
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "bwd_impl"))
+                                             "bwd_impl", "q_offset",
+                                             "k_offset", "truncate"))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
-                    bwd_impl: Optional[str] = None):
+                    bwd_impl: Optional[str] = None,
+                    q_offset: int = 0, k_offset: int = 0,
+                    truncate: Optional[bool] = None):
     """Pallas flash attention. Shapes [B, L, H, D] -> [B, L, H, D].
 
     Sequence lengths must be multiples of the block sizes (pad upstream).
@@ -184,10 +349,26 @@ def flash_attention(q, k, v, causal: bool = False,
     ``interpret`` defaults to True off-TPU so the same kernel is testable
     on the CPU mesh.
 
+    ``q_offset``/``k_offset`` (static) are the global positions of the
+    first query/key token, matching :func:`dot_product_attention` — so
+    sequence-parallel shims can call the kernel on a shard and keep the
+    causal mask globally aligned. Plain causal square attention (equal
+    offsets, Lq == Lk) executes a PACKED at-or-below-diagonal grid:
+    ~(n+1)/2n of the full grid's steps, eliminating the dead half's K/V
+    DMA bytes along with its loop overhead. Offset/rectangular causal
+    keeps the full grid with per-block compute skips, and requires
+    q_offset >= k_offset (every query row must see at least one key —
+    rows with none have no defined softmax). ``truncate=False``
+    forces the full grid (the truncated-vs-full A/B lanes);
+    ``truncate=True`` asserts eligibility; the accounting twin is
+    :func:`flash_grid_info`.
+
     Differentiable: the backward is two Pallas kernels (the
     FlashAttention-2 dQ / dK+dV split), recomputing scores blockwise
     against the forward's persisted logsumexp with O(block) VMEM per
     program — the [Lq, Lk] matrix is never materialized in either pass;
+    both backward kernels ride the same truncated grid (the dK/dV dead
+    region is the symmetric above-diagonal half over the q axis);
     gradient exactness vs the dense reference is pinned in
     tests/test_parallel.py::TestFlashAttention."""
     if scale is None:
@@ -204,27 +385,46 @@ def flash_attention(q, k, v, causal: bool = False,
     if bwd_impl not in ("auto", "scan", "pallas"):
         raise ValueError(f"bwd_impl must be auto|scan|pallas, "
                          f"got {bwd_impl!r}")
+    if causal and q_offset < k_offset:
+        # Query rows before the first key have NO unmasked key: their
+        # softmax is undefined, and the kernels' 0-output would
+        # silently diverge from the dense reference's degenerate
+        # uniform-over-NEG_INF rows. A block-parallel caller whose
+        # geometry straddles the diagonal this way needs partial-block
+        # lse merging (the ring recurrence), not plain flash.
+        raise ValueError(
+            f"causal flash_attention requires q_offset >= k_offset "
+            f"(got {q_offset} < {k_offset}): rows with no visible key "
+            f"have no defined softmax")
     return _flash(q, k, v, causal, float(scale), block_q, block_k,
-                  interpret, bwd_impl)
+                  interpret, bwd_impl, int(q_offset), int(k_offset),
+                  truncate)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bwd_impl):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bwd_impl,
+           q_offset, k_offset, truncate):
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, q_offset, k_offset, truncate)
     return out
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   q_offset=0, k_offset=0, truncate=None):
     """Returns (out [B, Lq, H, D], lse [B, H, Lq])."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+
+    from horovod_tpu.common.jax_compat import pallas_tpu
+    pltpu = pallas_tpu()
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     block_q = min(block_q, Lq)
     block_k = min(block_k, Lk)
     assert Lq % block_q == 0 and Lk % block_k == 0, (Lq, Lk, block_q, block_k)
+    delta = q_offset - k_offset
+    truncated = _grid_truncates(causal, Lq, Lk, q_offset, k_offset, truncate)
 
     # Collapse (B, H) into the grid's first axis; put seq minor-most for
     # contiguous VMEM tiles.
@@ -232,67 +432,129 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kr = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
     vr = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
 
+    n_qblocks = Lq // block_q
     n_kblocks = Lk // block_k
-    kernel = functools.partial(_flash_kernel, block_k=block_k,
-                               n_kblocks=n_kblocks, causal=causal,
-                               scale=scale, block_q=block_q)
-    out, lse = pl.pallas_call(
-        kernel,
-        # K blocks ride the grid's INNERMOST axis: sequential
-        # ("arbitrary") so the scratch-carried softmax state is legal,
-        # while Mosaic double-buffers the [block_k, D] K/V tile DMAs.
-        grid=(B * H, Lq // block_q, n_kblocks),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qb, kb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_k, D), lambda bh, qb, kb: (bh, kb, 0)),
-            pl.BlockSpec((None, block_k, D), lambda bh, qb, kb: (bh, kb, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qb, kb: (bh, qb, 0)),
-            # [block_q, 1] column per program — the statistics' native
-            # layout (see the kernel's Mosaic-discipline note); the
-            # trailing singleton is dropped OUTSIDE the kernel where a
-            # relayout is just an XLA reshape.
-            pl.BlockSpec((None, block_q, 1), lambda bh, qb, kb: (bh, qb, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Lq, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum l
-            pltpu.VMEM((block_q, D), jnp.float32),   # output accumulator
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qr, kr, vr)
+    out_shape = [
+        jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        jax.ShapeDtypeStruct((B * H, Lq, 1), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+        pltpu.VMEM((block_q, 1), jnp.float32),   # running sum l
+        pltpu.VMEM((block_q, D), jnp.float32),   # output accumulator
+    ]
+    if truncated:
+        qi_tab, kb_tab = _causal_step_tables(n_qblocks, n_kblocks,
+                                             block_q, block_k)
+        kernel = functools.partial(_flash_kernel, block_k=block_k,
+                                   n_kblocks=n_kblocks, causal=causal,
+                                   scale=scale, block_q=block_q,
+                                   delta=0, packed=True)
+        # The STEP axis enumerates only the live at-or-below-diagonal
+        # (q-block, k-block) pairs — ~(n+1)/2n of the full causal grid.
+        # Still sequential ("arbitrary") so the scratch-carried softmax
+        # state is legal, and Mosaic double-buffers exactly the
+        # [block_k, D] K/V tile DMAs the mask actually needs; the
+        # block indices come off the scalar-prefetched tables.
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, int(qi_tab.size)),
+            in_specs=[
+                pl.BlockSpec((None, block_q, D),
+                             lambda bh, t, qi, kb: (bh, qi[t], 0)),
+                pl.BlockSpec((None, block_k, D),
+                             lambda bh, t, qi, kb: (bh, kb[t], 0)),
+                pl.BlockSpec((None, block_k, D),
+                             lambda bh, t, qi, kb: (bh, kb[t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, D),
+                             lambda bh, t, qi, kb: (bh, qi[t], 0)),
+                # [block_q, 1] column per program — the statistics'
+                # native layout (see the kernel's Mosaic-discipline
+                # note); the trailing singleton is dropped OUTSIDE the
+                # kernel where a relayout is just an XLA reshape.
+                pl.BlockSpec((None, block_q, 1),
+                             lambda bh, t, qi, kb: (bh, qi[t], 0)),
+            ],
+            scratch_shapes=scratch,
+        )
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(qi_tab), jnp.asarray(kb_tab), qr, kr, vr)
+    else:
+        kernel = functools.partial(_flash_kernel, block_k=block_k,
+                                   n_kblocks=n_kblocks, causal=causal,
+                                   scale=scale, block_q=block_q,
+                                   delta=delta, packed=False)
+        out, lse = pl.pallas_call(
+            kernel,
+            # K blocks ride the grid's INNERMOST axis: sequential
+            # ("arbitrary") so the scratch-carried softmax state is
+            # legal, while Mosaic double-buffers the [block_k, D] K/V
+            # tile DMAs.
+            grid=(B * H, n_qblocks, n_kblocks),
+            in_specs=[
+                pl.BlockSpec((None, block_q, D),
+                             lambda bh, qb, kb: (bh, qb, 0)),
+                pl.BlockSpec((None, block_k, D),
+                             lambda bh, qb, kb: (bh, kb, 0)),
+                pl.BlockSpec((None, block_k, D),
+                             lambda bh, qb, kb: (bh, kb, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, D),
+                             lambda bh, qb, kb: (bh, qb, 0)),
+                pl.BlockSpec((None, block_q, 1),
+                             lambda bh, qb, kb: (bh, qb, 0)),
+            ],
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qr, kr, vr)
     return (out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3),
             lse.reshape(B, H, Lq))
 
 
 def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret,
-                   bwd_impl):
+                   bwd_impl, q_offset, k_offset, truncate):
     o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, q_offset, k_offset, truncate)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
-                         dq_ref, dq_scr, *, causal: bool, scale: float,
-                         block_q: int, block_k: int, n_kblocks: int):
-    """dQ: grid (batch*head, q-block, K-BLOCK stream). Standard
-    FlashAttention-2 recurrence against the forward's persisted
-    logsumexp:
+def _flash_bwd_dq_kernel(*refs, causal: bool, scale: float, block_q: int,
+                         block_k: int, n_kblocks: int, delta: int,
+                         packed: bool):
+    """dQ: full grid (batch*head, q-block, K-BLOCK stream) or the packed
+    q-major causal grid (batch*head, STEP) — same layout split as
+    :func:`_flash_kernel`. Standard FlashAttention-2 recurrence against
+    the forward's persisted logsumexp:
         P_ij = exp(S_ij - lse_i);  dS_ij = P_ij * (dO_i V_j^T - D_i)
         dQ_i = sum_j dS_ij K_j * scale
     The k axis rides the grid (sequential) with the dQ accumulator in
     VMEM scratch — same O(block) VMEM shape as the forward kernel."""
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(1)
-    kb = pl.program_id(2)
+    if packed:
+        qi_tab, kb_tab = refs[:2]
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+         dq_ref, dq_scr) = refs[2:]
+        t = pl.program_id(1)
+        qi = qi_tab[t]
+        kb = kb_tab[t]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+         dq_ref, dq_scr) = refs
+        qi = pl.program_id(1)
+        kb = pl.program_id(2)
 
     @pl.when(kb == 0)
     def _init():
@@ -306,7 +568,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         do_blk = do_ref[...]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = delta + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -317,29 +579,51 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         dq_scr[...] += jnp.dot(ds.astype(k_blk.dtype), k_blk,
                                preferred_element_type=jnp.float32) * scale
 
-    if causal:
-        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
+    if causal and not packed:
+        pl.when(qi * block_q + block_q - 1 + delta
+                >= kb * block_k)(_compute)
     else:
-        _compute()
+        _compute()  # packed grids enumerate live steps only
 
-    @pl.when(kb == n_kblocks - 1)
+    if packed:
+        last_kb = jnp.minimum(n_kblocks - 1,
+                              (qi * block_q + block_q - 1) // block_k)
+    else:
+        last_kb = n_kblocks - 1
+
+    @pl.when(kb == last_kb)
     def _finalize():
         dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                          scale: float, block_q: int, block_k: int,
-                          n_qblocks: int):
-    """dK/dV: grid (batch*head, k-block, Q-BLOCK stream), transposing
-    the dQ kernel's roles:
+def _flash_bwd_dkv_kernel(*refs, causal: bool, scale: float, block_q: int,
+                          block_k: int, n_qblocks: int, delta: int,
+                          packed: bool):
+    """dK/dV: full grid (batch*head, k-block, Q-BLOCK stream) or the
+    packed K-MAJOR causal grid — transposing the dQ kernel's roles, so
+    the truncated region is the symmetric above-diagonal half over the
+    q axis (each k-block's stream starts at its diagonal q-block):
         dV_j = sum_i P_ij^T dO_i;  dK_j = sum_i dS_ij^T Q_i * scale"""
     from jax.experimental import pallas as pl
 
-    kb = pl.program_id(1)
-    qi = pl.program_id(2)
+    if packed:
+        qi_tab, kb_tab = refs[:2]
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs[2:]
+        t = pl.program_id(1)
+        qi = qi_tab[t]
+        kb = kb_tab[t]
+        # First live q-block of this k-block's stream: the diagonal
+        # (matches _causal_step_tables' k-major start).
+        first_qi = (kb * block_k) // block_q
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        kb = pl.program_id(1)
+        qi = pl.program_id(2)
+        first_qi = 0
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first_qi)
     def _init():
         dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
@@ -352,7 +636,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         do_blk = do_ref[...]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = delta + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -365,12 +649,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         dk_scr[...] += jnp.dot(ds.T.astype(q.dtype), q,
                                preferred_element_type=jnp.float32) * scale
 
-    if causal:
+    if causal and not packed:
         # Q-blocks fully ABOVE the diagonal (every q_pos < every k_pos)
         # contribute nothing to this k-block.
-        pl.when(qi * block_q + block_q - 1 >= kb * block_k)(_compute)
+        pl.when(qi * block_q + block_q - 1 + delta
+                >= kb * block_k)(_compute)
     else:
-        _compute()
+        _compute()  # packed grids enumerate live steps only
 
     @pl.when(qi == n_qblocks - 1)
     def _finalize():
@@ -378,7 +663,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_scan(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd_scan(causal, scale, block_q, block_k, interpret,
+                    q_offset, k_offset, truncate, res, do):
     """XLA lax.scan backward (the pre-round-5 implementation, kept as a
     selectable path): one batched einsum pass per key block computing
     dq/dk/dv together. At seq <= ~4096 its [B, H, Lq, block_k] einsum
@@ -386,20 +672,31 @@ def _flash_bwd_scan(causal, scale, block_q, block_k, interpret, res, do):
     the kernel split (10.45M vs 9.68M tok/s at seq 2048, PERF.md r5);
     at long seq those slabs become multi-hundred-MB HBM round-trips
     per block step. Selected by ``HVD_FLASH_BWD=scan`` or
-    automatically at short key lengths (see _flash_bwd_vjp)."""
+    automatically at short key lengths (see _flash_bwd_vjp). Already
+    grid-truncated by construction: the causal scan walks only the
+    k-blocks at or below the last query row's diagonal (``truncate``
+    is accepted for signature parity and ignored)."""
+    del truncate  # no grid to truncate: the scan bound below early-exits
     q, k, v, o, lse = res
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     bk = min(block_k, Lk)
     nkb = Lk // bk
-    nkb_live = min(nkb, -(-Lq // bk)) if causal else nkb
+    delta = q_offset - k_offset
+    if causal:
+        # Keys past the last query row's global position are dead for
+        # every row; at least one block stays so the scan is non-empty.
+        nkb_live = min(nkb, max(0, delta + Lq - 1) // bk + 1)
+        nkb_live = max(1, nkb_live)
+    else:
+        nkb_live = nkb
     # Einsums run in the input dtype with f32 accumulation
     # (preferred_element_type) — bf16 inputs keep the MXU's native
     # path; f32 test inputs keep CI exactness. Softmax stats stay f32.
     f32 = jnp.float32
     d_row = jnp.sum(do.astype(f32) * o.astype(f32), axis=-1)  # [B, Lq, H]
     d_row = d_row.transpose(0, 2, 1)                           # [B, H, Lq]
-    q_pos = jnp.arange(Lq)[:, None]
+    q_pos = delta + jnp.arange(Lq)[:, None]
 
     def bwd_step(dq, jb):
         kb = jax.lax.dynamic_slice_in_dim(k, jb * bk, bk, 1)
@@ -432,7 +729,8 @@ def _flash_bwd_scan(causal, scale, block_q, block_k, interpret, res, do):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret,
+                      q_offset, k_offset, truncate, res, do):
     """Flash backward as two Pallas kernels (FlashAttention-2 split):
     a dQ kernel streaming k-blocks and a dK/dV kernel streaming
     q-blocks, both against the forward's persisted logsumexp and the
@@ -441,10 +739,16 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
     scales to the same contexts the streamed forward unlocked (the
     prior lax.scan backward materialized [B, H, Lq, block_k] slabs in
     HBM per step — 2 GB at seq 16k — and serialized the k-block walk).
-    For causal rectangular Lq != Lk, blocks entirely on the masked side
-    of the diagonal skip their compute in both kernels."""
+    On the causal square path both kernels ride the PACKED grid of
+    :func:`_causal_step_tables` (q-major for dQ, k-major for dK/dV), so
+    the dead half of each grid — ~2x the K/V and Q/dO bytes actually
+    needed — is never DMA'd. For causal rectangular/offset Lq != Lk the
+    grids stay full and blocks entirely on the masked side of the
+    diagonal skip their compute only."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+
+    from horovod_tpu.common.jax_compat import pallas_tpu
+    pltpu = pallas_tpu()
 
     q, k, v, o, lse = res
     B, Lq, H, D = q.shape
@@ -453,6 +757,8 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
     bk = min(block_k, Lk)
     assert Lq % bq == 0 and Lk % bk == 0, (Lq, Lk, bq, bk)
     nqb, nkb = Lq // bq, Lk // bk
+    delta = q_offset - k_offset
+    truncated = _grid_truncates(causal, Lq, Lk, q_offset, k_offset, truncate)
 
     qr = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
     kr = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
@@ -465,47 +771,98 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
                     * o.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
                     .astype(jnp.float32), axis=-1, keepdims=True)
 
-    qspec = pl.BlockSpec((None, bq, D), lambda bh, i, j: (bh, i, 0))
-    kspec = pl.BlockSpec((None, bk, D), lambda bh, i, j: (bh, j, 0))
-    col_q = pl.BlockSpec((None, bq, 1), lambda bh, i, j: (bh, i, 0))
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, causal=causal, scale=scale, block_q=bq,
+        block_k=bk, n_kblocks=nkb, delta=0 if truncated else delta,
+        packed=truncated)
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq,
+        block_k=bk, n_qblocks=nqb, delta=0 if truncated else delta,
+        packed=truncated)
+    dq_out_shape = jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype)
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((B * H, Lk, D), k.dtype),
+        jax.ShapeDtypeStruct((B * H, Lk, D), v.dtype),
+    ]
 
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk, n_kblocks=nkb),
-        grid=(B * H, nqb, nkb),
-        in_specs=[qspec, kspec, kspec, qspec, col_q, col_q],
-        out_specs=pl.BlockSpec((None, bq, D), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qr, kr, vr, dor, lser, d_row)
+    if truncated:
+        # Packed causal grids: q-major steps for dQ (k-blocks stream
+        # within a q-block), k-major for dK/dV (q-blocks stream within
+        # a k-block, starting at the diagonal).
+        qi_q, kb_q = _causal_step_tables(nqb, nkb, bq, bk)
+        qi_k, kb_k = _causal_step_tables(nqb, nkb, bq, bk, k_major=True)
+        qspec = pl.BlockSpec((None, bq, D),
+                             lambda bh, t, qi, kb: (bh, qi[t], 0))
+        kspec = pl.BlockSpec((None, bk, D),
+                             lambda bh, t, qi, kb: (bh, kb[t], 0))
+        col_q = pl.BlockSpec((None, bq, 1),
+                             lambda bh, t, qi, kb: (bh, qi[t], 0))
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B * H, int(qi_q.size)),
+                in_specs=[qspec, kspec, kspec, qspec, col_q, col_q],
+                out_specs=qspec,
+                scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)]),
+            out_shape=dq_out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(qi_q), jnp.asarray(kb_q), qr, kr, vr, dor, lser,
+          d_row)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B * H, int(qi_k.size)),
+                in_specs=[qspec, kspec, kspec, qspec, col_q, col_q],
+                out_specs=[kspec, kspec],
+                scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                                pltpu.VMEM((bk, D), jnp.float32)]),
+            out_shape=dkv_out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(qi_k), jnp.asarray(kb_k), qr, kr, vr, dor, lser,
+          d_row)
+    else:
+        qspec = pl.BlockSpec((None, bq, D), lambda bh, i, j: (bh, i, 0))
+        kspec = pl.BlockSpec((None, bk, D), lambda bh, i, j: (bh, j, 0))
+        col_q = pl.BlockSpec((None, bq, 1), lambda bh, i, j: (bh, i, 0))
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B * H, nqb, nkb),
+            in_specs=[qspec, kspec, kspec, qspec, col_q, col_q],
+            out_specs=pl.BlockSpec((None, bq, D),
+                                   lambda bh, i, j: (bh, i, 0)),
+            out_shape=dq_out_shape,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qr, kr, vr, dor, lser, d_row)
 
-    # dK/dV grid transposes the stream: (bh, k-block, q-stream).
-    qspec_t = pl.BlockSpec((None, bq, D), lambda bh, j, i: (bh, i, 0))
-    kspec_t = pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0))
-    col_q_t = pl.BlockSpec((None, bq, 1), lambda bh, j, i: (bh, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                          scale=scale, block_q=bq, block_k=bk,
-                          n_qblocks=nqb),
-        grid=(B * H, nkb, nqb),
-        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, col_q_t, col_q_t],
-        out_specs=[
-            pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Lk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Lk, D), v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qr, kr, vr, dor, lser, d_row)
+        # dK/dV grid transposes the stream: (bh, k-block, q-stream).
+        qspec_t = pl.BlockSpec((None, bq, D), lambda bh, j, i: (bh, i, 0))
+        kspec_t = pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0))
+        col_q_t = pl.BlockSpec((None, bq, 1), lambda bh, j, i: (bh, i, 0))
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(B * H, nkb, nqb),
+            in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, col_q_t,
+                      col_q_t],
+            out_specs=[
+                pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((None, bk, D), lambda bh, j, i: (bh, j, 0)),
+            ],
+            out_shape=dkv_out_shape,
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qr, kr, vr, dor, lser, d_row)
 
     def unflat(t, L):
         return t.reshape(B, H, L, D).transpose(0, 2, 1, 3)
@@ -518,8 +875,26 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, interpret, res, do):
 _FLASH_BWD_PALLAS_MIN_LK = 8192
 
 
+def resolve_bwd_impl(bwd_impl: Optional[str], seq_k: int) -> str:
+    """The backward implementation a flash_attention call will actually
+    run, mirroring flash_attention's own dispatch: None defers to the
+    HVD_FLASH_BWD import-time env default, then "auto" picks the
+    measured crossover — the scan backward below the
+    _FLASH_BWD_PALLAS_MIN_LK key length, the Pallas kernel split
+    at/above. Public so bench.py can stamp the RESOLVED backward into
+    flash-lane records: the truncated-vs-full grid A/B only spans the
+    backward when this says "pallas" (the scan walk is
+    diagonal-truncated by construction on both sides)."""
+    if bwd_impl is None:
+        bwd_impl = _FLASH_BWD_ENV_DEFAULT or "auto"
+    if bwd_impl == "auto":
+        return ("pallas" if seq_k >= _FLASH_BWD_PALLAS_MIN_LK
+                else "scan")
+    return bwd_impl
+
+
 def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, bwd_impl,
-                   res, do):
+                   q_offset, k_offset, truncate, res, do):
     """Backward dispatch, measured not assumed (PERF.md round 5): the
     scan backward's batched einsums win at short key lengths; the
     O(block)-VMEM kernel split is required at long ones (the scan's
@@ -527,12 +902,10 @@ def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, bwd_impl,
     arrives as a static ("auto"|"scan"|"pallas") from flash_attention —
     part of the trace key, so selection can never desync from a cached
     trace."""
-    impl = bwd_impl
-    if impl == "auto":
-        impl = ("pallas" if res[1].shape[1] >= _FLASH_BWD_PALLAS_MIN_LK
-                else "scan")
+    impl = resolve_bwd_impl(bwd_impl, res[1].shape[1])
     fn = _flash_bwd_pallas if impl == "pallas" else _flash_bwd_scan
-    return fn(causal, scale, block_q, block_k, interpret, res, do)
+    return fn(causal, scale, block_q, block_k, interpret,
+              q_offset, k_offset, truncate, res, do)
 
 
 _flash.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

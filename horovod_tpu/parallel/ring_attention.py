@@ -7,7 +7,10 @@ accumulates its queries' attention over each visiting block with the
 online-softmax (flash) recurrence. Peak memory is O(L_local^2) per step
 instead of O(L^2), and the ICI transfer of the next block overlaps the
 current block's compute (XLA schedules the ppermute concurrently with the
-einsums — the Pallas guide's ring-collective pattern).
+einsums — the Pallas guide's ring-collective pattern). In causal mode a
+visiting block entirely above this shard's diagonal skips its compute
+(the ring-level twin of the flash kernels' causal grid truncation); the
+rotation itself is never skipped — collectives stay rank-uniform.
 
 Use inside ``shard_map``/``spmd_run`` with the sequence axis sharded, e.g.
 ``in_specs=P(None, "sp", None, None)`` for [B, L, H, D].
@@ -26,13 +29,21 @@ from horovod_tpu.ops.attention import NEG_INF
 
 
 def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   skip_dead_blocks: Optional[bool] = None):
     """Exact multi-head attention over a sequence-sharded mesh axis.
 
     Shapes (per chip): q, k, v [B, L_local, H, D] -> [B, L_local, H, D].
     Must run inside a shard_map region with ``axis`` active. Causal masks
     use global token positions, so results match single-chip attention on
     the gathered sequence exactly.
+
+    ``skip_dead_blocks`` (causal only) conditionally skips the einsums
+    for visiting blocks entirely above this shard's diagonal. The
+    default (None) enables it exactly when the runtime's vma typing can
+    transpose the rank-divergent cond (see the in-loop note); the
+    explicit values exist for A/B and for CI on legacy runtimes, where
+    the cond path is only legal under ``check_vma=False`` regions.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -47,24 +58,53 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     def step(p, carry):
         k_blk, v_blk, m, l, acc = carry
         src = (rank - p) % size  # owner of the block currently held
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-        if causal:
-            q_pos = rank * Lq + jnp.arange(Lq)[:, None]
-            k_pos = src * Lk + jnp.arange(Lk)[None, :]
-            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p_exp = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + jnp.sum(p_exp, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p_exp, v_blk.astype(jnp.float32))
+
+        def _update(operand):
+            k_b, v_b, m, l, acc = operand
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_b.astype(jnp.float32))
+            if causal:
+                q_pos = rank * Lq + jnp.arange(Lq)[:, None]
+                k_pos = src * Lk + jnp.arange(Lk)[None, :]
+                s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p_exp = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + jnp.sum(p_exp, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p_exp, v_b.astype(jnp.float32))
+            return m_new, l_new, acc_new
+
+        if causal and skip_dead_blocks:
+            # The dead half of the causal ring: a visiting block whose
+            # FIRST global key position is past this shard's LAST query
+            # row is fully masked — skip its einsums and rescale
+            # outright (same at-or-below-diagonal discipline as the
+            # flash kernels' truncated grid; ~half the ring steps on a
+            # causal square). Only the local compute is conditional:
+            # the ppermute rotation below stays unconditional, since
+            # every rank must feed the collective on every step. Off by
+            # default on legacy (no-vma-typing) runtimes: the check_rep
+            # machinery cannot unify this rank-divergent cond's
+            # TRANSPOSE (dead-branch symbolic-zero cotangents type
+            # replicated), so there the unconditional — numerically
+            # identical — masked update runs instead; CI still pins the
+            # cond path through check_vma=False regions.
+            has_live = rank * Lq + Lq - 1 >= src * Lk
+            m, l, acc = lax.cond(has_live, _update,
+                                 lambda operand: operand[2:],
+                                 (k_blk, v_blk, m, l, acc))
+        else:
+            m, l, acc = _update((k_blk, v_blk, m, l, acc))
         # Rotate K/V to the next chip; the final rotation returns blocks
         # home, keeping the loop body uniform for lax.fori_loop.
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
-        return k_next, v_next, m_new, l_new, acc_new
+        return k_next, v_next, m, l, acc
 
-    from horovod_tpu.parallel._vma import match_vma
+    from horovod_tpu.parallel._vma import match_vma, vma_typing_available
+
+    if skip_dead_blocks is None:
+        skip_dead_blocks = vma_typing_available()
 
     # Type the zero-init carries as varying like q/k/v so the loop body's
     # carry-out matches under check_vma=True (values unchanged).

@@ -33,14 +33,18 @@ def _heads_to_seq(x, axis: str):
 
 def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
                       scale: Optional[float] = None,
-                      attn_fn: Optional[Callable] = None):
+                      attn_fn: Optional[Callable] = None,
+                      use_flash: bool = False):
     """All-to-all sequence-parallel attention.
 
     Per-chip shapes [B, L_local, H, D] -> [B, L_local, H, D]; the head
     count must be divisible by the axis size. ``attn_fn(q, k, v, causal,
     scale)`` defaults to the reference jnp kernel; pass
     :func:`horovod_tpu.ops.attention.flash_attention` on TPU for the
-    Pallas path.
+    Pallas path (``use_flash=True`` is the shorthand). After the
+    all-to-all the local view is the FULL sequence at global offset 0,
+    so causal flash here runs the packed at-or-below-diagonal grid —
+    the truncated-K/V-traffic causal path — with no offset plumbing.
     """
     size = lax.axis_size(axis)
     H = q.shape[2]
@@ -48,6 +52,10 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
         raise ValueError(
             f"ulysses needs heads ({H}) divisible by axis size ({size}); "
             "use ring_attention for head counts below the mesh size")
+    if use_flash and attn_fn is None:
+        from horovod_tpu.ops.attention import flash_attention
+
+        attn_fn = flash_attention
     qh = _seq_to_heads(q, axis)
     kh = _seq_to_heads(k, axis)
     vh = _seq_to_heads(v, axis)

@@ -140,7 +140,8 @@ def test_crashing_child_degrades_to_error_json():
 
 def test_lm_flash_attention_lane():
     """--flash-attention swaps the Pallas kernel into the LM lane (the
-    flash-vs-dense A/B surface); same contract, interpret mode on CPU."""
+    flash-vs-dense A/B surface); same contract, interpret mode on CPU.
+    The record now also stamps the resolved attention implementation."""
     out, _ = _run_bench(
         "--model", "transformer_lm", "--flash-attention",
         "--batch-size", "2", "--seq-len", "128", "--vocab", "256",
@@ -149,6 +150,46 @@ def test_lm_flash_attention_lane():
         "--num-iters", "1")
     assert out["metric"] == "transformer_lm_tokens_per_sec_per_chip"
     assert out["value"] > 0
+    assert out["attention"] == "flash"
+
+
+def test_lm_attention_auto_policy():
+    """--attention auto encodes the measured crossover (dense < 4096,
+    flash >= 4096 — PERF.md r5 adjudication #2): below the threshold it
+    must resolve to dense, and the record says so."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--attention", "auto",
+        "--batch-size", "2", "--seq-len", "128", "--vocab", "256",
+        "--lm-layers", "1", "--lm-dim", "64", "--lm-heads", "4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out["attention"] == "dense"
+    assert out["flash_grid"] is None
+    assert out["value"] > 0
+
+
+def test_lm_flash_grid_stamp_and_full_grid_ab():
+    """Flash records carry the causal-grid accounting (blocks, step
+    counts, K/V bytes), and --flash-full-grid pins the full grid — the
+    truncated-vs-full A/B pair tools/hw_sweep.py queues. seq 384 tiles
+    as a 3x3 block grid, so the packed walk is 6 of 9 steps."""
+    common = ("--model", "transformer_lm", "--batch-size", "2",
+              "--seq-len", "384", "--vocab", "256", "--lm-layers", "1",
+              "--lm-dim", "64", "--lm-heads", "4",
+              "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+              "--num-iters", "1")
+    out, _ = _run_bench("--attention", "flash", *common)
+    g = out["flash_grid"]
+    assert out["attention"] == "flash" and g["truncated"]
+    assert (g["steps"], g["steps_full"]) == (6, 9)
+    assert g["kv_bytes"] * 3 == g["kv_bytes_full"] * 2
+    assert g["bwd"] == "scan"  # auto resolves scan below Lk 8192
+    out_full, _ = _run_bench("--attention", "flash", "--flash-full-grid",
+                             "--flash-bwd", "pallas", *common)
+    g_full = out_full["flash_grid"]
+    assert not g_full["truncated"]
+    assert g_full["steps"] == g_full["steps_full"] == 9
+    assert g_full["bwd"] == "pallas"  # the A/B lanes' pinned backward
 
 
 def test_compile_only_lane_contract():

@@ -67,11 +67,18 @@ def test_image_only_flags_not_on_lm_lanes(lanes, parser):
             continue
         args = parser.parse_args(cmd[1:])
         lm_flags = (args.fused_ce or args.scan_layers or args.remat
-                    or args.flash_attention)
+                    or args.flash_attention or args.flash_full_grid
+                    or args.attention is not None
+                    or args.flash_bwd is not None)
         if args.model != "transformer_lm":
             assert not lm_flags, f"{lane}: LM flag on an image lane"
         if args.model == "transformer_lm":
             assert not args.fused_bn, f"{lane}: --fused-bn on the LM lane"
+        if args.flash_full_grid:
+            # The full-grid A/B lane only means something on the flash
+            # path; bench_lm rejects the combination at runtime.
+            assert (args.flash_attention or args.attention == "flash"), \
+                f"{lane}: --flash-full-grid without the flash path"
 
 
 def test_parser_builds_without_backend_init(parser):

@@ -57,6 +57,20 @@ LANES = [
                                  "--fused-ce"]),
     ("transformer_lm_flash", ["bench.py", "--model", "transformer_lm",
                               "--flash-attention"]),
+    # Truncated-vs-full causal grid A/B (adjacent so the pair shares
+    # chip condition): same kernel, --flash-full-grid pins the full
+    # (q-block, k-block) grid whose dead half the packed default skips.
+    # BOTH sides pin --flash-bwd pallas: below Lk 8192 the auto
+    # backward is the scan, which is diagonal-truncated by construction
+    # — only the pinned kernel split makes the A/B span all three
+    # grids. The JSON's flash_grid field carries the step/byte/bwd
+    # accounting.
+    ("transformer_lm_flash_trunc_pallasbwd",
+     ["bench.py", "--model", "transformer_lm", "--attention", "flash",
+      "--flash-bwd", "pallas"]),
+    ("transformer_lm_flash_fullgrid",
+     ["bench.py", "--model", "transformer_lm", "--attention", "flash",
+      "--flash-full-grid", "--flash-bwd", "pallas"]),
     ("flash_check", ["tools/tpu_flash_check.py"]),
     # Block-tiling sweep at the flash/dense crossover (the 128x128
     # default lost ~5% to dense at seq 2048 in the round-4 A/B; if a
@@ -74,6 +88,17 @@ LANES = [
                                       "transformer_lm", "--seq-len", "4096",
                                       "--batch-size", "4", "--remat",
                                       "--flash-attention"]),
+    # Grid-truncation A/B at the first flash-only length (16 k-blocks:
+    # the packed grid runs ~53% of the full grid's steps here); both
+    # sides pin the pallas backward (see the seq-2048 pair's note).
+    ("transformer_lm_seq4096_flash_trunc_pallasbwd",
+     ["bench.py", "--model", "transformer_lm", "--seq-len", "4096",
+      "--batch-size", "4", "--remat", "--attention", "flash",
+      "--flash-bwd", "pallas"]),
+    ("transformer_lm_seq4096_flash_fullgrid",
+     ["bench.py", "--model", "transformer_lm", "--seq-len", "4096",
+      "--batch-size", "4", "--remat", "--attention", "flash",
+      "--flash-full-grid", "--flash-bwd", "pallas"]),
     ("transformer_lm_seq8192", ["bench.py", "--model", "transformer_lm",
                                 "--seq-len", "8192", "--batch-size", "2",
                                 "--remat"]),
@@ -105,6 +130,14 @@ LANES = [
                                              "16384", "--batch-size", "1",
                                              "--remat", "--flash-attention",
                                              "--fused-ce"]),
+    # Longest-rung grid A/B: at 64 k-blocks the dead half is ~49% of
+    # the full grid's steps AND K/V DMA bytes — the lane family where
+    # PERF.md's MFU table says the chip is least saturated (12-18%).
+    # No bwd pin needed: auto already resolves to pallas at Lk 16384.
+    ("transformer_lm_seq16384_flash_fused_fullgrid",
+     ["bench.py", "--model", "transformer_lm", "--seq-len", "16384",
+      "--batch-size", "1", "--remat", "--attention", "flash",
+      "--fused-ce", "--flash-full-grid"]),
     # ViT: the compute-bound (MXU-friendly) image lane — unlike the
     # memory-bound ResNet family it should approach the chip's matmul
     # rate, quantifying how much of the ResNet gap is the model, not

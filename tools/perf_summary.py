@@ -57,14 +57,32 @@ def fmt(v):
     return str(v)
 
 
+def flash_grid_cell(rec):
+    """Compact render of the record's flash_grid accounting (bench.py
+    stamps it on flash LM lanes): "steps/full bqxbk bwd", e.g.
+    "2080/4096 256x256 pallas" for the truncated causal grid at seq
+    16384 — so the truncated-vs-full A/B rows carry their grid AND
+    resolved-backward evidence in the table. Dense / pre-truncation
+    records render as em-dash."""
+    g = rec.get("flash_grid")
+    if not isinstance(g, dict):
+        return "—"
+    cell = (f"{g.get('steps', '?')}/{g.get('steps_full', '?')} "
+            f"{g.get('block_q', '?')}x{g.get('block_k', '?')}")
+    if g.get("bwd"):
+        cell += f" {g['bwd']}"
+    return cell
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | peak | probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|")
+    print("| lane | value | unit | window | flash grid | peak | probe TF "
+          "| stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -74,6 +92,7 @@ def main():
         window = rec.get("window")
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
               f"| {window if window is not None else '—'} "
+              f"| {flash_grid_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")
