@@ -254,7 +254,7 @@ def bench_image(args, log):
         0.01, momentum=0.9,
         accumulator_dtype=jnp.bfloat16 if args.bf16_momentum else None)
     state, optimizer = models.create_train_state(
-        rng, model, sgd, sample, zero=args.zero)
+        rng, model, sgd, sample, zero=args.zero, overlap=args.overlap)
     step_fn = models.make_train_step(model, optimizer, average_loss=False)
     state_spec = models.state_partition_specs(state) if args.zero else P()
 
@@ -284,6 +284,7 @@ def bench_image(args, log):
         f"({jax.devices()[0].platform})"
         + (f", {k}-step dispatch windows" if k > 1 else ""),
         file=sys.stderr)
+    stamp = overlap_stamp(args, state, log)
     units_per_iter = batch_size * k * args.num_batches_per_iter
     mean, conf, peak = run_timed(run_step, state, batch, args,
                                  units_per_iter, "img/sec", log)
@@ -291,7 +292,7 @@ def bench_image(args, log):
         log(f"Total img/sec on {n} chip(s): {mean * n:.1f} +-{conf * n:.1f}",
             file=sys.stderr)
     metric, unit = metric_contract(args)
-    return mean, peak, unit, metric, {}
+    return mean, peak, unit, metric, stamp
 
 
 def bench_lm(args, log):
@@ -377,7 +378,7 @@ def bench_lm(args, log):
     opt = optax.adam(
         1e-4, mu_dtype=jnp.bfloat16 if args.bf16_momentum else None)
     state, optimizer = models.create_train_state(
-        rng, model, opt, sample, zero=args.zero)
+        rng, model, opt, sample, zero=args.zero, overlap=args.overlap)
     state_spec = models.state_partition_specs(state) if args.zero else P()
 
     def step_fn(state, batch):
@@ -432,6 +433,7 @@ def bench_lm(args, log):
         + (f", {k}-step dispatch windows" if k > 1 else ""),
         file=sys.stderr)
     units_per_iter = batch_size * L * k * args.num_batches_per_iter
+    stamp = overlap_stamp(args, state, log)
     mean, conf, peak = run_timed(run_step, state, batch, args,
                                  units_per_iter, "tokens/sec", log)
     if not args.compile_only:
@@ -439,7 +441,38 @@ def bench_lm(args, log):
             f"+-{conf * n:.1f}", file=sys.stderr)
     metric, unit = metric_contract(args)
     return mean, peak, unit, metric, {"attention": attention,
-                                      "flash_grid": flash_grid}
+                                      "flash_grid": flash_grid,
+                                      **stamp}
+
+
+def overlap_stamp(args, state, log):
+    """The overlap/bucket evidence fields for the JSON record: the
+    resolved overlap knob plus the fused-bucket plan the gradient
+    exchange will execute (count / MB / oversize singletons — the same
+    accounting tools/scaling_model.py consumes), so an overlap A/B row
+    carries its dispatch-shape evidence like the flash rows carry their
+    grid. Uses param shapes only (gradients share them), so it runs
+    before the timed windows touch (and donate) the state."""
+    import jax
+
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.fusion import plan_buckets, plan_summary
+
+    # Resolve exactly the way fused_reduce will (flag > HOROVOD_OVERLAP
+    # config default): the stamp must record what the run executed.
+    mode = args.overlap or global_state().config.overlap
+    if args.zero:
+        # ZeRO's exchange is already reduce-scatter shaped; the overlap
+        # knob applies to the fused-psum DP lane only.
+        return {"overlap": None, "buckets": None}
+    leaves = jax.tree_util.tree_leaves(state["params"])
+    summary = plan_summary(plan_buckets(
+        leaves, global_state().config.fusion_threshold))
+    log(f"Gradient bucket plan: {summary['count']} bucket(s), "
+        f"{summary['total_mb']} MB total, "
+        f"{summary['oversize_singletons']} oversize singleton(s), "
+        f"overlap={mode}", file=sys.stderr)
+    return {"overlap": mode, "buckets": summary}
 
 
 def resolve_attention(args) -> str:
@@ -540,6 +573,7 @@ def supervise(argv, args):
             "metric": metric_, "value": None, "unit": unit_,
             "vs_baseline": None, "peak": None, "probe_tflops": None,
             "window": getattr(args, "steps_per_dispatch", 1),
+            "overlap": getattr(args, "overlap", None),
             "error": f"supervisor received signal {signum} mid-run "
                      f"(outer/driver deadline?); last state: {last_err}",
         }), flush=True)
@@ -639,6 +673,7 @@ def supervise(argv, args):
         "metric": metric, "value": None, "unit": unit,
         "vs_baseline": None, "peak": None, "probe_tflops": None,
         "window": getattr(args, "steps_per_dispatch", 1),
+        "overlap": getattr(args, "overlap", None),
         "error": last_err,
     }))
     return 0
@@ -659,6 +694,15 @@ def build_parser():
     parser.add_argument("--vocab", type=int, default=32000)
     parser.add_argument("--lm-layers", type=int, default=12)
     parser.add_argument("--lm-dim", type=int, default=768)
+    # Alias for --lm-dim (VERDICT r5 ask #4's spelling): the GPT-2-medium
+    # MFU lane is `--model transformer_lm --d-model 1024` (+ --lm-layers
+    # 24 --lm-heads 16 in tools/hw_sweep.py's transformer_lm_medium
+    # lanes). SUPPRESS keeps --lm-dim's default authoritative.
+    parser.add_argument("--d-model", dest="lm_dim", type=int,
+                        default=argparse.SUPPRESS,
+                        help="alias for --lm-dim (transformer_lm model "
+                             "width; --d-model 1024 + --lm-layers 24 + "
+                             "--lm-heads 16 is the GPT-2-medium config)")
     parser.add_argument("--lm-heads", type=int, default=12)
     parser.add_argument("--steps-per-dispatch", type=int, default=1,
                         help="compile K training steps into ONE XLA "
@@ -677,6 +721,17 @@ def build_parser():
                         help="disable bfloat16 compute")
     parser.add_argument("--zero", action="store_true",
                         help="ZeRO-1 optimizer-state sharding over the mesh")
+    parser.add_argument("--overlap", default=None,
+                        choices=("auto", "on", "off"),
+                        help="backward-overlapped bucketed gradient "
+                             "collectives (horovod_tpu/jax/fusion.py): "
+                             "per-bucket reductions issued in reverse "
+                             "bucket order, start-all/unpack-later, "
+                             "rs+ag form for big buckets — dispatch "
+                             "shape only, numerics bit-identical. "
+                             "Default: the HOROVOD_OVERLAP env knob "
+                             "(auto). The record stamps the mode plus "
+                             "the bucket plan (count/MB/oversize)")
     parser.add_argument("--flash-attention", action="store_true",
                         help="transformer_lm: run the Pallas flash "
                              "attention kernel instead of dense "

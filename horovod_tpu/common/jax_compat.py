@@ -15,6 +15,8 @@ Polyfills:
   folds to a static Python int at trace time (the long-standing idiom
   the newer helper replaced), so the polyfill is exact — including for
   shape arithmetic.
+* ``jax.enable_x64`` — the top-level x64-override context manager; the
+  0.4.x era kept the identical object in ``jax.experimental``.
 
 The ``jax.shard_map`` vs ``jax.experimental.shard_map`` (check_vma vs
 check_rep) split is resolved in :mod:`horovod_tpu.parallel.spmd`, next
@@ -54,6 +56,14 @@ def install() -> None:
             return x
 
         lax.pcast = pcast
+
+    if not hasattr(jax, "enable_x64"):
+        # Current jax exposes the x64-override context manager at top
+        # level; the 0.4.x era kept it in jax.experimental. Same object,
+        # same semantics — re-export, never wrap.
+        from jax.experimental import enable_x64
+
+        jax.enable_x64 = enable_x64
 
     if not hasattr(jax, "shard_map"):
         def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,

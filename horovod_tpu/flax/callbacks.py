@@ -204,7 +204,11 @@ class MetricAverageCallback(Callback):
         for key in list(logs):
             val = logs[key]
             if isinstance(val, (int, float, jnp.ndarray)):
-                logs[key] = mpi_ops.allreduce(
+                # Per-metric (not per-gradient) reductions: a handful of
+                # scalars once per epoch, and each NEEDS its own name on
+                # the eager path (timeline identity / negotiation) — not
+                # the per-tensor gradient anti-pattern HVD006 targets.
+                logs[key] = mpi_ops.allreduce(  # hvdlint: disable=HVD006
                     jnp.asarray(val, jnp.float32), average=True,
                     name=f"metric.{key}")
 

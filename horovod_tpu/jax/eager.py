@@ -50,3 +50,111 @@ def process_broadcast(x, root_rank: int):
     return multihost_utils.broadcast_one_to_all(
         x, is_source=jax.process_index() == root_rank
     )
+
+
+# --------------------------------------------------------------------------
+# Scalable exchange shapes: alltoall / reducescatter compiled over a
+# one-representative-device-per-process mesh. The old eager fallbacks
+# (allgather-then-select, full-reduce-then-slice) moved O(size x bytes)
+# per rank; these compile the REAL primitive — lax.all_to_all's pairwise
+# exchange, lax.psum_scatter's ring — over the process world, so the wire
+# cost has the MPI shape (O(bytes) / (n-1)/n bytes per rank) while the
+# data plane rides the same distributed runtime as the other eager ops.
+
+
+def _process_mesh():
+    """1-D mesh with ONE representative device per process, process order."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    reps = {}
+    for d in jax.devices():
+        reps.setdefault(d.process_index, d)
+    devs = np.array([reps[i] for i in range(jax.process_count())])
+    return Mesh(devs, ("proc",))
+
+
+def _alltoall_on_axis(t, axis, split_axis: int, concat_axis: int):
+    """Per-rank alltoall body: scatter dim ``split_axis`` splits, gather
+    received splits along ``concat_axis`` (the pairwise-exchange data
+    plane; equivalence vs the old allgather-then-select shape is pinned
+    in tests/test_collectives.py)."""
+    from jax import lax
+
+    return lax.all_to_all(t, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def _reducescatter_on_axis(t, axis):
+    """Per-rank reduce-scatter body: this rank's dim-0 stripe of the
+    cross-rank sum (the ring's reduce half; equivalence vs the old
+    full-reduce-then-slice shape is pinned in tests/test_collectives.py)."""
+    from jax import lax
+
+    return lax.psum_scatter(t, axis, scatter_dimension=0, tiled=True)
+
+
+# (cache_key, shape, dtype) -> compiled program. jit caches on callable
+# identity, so the per-call closures below would otherwise retrace and
+# recompile EVERY eager exchange — a per-step eager loop must pay trace
+# + compile once per shape, then dispatch in microseconds. Bounded: an
+# eager loop cycles a handful of shapes; evict oldest past the cap.
+_EXCHANGE_CACHE: dict = {}
+_EXCHANGE_CACHE_MAX = 64
+
+
+def _run_over_process_mesh(body, cache_key, x, out_rows_per_proc: bool):
+    """Run ``body(local_block)`` as one compiled SPMD program over the
+    process mesh: each process contributes its local array as one shard
+    of a stacked leading axis, takes back its own output block.
+    ``cache_key`` names the exchange (op + static args) so same-shape
+    calls reuse the compiled program."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.spmd import _SHARD_MAP_CHECK_KW, _shard_map
+
+    mesh = _process_mesh()
+    g = multihost_utils.host_local_array_to_global_array(x[None], mesh,
+                                                         P("proc"))
+    out_spec = P("proc")
+    key = (cache_key, x.shape, str(x.dtype), mesh.shape["proc"])
+    compiled = _EXCHANGE_CACHE.pop(key, None)  # pop+reinsert = LRU touch
+    if compiled is None:
+        def per_rank(t):
+            return body(t[0], "proc")[None] if out_rows_per_proc else body(
+                t[0], "proc")
+
+        compiled = jax.jit(_shard_map(
+            per_rank, mesh=mesh, in_specs=P("proc"), out_specs=out_spec,
+            **{_SHARD_MAP_CHECK_KW: False}))
+    _EXCHANGE_CACHE[key] = compiled
+    while len(_EXCHANGE_CACHE) > _EXCHANGE_CACHE_MAX:
+        _EXCHANGE_CACHE.pop(next(iter(_EXCHANGE_CACHE)))
+    out = compiled(g)
+    local = multihost_utils.global_array_to_host_local_array(out, mesh,
+                                                             out_spec)
+    return local[0] if out_rows_per_proc else local
+
+
+def process_alltoall(x, split_axis: int = 0, concat_axis: int = 0):
+    """Pairwise alltoall across processes: process p's split ``s`` of dim
+    ``split_axis`` lands on process ``s``, received splits concatenate
+    along ``concat_axis`` in source order — O(bytes) sent and received
+    per rank (MPI_Alltoall's shape), vs the old allgather-then-select's
+    O(size x bytes)."""
+    x = jnp.asarray(x)
+    return _run_over_process_mesh(
+        lambda t, ax: _alltoall_on_axis(t, ax, split_axis, concat_axis),
+        ("alltoall", split_axis, concat_axis), x, out_rows_per_proc=True)
+
+
+def process_reducescatter(x):
+    """Ring reduce-scatter across processes: each process receives its
+    dim-0 stripe of the elementwise cross-process SUM — (n-1)/n of the
+    tensor bytes per rank, vs the old full-reduce-then-slice's whole-
+    tensor allreduce. Caller divides for the averaged variant."""
+    x = jnp.asarray(x)
+    return _run_over_process_mesh(_reducescatter_on_axis,
+                                  ("reducescatter",), x,
+                                  out_rows_per_proc=False)

@@ -1,4 +1,4 @@
-"""Tensor fusion: bucketed flat-buffer collectives.
+"""Tensor fusion: bucketed flat-buffer collectives, overlap-scheduled.
 
 TPU-native rebuild of the reference's fusion machinery — the 64 MB fusion
 buffer (horovod/common/fusion_buffer_manager.h:50-55), the response-merging
@@ -17,17 +17,38 @@ Mapping onto XLA:
 * bucket boundaries respect HOROVOD_FUSION_THRESHOLD so the env knob (and
   the autotuner that drives it) keeps its meaning.
 
+Overlap scheduling (HOROVOD_OVERLAP=auto|on|off): the reference hid the
+gradient exchange behind backward compute by firing an allreduce from each
+gradient hook as autograd produced it (Sergeev & Del Balso 2018; PyTorch
+DDP's reverse-order buckets, Li et al. VLDB 2020). Under XLA the step is
+one program, so the same win is a *scheduling shape* problem: with overlap
+on, per-bucket collectives are issued in REVERSE bucket order — the order
+backward produces gradients, last layers first — as a start-all/
+unpack-later sequence, so each bucket's collective depends only on its own
+members and XLA's async collective (start/done) scheduler can slide it
+under the remaining backward compute instead of serializing one
+post-backward block. Buckets at or above HOROVOD_OVERLAP_SCATTER_THRESHOLD
+additionally take the ``psum_scatter`` -> sharded-update -> ``all_gather``
+form: identical wire bytes (reduce-scatter + all-gather IS how a ring
+allreduce decomposes) and identical numerics, but two independently
+schedulable halves — ZeRO-shaped communication with plain-DP semantics
+(optimizer state stays replicated; contrast :mod:`horovod_tpu.jax.zero`).
+Overlap NEVER changes results: the emission order and collective shape
+change, the math does not (pinned bit-exactly in tests/test_overlap.py).
+
 Same-dtype-only fusion matches the reference (it fused only responses with
 identical dtype/device signatures, operations.cc:2175-2230).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import math
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.common.config import OVERLAP_MODES
 from horovod_tpu.common.exceptions import InvalidArgumentError
 from horovod_tpu.common.state import current_spmd_axis, global_state
 from horovod_tpu.jax.compression import Compression
@@ -52,6 +73,88 @@ def _plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]
     return buckets
 
 
+class Bucket(NamedTuple):
+    """One fused-collective bucket of the plan (public accounting record —
+    tools/scaling_model.py and the bucket-byte tests consume these)."""
+
+    dtype: str        # wire dtype name, e.g. "float32"
+    index: int        # position within this dtype's bucket sequence
+    members: tuple    # indices into the input tensor list, input order
+    nbytes: int       # payload bytes (sum of member bytes, unpadded)
+    oversize: bool    # single tensor alone exceeding the fusion threshold
+
+
+def _leaf_size(leaf) -> int:
+    size = getattr(leaf, "size", None)
+    if size is None:  # ShapeDtypeStruct on older jax: derive from shape
+        size = int(math.prod(leaf.shape))
+    return int(size)
+
+
+def plan_buckets(leaves, threshold: int) -> List[Bucket]:
+    """The full bucket plan for ``leaves`` (arrays or ShapeDtypeStructs):
+    grouped by dtype (first-appearance order), greedily packed to
+    ``threshold`` bytes within each group, forward (input) order.
+
+    This is exactly the plan :func:`fused_reduce` executes, exposed so the
+    scaling model and tests can account bucket bytes without tracing."""
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    plan: List[Bucket] = []
+    for dtype, idxs in by_dtype.items():
+        sizes = [_leaf_size(leaves[i]) * dtype.itemsize for i in idxs]
+        for b, bucket in enumerate(_plan_buckets(sizes, threshold)):
+            nbytes = sum(sizes[j] for j in bucket)
+            plan.append(Bucket(
+                dtype=dtype.name,
+                index=b,
+                members=tuple(idxs[j] for j in bucket),
+                nbytes=nbytes,
+                oversize=len(bucket) == 1 and nbytes > threshold,
+            ))
+    return plan
+
+
+def plan_summary(plan: Sequence[Bucket]) -> dict:
+    """Compact accounting of a bucket plan: the numbers the scaling model
+    consumes and bench JSON stamps alongside the overlap knob."""
+    total = sum(b.nbytes for b in plan)
+    return {
+        "count": len(plan),
+        "total_bytes": total,
+        "total_mb": round(total / (1024 * 1024), 2),
+        "oversize_singletons": sum(1 for b in plan if b.oversize),
+        "largest_bytes": max((b.nbytes for b in plan), default=0),
+    }
+
+
+def resolve_overlap(mode: Optional[str], n_buckets: int) -> bool:
+    """Resolve the overlap knob to a concrete decision for one plan.
+
+    ``auto`` engages overlap emission whenever the plan has >= 2 buckets
+    (with a single bucket there is nothing to interleave — the legacy
+    single-pass emission is kept so historical wire shapes stay
+    byte-identical); ``on`` forces the overlap shape even for one bucket;
+    ``off`` is the legacy post-backward block. ``None`` reads the
+    HOROVOD_OVERLAP config default.
+    """
+    if mode is None:
+        mode = global_state().config.overlap
+    if mode is True:
+        mode = "on"
+    elif mode is False:
+        mode = "off"
+    if mode not in OVERLAP_MODES:
+        raise InvalidArgumentError(
+            f"overlap must be one of {OVERLAP_MODES} (got {mode!r})")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return n_buckets >= 2
+
+
 def _hierarchical_inner(st, axis_size: int, enabled: bool) -> int:
     """Fast-domain size for the two-level ladder, or 0 when the flat
     collective should be used. Auto mode uses chips-per-process (the
@@ -71,6 +174,8 @@ def fused_reduce(
     op=None,
     fusion_threshold: Optional[int] = None,
     name: Optional[str] = None,
+    overlap: Optional[str] = None,
+    scatter_threshold: Optional[int] = None,
 ):
     """Allreduce a sequence of tensors via fused flat buckets.
 
@@ -79,6 +184,12 @@ def fused_reduce(
     ``name`` labels the per-tensor collectives on the eager process-level
     path (where names drive the native negotiation and the timeline); the
     SPMD path has no per-tensor identity inside the compiled program.
+
+    ``overlap`` (auto|on|off, default HOROVOD_OVERLAP) selects the
+    backward-overlapped emission: reverse bucket order, start-all/
+    unpack-later, reduce-scatter+all-gather for buckets >=
+    ``scatter_threshold`` bytes (HOROVOD_OVERLAP_SCATTER_THRESHOLD).
+    Changes dispatch shape only — results are bit-identical to ``off``.
     """
     from horovod_tpu.jax import mpi_ops
 
@@ -89,6 +200,8 @@ def fused_reduce(
     st.require_init()
     if fusion_threshold is None:
         fusion_threshold = st.config.fusion_threshold
+    if scatter_threshold is None:
+        scatter_threshold = st.config.overlap_scatter_threshold
 
     tensors = [jnp.asarray(t) for t in tensors]
     axis = current_spmd_axis()
@@ -97,9 +210,10 @@ def fused_reduce(
         if nproc == 1:
             return list(tensors)
         # Multi-process eager: reduce each via the process-level path (the
-        # native core fuses on its own side).
+        # native core fuses on its own side, so this per-tensor loop is
+        # not the per-tensor anti-pattern HVD006 flags in user code).
         return [
-            mpi_ops.allreduce(
+            mpi_ops.allreduce(  # hvdlint: disable=HVD006
                 t, average=(op is mpi_ops.Average), op=op,
                 name=f"{name}.{i}" if name else None)
             for i, t in enumerate(tensors)
@@ -108,7 +222,8 @@ def fused_reduce(
     n = mpi_ops._axis_size(axis)
     # Min/Max/Product fuse just as well as Sum: any elementwise cross-rank
     # reduction distributes over concatenation.
-    if op is mpi_ops.Average or op is mpi_ops.Sum:
+    plain_sum = op is mpi_ops.Average or op is mpi_ops.Sum
+    if plain_sum:
         reduce_fn = lax.psum
         # HOROVOD_HIERARCHICAL_ALLREDUCE: route sum-reductions through the
         # explicit two-level ladder (reference operations.cc:1284-1436) —
@@ -121,6 +236,7 @@ def fused_reduce(
             def reduce_fn(v, ax, _inner=inner):
                 return hierarchical_allreduce_in_axis(v, ax, _inner)
     else:
+        inner = 0
         try:
             reduce_fn = mpi_ops._REDUCE_FNS[op]
         except KeyError:
@@ -132,10 +248,12 @@ def fused_reduce(
         compressed.append(c)
         ctxs.append(ctx)
 
-    # Group indices by wire dtype, preserving order within a group.
-    by_dtype: dict = {}
-    for i, c in enumerate(compressed):
-        by_dtype.setdefault(jnp.dtype(c.dtype), []).append(i)
+    plan = plan_buckets(compressed, fusion_threshold)
+    use_overlap = resolve_overlap(overlap, len(plan))
+    # The rs+ag form needs the plain flat psum semantics (the ladder
+    # already decomposes; Min/Max/Product have no scatter primitive) and
+    # >1 rank for the scatter to mean anything.
+    can_scatter = use_overlap and plain_sum and not inner and n > 1
 
     # Per-bucket observability (the SPMD half of the reference's
     # per-tensor activity taxonomy, operations.h:29-50): each bucket's
@@ -143,11 +261,15 @@ def fused_reduce(
     # the HLO metadata, so device profiles (jax.profiler /
     # tools/profile_step.py) attribute its time by name — and, when
     # HOROVOD_TIMELINE is active, emits MEMCPY_IN_FUSION_BUFFER /
-    # ALLREDUCE / MEMCPY_OUT_FUSION_BUFFER spans on a per-bucket track
-    # at TRACE time (this code runs once per compile; the spans record
-    # the bucket PLAN — members/bytes/dtype — not per-step device time,
+    # ALLREDUCE (or REDUCESCATTER+ALLGATHER on the scatter form) /
+    # MEMCPY_OUT_FUSION_BUFFER spans on a per-bucket track at TRACE time
+    # (this code runs once per compile; the spans record the bucket PLAN
+    # — members/bytes/dtype/issue order — not per-step device time,
     # which is stated in the span args; per-step device time is the
-    # profiler's job, per-step host dispatch is XLA_EXECUTE's).
+    # profiler's job, per-step host dispatch is XLA_EXECUTE's). Under
+    # overlap the B span opens at ISSUE and closes at UNPACK, so the
+    # trace shows every in-flight bucket between its collective start
+    # and its fusion-buffer unpack.
     import contextlib
 
     import jax as _jax
@@ -158,58 +280,114 @@ def fused_reduce(
     tl = getattr(st, "timeline", None)
     emit = tl is not None and tl.enabled
 
-    @contextlib.contextmanager
-    def _span(track, act, args=None):
-        """B/E-paired top-level span (activity() covers the nested
-        MEMCPY spans; this pairs start/end the same exception-safe
-        way). No-ops when the timeline is off."""
-        if not emit:
-            yield
-            return
-        tl.start(track, act, args=args)
-        try:
-            yield
-        finally:
-            tl.end(track, act)
-
     def _act(track, act_name):
         return (_activity(tl, track, act_name) if emit
                 else contextlib.nullcontext())
 
     results: List = [None] * len(tensors)
-    for dtype, idxs in by_dtype.items():
-        sizes = [compressed[i].size * dtype.itemsize for i in idxs]
-        for b, bucket in enumerate(_plan_buckets(sizes, fusion_threshold)):
-            members = [idxs[j] for j in bucket]
-            nbytes = sum(sizes[j] for j in bucket)
-            bucket_name = f"{name or 'fused'}.{dtype.name}.b{b}"
-            scope = f"hvd_allreduce_{bucket_name}".replace(".", "_")
-            with _span(bucket_name, _tl_names.ALLREDUCE,
-                       args={"span": "trace", "tensors": len(members),
-                             "bytes": int(nbytes)}), \
-                 _jax.named_scope(scope):
-                if len(members) == 1:
-                    i = members[0]
-                    results[i] = reduce_fn(compressed[i], axis)
-                    continue
-                with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
-                    flat = jnp.concatenate(
-                        [compressed[i].ravel() for i in members]
-                    )
-                reduced = reduce_fn(flat, axis)
-                with _act(bucket_name, _tl_names.MEMCPY_OUT_FUSION_BUFFER):
-                    offset = 0
-                    for i in members:
-                        sz = compressed[i].size
-                        results[i] = reduced[offset : offset + sz].reshape(
-                            compressed[i].shape
-                        )
-                        offset += sz
+    # Members whose averaging division already happened on the scattered
+    # shard (the "sharded update": 1/n of the elementwise work, before
+    # the all-gather) — the tail must not divide them again.
+    averaged = [False] * len(tensors)
+
+    def _issue(k, bucket: Bucket):
+        """Emit bucket ``bucket``'s collective (k-th in issue order);
+        return the unpack closure that splits results back out."""
+        dtype = jnp.dtype(bucket.dtype)
+        bucket_name = f"{name or 'fused'}.{dtype.name}.b{bucket.index}"
+        scope = f"hvd_allreduce_{bucket_name}".replace(".", "_")
+        members = list(bucket.members)
+        scatter = can_scatter and bucket.nbytes >= scatter_threshold
+        if emit:
+            tl.start(bucket_name, _tl_names.ALLREDUCE,
+                     args={"span": "trace", "tensors": len(members),
+                           "bytes": int(bucket.nbytes),
+                           "overlap": bool(use_overlap), "issue": k,
+                           # Sequential emission unpacks each bucket
+                           # before issuing the next: never >1 in flight.
+                           "in_flight": k + 1 if use_overlap else 1,
+                           "path": "rs_ag" if scatter else "psum"})
+        try:
+            with _jax.named_scope(scope):
+                if scatter:
+                    with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
+                        flat = (jnp.concatenate(
+                            [compressed[i].ravel() for i in members])
+                            if len(members) > 1
+                            else compressed[members[0]].ravel())
+                    size = flat.size
+                    pad = (-size) % n
+                    if pad:
+                        flat = jnp.pad(flat, (0, pad))
+                    with _act(bucket_name, _tl_names.REDUCESCATTER):
+                        shard = lax.psum_scatter(
+                            flat, axis, scatter_dimension=0, tiled=True)
+                    if op is mpi_ops.Average and compression is Compression.none:
+                        # Sharded update: divide the 1/n shard, not the
+                        # gathered whole — elementwise division commutes
+                        # with the gather, so this is bit-identical to
+                        # dividing after (and 1/n of the work). Under
+                        # wire compression the division stays in the
+                        # decompressed dtype at the tail instead.
+                        shard = shard / n
+                        for i in members:
+                            averaged[i] = True
+                    with _act(bucket_name, _tl_names.ALLGATHER):
+                        reduced = lax.all_gather(shard, axis, tiled=True)
+                    if pad:
+                        reduced = reduced[:size]
+                elif len(members) == 1:
+                    reduced = reduce_fn(compressed[members[0]], axis)
+                else:
+                    with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
+                        flat = jnp.concatenate(
+                            [compressed[i].ravel() for i in members])
+                    reduced = reduce_fn(flat, axis)
+        except Exception:
+            if emit:
+                tl.end(bucket_name, _tl_names.ALLREDUCE)
+            raise
+
+        def _unpack():
+            try:
+                with _jax.named_scope(scope):
+                    if len(members) == 1 and not scatter:
+                        results[members[0]] = reduced
+                        return
+                    with _act(bucket_name,
+                              _tl_names.MEMCPY_OUT_FUSION_BUFFER):
+                        offset = 0
+                        for i in members:
+                            sz = compressed[i].size
+                            results[i] = reduced[offset:offset + sz].reshape(
+                                compressed[i].shape)
+                            offset += sz
+            finally:
+                if emit:
+                    tl.end(bucket_name, _tl_names.ALLREDUCE)
+
+        return _unpack
+
+    if use_overlap:
+        # Reverse bucket order = backward availability order (autodiff
+        # produces the LAST layers' gradients first): start every
+        # collective as its bucket's gradients become available, unpack
+        # afterwards in forward order — the start-all/done-later shape
+        # XLA's async collective scheduler hides under the remaining
+        # backward compute.
+        unpacks = [None] * len(plan)
+        for k, bi in enumerate(reversed(range(len(plan)))):
+            unpacks[bi] = _issue(k, plan[bi])
+        for unpack in unpacks:
+            unpack()
+    else:
+        for k, bucket in enumerate(plan):
+            _issue(k, bucket)()
 
     out = []
     for i, t in enumerate(tensors):
         r = compression.decompress(results[i], ctxs[i])
-        if op is mpi_ops.Average:
+        if op is mpi_ops.Average and not averaged[i]:
             r = r / n
         out.append(r.astype(t.dtype) if r.dtype != t.dtype else r)
     return out

@@ -45,9 +45,18 @@ def allreduce_gradients_transform(
     op=None,
     average: bool = True,
     fusion_threshold: Optional[int] = None,
+    overlap: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """An optax transform that replaces gradients with their cross-rank
-    (fused) allreduce. Composable with any optax chain."""
+    (fused) allreduce. Composable with any optax chain.
+
+    ``overlap`` (auto|on|off; default HOROVOD_OVERLAP) selects the
+    backward-overlapped bucket emission (:mod:`horovod_tpu.jax.fusion`):
+    per-bucket collectives issued in reverse bucket order as each
+    bucket's gradients become available, so XLA's async collective
+    scheduling hides them under remaining backward compute. Dispatch
+    shape only — numerics are bit-identical across modes.
+    """
 
     def init_fn(params):
         del params
@@ -62,6 +71,7 @@ def allreduce_gradients_transform(
             compression=compression,
             op=op,
             fusion_threshold=fusion_threshold,
+            overlap=overlap,
             name="grads",
         )
         return jax.tree_util.tree_unflatten(treedef, reduced), state
@@ -77,6 +87,7 @@ def DistributedOptimizer(
     op=None,
     average: bool = True,
     fusion_threshold: Optional[int] = None,
+    overlap: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see cross-rank-averaged gradients.
 
@@ -88,6 +99,9 @@ def DistributedOptimizer(
     calls and performs the (single) fused allreduce + update on the k-th,
     reproducing the reference's delayed-allreduce accumulation
     (torch/__init__.py:71-73,114-130).
+
+    ``overlap`` (auto|on|off) selects the backward-overlapped bucket
+    schedule — see :func:`allreduce_gradients_transform`.
     """
     del named_parameters
     chain = optax.chain(
@@ -96,6 +110,7 @@ def DistributedOptimizer(
             op=op,
             average=average,
             fusion_threshold=fusion_threshold,
+            overlap=overlap,
         ),
         optimizer,
     )

@@ -63,12 +63,19 @@ def create_train_state(
     compression=Compression.none,
     backward_passes_per_step: int = 1,
     zero: bool = False,
+    overlap: Optional[str] = None,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params/batch_stats and the (wrapped) optimizer state.
 
     ``distributed=True`` wraps ``optimizer`` in :func:`DistributedOptimizer`
     — the one-line change the reference advertised
     (reference README.md:96-141).
+
+    ``overlap`` (auto|on|off; default HOROVOD_OVERLAP) selects the
+    backward-overlapped bucket schedule for the fused gradient exchange
+    (:mod:`horovod_tpu.jax.fusion`): dispatch shape only, numerics are
+    bit-identical across modes. Ignored with ``zero=True`` (the ZeRO
+    path is already reduce-scatter shaped).
 
     ``zero=True`` uses ZeRO-1 optimizer-state sharding instead
     (:mod:`horovod_tpu.jax.zero`): same wire bytes, optimizer state and
@@ -98,6 +105,7 @@ def create_train_state(
             optimizer,
             compression=compression,
             backward_passes_per_step=backward_passes_per_step,
+            overlap=overlap,
         )
     opt_state = optimizer.init(params)
     state = TrainState(

@@ -188,7 +188,15 @@ def _forward(x, w, a, b, interpret: bool):
     operands, vma = _vma_align(*operands)
 
     def out_struct(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        # Legacy jax (check_rep era) has no vma kwarg on ShapeDtypeStruct
+        # — and no vma typing at all, so _vma_align always returns the
+        # empty set there and plain structs are exactly right. Passing
+        # the kwarg only when a nonempty set needs expressing keeps one
+        # code path valid on both runtimes (same compat discipline as
+        # common/jax_compat.py).
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
 
     kernel = _make_kernel(prologue, m if (prologue and pad) else None, bm)
     y, s1, s2 = pl.pallas_call(

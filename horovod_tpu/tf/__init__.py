@@ -291,7 +291,12 @@ def _allreduce_batch(tensors, average, compression,
                 continue
         compressed, ctx = compression.compress(tf.convert_to_tensor(t))
         arr = _to_writable_numpy(compressed)
-        h = core.allreduce_async_(_next_name("allreduce", f"grad.{i}"), arr)
+        # Async enqueue per gradient INTO the native core, whose
+        # background cycle fuses same-dtype responses into flat buckets
+        # (csrc negotiation) — the per-tensor loop is the enqueue API,
+        # not the wire shape, so HVD006's fusion advice already holds.
+        h = core.allreduce_async_(  # hvdlint: disable=HVD006
+            _next_name("allreduce", f"grad.{i}"), arr)
         entries.append((h, arr, ctx))
     out = []
     for entry in entries:

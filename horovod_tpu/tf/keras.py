@@ -158,7 +158,10 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
     def on_epoch_end(self, epoch, logs=None):
         if logs:
             for key, value in list(logs.items()):
-                logs[key] = float(hvd.allreduce(
+                # Per-metric scalars once per epoch, each needing its own
+                # negotiation/timeline name — not the per-gradient
+                # anti-pattern HVD006 targets (see flax/callbacks.py).
+                logs[key] = float(hvd.allreduce(  # hvdlint: disable=HVD006
                     tf.constant(np.float64(value)), average=True,
                     name=f"metric.{key}"))
 

@@ -1,0 +1,18 @@
+"""NEGATIVE: the bucketed fusion lane itself, and loops whose collective
+input is the whole (loop-invariant) tensor set — ``grouped_allreduce``
+packs the leaves into flat fusion-threshold buckets, so iterating steps
+around it is the correct shape and must stay silent.
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def average_gradients(grads):
+    return hvd.grouped_allreduce(grads, average=True)
+
+
+def train(run_step, state, batches):
+    for batch in batches:
+        state, metrics = run_step(state, batch)
+        metrics = hvd.grouped_allreduce(list(metrics.values()))
+    return state

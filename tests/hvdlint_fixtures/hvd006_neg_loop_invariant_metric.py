@@ -1,0 +1,21 @@
+"""NEGATIVE: a loop-invariant collective inside a step/epoch loop — the
+reduced tensor does not vary with the loop variable (one metric scalar
+per step, the reference's metric-average pattern), so there is no
+per-tensor fan-out for the fusion lane to amortize.
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def train(run_step, state, loss, num_steps):
+    for _ in range(num_steps):
+        state, loss = run_step(state)
+        avg = hvd.allreduce(loss, average=True, name="train.loss")
+    return state, avg
+
+
+def epoch_summary(epochs, accuracy):
+    history = []
+    for epoch in range(epochs):
+        history.append(hvd.allreduce(accuracy, name="val.accuracy"))
+    return history

@@ -1,0 +1,20 @@
+"""POSITIVE: per-leaf ``lax.psum`` from a comprehension inside an SPMD
+step — same per-tensor collective cost as the loop form, one latency +
+dispatch per gradient leaf; the fused bucket lane
+(``fused_reduce``/``DistributedOptimizer``) exists for exactly this.
+"""
+
+import jax
+from jax import lax
+
+
+def reduce_tree(grads):
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    reduced = [lax.psum(leaf, "hvd") for leaf in leaves]  # EXPECT: HVD006
+    return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def mean_tree(grads):
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    reduced = {i: lax.pmean(g, "hvd") for i, g in enumerate(leaves)}  # EXPECT: HVD006
+    return treedef, reduced

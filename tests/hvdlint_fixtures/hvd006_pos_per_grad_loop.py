@@ -1,0 +1,22 @@
+"""POSITIVE: one allreduce per gradient from a Python loop — the
+pattern the reference built its fusion buffer to kill
+(operations.cc:2160-2264): every iteration pays a full collective
+latency + dispatch where ``grouped_allreduce`` would pay once per
+flat fusion-threshold bucket.
+"""
+
+import horovod_tpu.jax as hvd
+
+
+def average_gradients(grads):
+    reduced = []
+    for g in grads:
+        reduced.append(hvd.allreduce(g, average=True))  # EXPECT: HVD006
+    return reduced
+
+
+def sum_named_gradients(named_grads):
+    out = {}
+    for name, g in named_grads.items():
+        out[name] = hvd.allreduce(g, average=False, name=name)  # EXPECT: HVD006
+    return out

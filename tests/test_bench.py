@@ -192,6 +192,25 @@ def test_lm_flash_grid_stamp_and_full_grid_ab():
     assert g_full["bwd"] == "pallas"  # the A/B lanes' pinned backward
 
 
+def test_overlap_and_bucket_stamps_in_record():
+    """--overlap stamps the knob AND the fused bucket plan (count / MB /
+    oversize singletons — the same accounting tools/scaling_model.py
+    consumes) into the JSON record, so the hw_sweep overlap A/B rows
+    carry their dispatch-shape evidence; --d-model is the documented
+    alias for --lm-dim (the GPT-2-medium lane spelling)."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--overlap", "on",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--d-model", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out["overlap"] == "on"
+    b = out["buckets"]
+    assert b["count"] >= 1 and b["total_bytes"] > 0
+    assert {"total_mb", "oversize_singletons", "largest_bytes"} <= set(b)
+    assert out["value"] > 0
+
+
 def test_compile_only_lane_contract():
     """--compile-only (the sweep's *_warm lanes): one first step, metric
     <model>_first_step_secs, vs_baseline null — the warm-cache pass big

@@ -269,6 +269,78 @@ def test_alltoall_indivisible_raises(hvd):
         )
 
 
+@pytest.mark.parametrize("np_", [2, 4, 8])
+def test_eager_alltoall_body_matches_allgather_select(hvd, np_):
+    """The eager multi-process alltoall now rides a TRUE pairwise
+    exchange (eager.process_alltoall -> lax.all_to_all over a one-
+    device-per-process mesh; O(bytes)/rank instead of the old
+    O(n*bytes) allgather-then-select). Equivalence pin: the new data
+    plane must reproduce the OLD shape's result exactly at np<=8."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.parallel as par
+    from horovod_tpu.jax.eager import _alltoall_on_axis
+
+    per = 3
+    # Per-"process" inputs: rank r's row block s carries 100*r + s.
+    inputs = [np.concatenate(
+        [np.full((per, 2), 100.0 * r + s, np.float32)
+         for s in range(np_)]) for r in range(np_)]
+
+    # OLD shape: allgather everyone's tensor, select each source's split
+    # destined for this rank (the pre-rewrite fallback, verbatim math).
+    def old_shape(me):
+        gathered = np.stack(inputs)
+        splits = np.split(gathered, np_, axis=1)
+        return np.concatenate([splits[me][s] for s in range(np_)], axis=0)
+
+    mesh = par.make_mesh({"proc": np_}, devices=jax.devices()[:np_])
+    stacked = jnp.asarray(np.concatenate(inputs))
+    out = jax.shard_map(
+        lambda t: _alltoall_on_axis(t, "proc", 0, 0),
+        mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+        check_vma=False)(stacked)
+    out = np.asarray(out)
+    rows = np_ * per
+    for me in range(np_):
+        np.testing.assert_array_equal(out[me * rows:(me + 1) * rows],
+                                      old_shape(me))
+
+
+@pytest.mark.parametrize("np_", [2, 4, 8])
+def test_eager_reducescatter_body_matches_reduce_slice(hvd, np_):
+    """Ring reduce-scatter (eager.process_reducescatter) vs the old
+    full-reduce-then-slice: each rank's stripe of the cross-rank sum,
+    bit-for-bit, at np<=8 (integer-valued inputs make every reduction
+    order exact)."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.parallel as par
+
+    from horovod_tpu.jax.eager import _reducescatter_on_axis
+
+    rng = np.random.RandomState(42 + np_)
+    per = 2
+    inputs = [np.asarray(rng.randint(-6, 7, (np_ * per, 3)), np.float32)
+              for _ in range(np_)]
+
+    # OLD shape: full elementwise sum, keep rank me's dim-0 stripe.
+    summed = np.sum(inputs, axis=0)
+
+    mesh = par.make_mesh({"proc": np_}, devices=jax.devices()[:np_])
+    stacked = jnp.asarray(np.concatenate(inputs))
+    out = np.asarray(jax.shard_map(
+        lambda t: _reducescatter_on_axis(t, "proc"),
+        mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+        check_vma=False)(stacked))
+    assert out.shape == summed.shape
+    for me in range(np_):
+        np.testing.assert_array_equal(out[me * per:(me + 1) * per],
+                                      summed[me * per:(me + 1) * per])
+
+
 def test_gradient_of_allreduce(hvd):
     # Reference registered allreduce's gradient as allreduce
     # (tensorflow/mpi_ops.py:94-105); with lax.psum this falls out of the

@@ -1,0 +1,294 @@
+"""Backward-overlapped bucketed collectives (horovod_tpu/jax/fusion.py):
+the overlap knob changes DISPATCH SHAPE — issue order, start-all/
+unpack-later, rs+ag split for big buckets — and NEVER numerics. Pinned
+bit-exactly over the 8-chip virtual mesh with closed-form integer-valued
+tensors (any cross-rank summation order is exact, so a single differing
+bit means a real semantic change, not float noise), across bucket counts
+including oversize singletons, both reduction ops, wire compression, and
+the full DistributedOptimizer/train-step wiring.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.common import state as _state
+from horovod_tpu.common.exceptions import InvalidArgumentError
+from horovod_tpu.jax.fusion import (
+    fused_reduce,
+    plan_buckets,
+    plan_summary,
+    resolve_overlap,
+)
+
+# Shapes chosen so thresholds carve distinct plans: 33*4=132 B, 7*5*4=140,
+# 101*4=404 (an oversize singleton below threshold 400), 64*4=256, 257*4=1028.
+_SHAPES = [(33,), (7, 5), (101,), (4, 4, 4), (257,)]
+
+
+def _bases(seed=0):
+    rng = np.random.RandomState(seed)
+    return [np.asarray(rng.randint(-8, 8, size=s), np.float32)
+            for s in _SHAPES]
+
+
+def _run(bases, overlap, threshold, scatter, average, compression=None):
+    comp = compression or hvd.Compression.none
+
+    def fn():
+        ts = [b * (hvd.rank() + 1).astype(b.dtype) for b in bases]
+        return tuple(fused_reduce(ts, average=average,
+                                  compression=comp,
+                                  fusion_threshold=threshold,
+                                  overlap=overlap,
+                                  scatter_threshold=scatter))
+
+    return [np.asarray(o) for o in hvd.spmd_run(fn)]
+
+
+# threshold 10**9 -> one bucket; 400 -> several incl. an oversize
+# singleton (404 B > 400); 64 -> every tensor its own bucket.
+@pytest.mark.parametrize("threshold", [10**9, 400, 64])
+@pytest.mark.parametrize("average", [False, True])
+def test_overlapped_matches_sequential_bitexact(hvd, threshold, average):
+    bases = _bases()
+    ref = _run(bases, "off", threshold, 10**9, average)
+    for overlap, scatter in [("on", 10**9), ("on", 0), ("auto", 0)]:
+        got = _run(bases, overlap, threshold, scatter, average)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r, g)
+
+
+def test_overlap_bitexact_under_wire_compression(hvd):
+    # fp16 wire: the scatter path must NOT pre-divide the compressed
+    # shard (precision) — division stays at the decompressed tail, so
+    # both modes share one reduction + division sequence exactly.
+    bases = _bases(seed=1)
+    ref = _run(bases, "off", 400, 10**9, True,
+               compression=hvd.Compression.fp16)
+    got = _run(bases, "on", 400, 0, True, compression=hvd.Compression.fp16)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_overlap_bitexact_mixed_dtypes_and_min(hvd):
+    rng = np.random.RandomState(2)
+    bases = [np.asarray(rng.randint(0, 9, (13,)), np.float32),
+             np.asarray(rng.randint(0, 9, (6,)), np.int32),
+             np.asarray(rng.randint(0, 9, (50,)), np.float32)]
+    ref = _run(bases, "off", 128, 10**9, False)
+    got = _run(bases, "on", 128, 0, False)
+    for r, g in zip(ref, got):
+        assert r.dtype == g.dtype
+        np.testing.assert_array_equal(r, g)
+
+    # Min has no scatter primitive: overlap mode must still produce the
+    # identical result via the psum-path fallback.
+    def fn(overlap):
+        def inner():
+            ts = [b * (hvd.rank() + 1).astype(b.dtype) for b in bases]
+            return tuple(fused_reduce(ts, op=hvd.Min, fusion_threshold=128,
+                                      overlap=overlap, scatter_threshold=0))
+        return [np.asarray(o) for o in hvd.spmd_run(inner)]
+
+    for r, g in zip(fn("off"), fn("on")):
+        np.testing.assert_array_equal(r, g)
+
+
+def _collect(jaxpr, names):
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in names:
+                nbytes = sum(v.aval.size * v.aval.dtype.itemsize
+                             for v in eqn.invars if hasattr(v.aval, "size"))
+                found.append((eqn.primitive.name, nbytes))
+            for v in eqn.params.values():
+                for item in (v if isinstance(v, (tuple, list)) else [v]):
+                    if hasattr(item, "jaxpr"):
+                        walk(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        walk(item)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+def _trace(overlap, threshold, scatter):
+    import jax
+
+    bases = _bases()
+
+    def fn():
+        ts = [np.asarray(b) * (hvd.rank() + 1).astype(np.float32)
+              for b in bases]
+        return tuple(fused_reduce(ts, average=False,
+                                  fusion_threshold=threshold,
+                                  overlap=overlap,
+                                  scatter_threshold=scatter))
+
+    tok = _state.set_spmd_axis("hvd")
+    try:
+        return jax.make_jaxpr(jax.shard_map(
+            fn, mesh=hvd.mesh(), in_specs=(), out_specs=(P(),) * len(bases),
+            check_vma=False))()
+    finally:
+        _state.reset_spmd_axis(tok)
+
+
+def test_scatter_wire_shape(hvd):
+    """Overlap + scatter: every bucket becomes psum_scatter + all_gather
+    (the ring halves — same wire bytes as the one allreduce they
+    replace), and the big flat psum is gone."""
+    jx = _trace("on", 10**9, 0)
+    rs = _collect(jx, {"psum_scatter", "reduce_scatter"})
+    ag = _collect(jx, {"all_gather"})
+    psums = [b for _, b in _collect(jx, {"psum", "psum2"}) if b > 64]
+    assert rs and ag and not psums, (rs, ag, psums)
+    grad_bytes = sum(int(np.prod(s)) * 4 for s in _SHAPES)
+    rs_bytes = sum(b for _, b in rs)
+    # >= from the divisibility pad, < 2x on these shapes.
+    assert grad_bytes <= rs_bytes < 2 * grad_bytes, (rs_bytes, grad_bytes)
+    # The gather moves the 1/8 shards back out.
+    assert sum(b for _, b in ag) * 8 == rs_bytes
+
+
+def test_overlap_auto_single_bucket_keeps_legacy_wire(hvd):
+    """auto with a one-bucket plan = the historical emission: one flat
+    psum, no scatter primitives — so the pinned DP wire shapes
+    (test_wire_bytes) hold under the default knob."""
+    jx = _trace("auto", 10**9, 10**9)
+    assert not _collect(jx, {"psum_scatter", "reduce_scatter",
+                             "all_gather"})
+    big = [b for _, b in _collect(jx, {"psum", "psum2"}) if b > 64]
+    grad_bytes = sum(int(np.prod(s)) * 4 for s in _SHAPES)
+    assert big == [grad_bytes], (big, grad_bytes)
+
+
+def test_overlap_issues_buckets_in_reverse_order(hvd):
+    """The tentpole's schedule: under overlap the FIRST collective in
+    program order is the LAST bucket's (the gradients backward produces
+    first), so XLA's async scheduler gets each start next to its
+    producers. threshold 400 makes per-bucket byte sizes distinct."""
+    sizes_off = [b for _, b in _collect(_trace("off", 400, 10**9),
+                                        {"psum", "psum2"}) if b > 64]
+    sizes_on = [b for _, b in _collect(_trace("on", 400, 10**9),
+                                       {"psum", "psum2"}) if b > 64]
+    assert len(sizes_off) >= 3
+    assert sizes_on == list(reversed(sizes_off)), (sizes_off, sizes_on)
+
+
+def test_overlap_knob_validation(hvd):
+    with pytest.raises(InvalidArgumentError):
+        _run(_bases(), "bogus", 400, 0, True)
+
+
+def test_resolve_overlap_semantics(hvd):
+    assert resolve_overlap("off", 99) is False
+    assert resolve_overlap("on", 1) is True
+    assert resolve_overlap("auto", 1) is False
+    assert resolve_overlap("auto", 2) is True
+    # bool spellings normalize; None reads the config default (auto).
+    assert resolve_overlap(True, 1) is True
+    assert resolve_overlap(False, 9) is False
+    assert resolve_overlap(None, 2) is True
+    with pytest.raises(InvalidArgumentError):
+        resolve_overlap("sometimes", 2)
+
+
+def test_plan_buckets_accounting(hvd):
+    import jax.numpy as jnp
+
+    leaves = [jnp.zeros((100,)), jnp.zeros((50,)), jnp.zeros((500,)),
+              jnp.zeros((8,), jnp.int32)]
+    plan = plan_buckets(leaves, 600)
+    # f32 group: [100, 50] pack (600 B), 500 alone (2000 B, oversize);
+    # i32 group: its own bucket.
+    assert [(b.dtype, b.members, b.nbytes, b.oversize) for b in plan] == [
+        ("float32", (0, 1), 600, False),
+        ("float32", (2,), 2000, True),
+        ("int32", (3,), 32, False),
+    ]
+    assert plan_summary(plan) == {
+        "count": 3, "total_bytes": 2632, "total_mb": 0.0,
+        "oversize_singletons": 1, "largest_bytes": 2000,
+    }
+
+
+def test_distributed_optimizer_overlap_bitexact(hvd):
+    """The full user wiring: create_train_state(overlap=...) ->
+    DistributedOptimizer -> fused_reduce. One SPMD training step's
+    parameters must be BIT-identical across overlap modes (multi-bucket
+    plan via a tiny fusion threshold; integer-valued data keeps every
+    reduction order exact)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import models
+
+    def step_params(overlap):
+        model = models.MNISTNet()
+        state, opt = models.create_train_state(
+            jax.random.PRNGKey(0), model, optax.sgd(0.125, momentum=0.5),
+            jnp.zeros((1, 28, 28, 1)), overlap=overlap)
+        # ~450 KB of MNIST params over a 4 KB threshold -> a many-bucket
+        # plan, so the reverse-order issue path really runs.
+        from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+        opt = DistributedOptimizer(optax.sgd(0.125, momentum=0.5),
+                                   fusion_threshold=4096, overlap=overlap)
+        state["opt_state"] = opt.init(state["params"])
+        step = models.make_train_step(model, opt, average_loss=False)
+        rng = np.random.RandomState(3)
+        batch = {"image": jnp.asarray(
+            rng.randint(0, 2, (16, 28, 28, 1)), jnp.float32),
+            "label": jnp.asarray(rng.randint(0, 10, (16,)))}
+        new_state, _ = hvd.spmd_run(step, state, batch,
+                                    in_specs=(P(), P("hvd")),
+                                    out_specs=(P(), P()))
+        return jax.tree_util.tree_leaves(new_state["params"])
+
+    ref = step_params("off")
+    for mode in ("on", "auto"):
+        got = step_params(mode)
+        assert len(ref) == len(got)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_timeline_marks_in_flight_buckets(hvd, tmp_path):
+    """Per-in-flight-bucket observability: under overlap each bucket's
+    ALLREDUCE span opens at issue (args carry issue order + in-flight
+    count + path) and the scatter form emits REDUCESCATTER/ALLGATHER
+    activities inside it."""
+    from horovod_tpu.utils.timeline import Timeline
+
+    st = _state.global_state()
+    trace = tmp_path / "overlap_trace.json"
+    saved = st.timeline
+    st.timeline = Timeline(str(trace))
+    try:
+        _run(_bases(), "on", 400, 0, True)
+    finally:
+        st.timeline.close()
+        st.timeline = saved
+    events = json.loads(trace.read_text().rstrip().rstrip(",\n") + "]")
+    starts = [e for e in events
+              if e.get("name") == "ALLREDUCE" and e["ph"] == "B"]
+    assert starts, events
+    issues = sorted(e["args"]["issue"] for e in starts)
+    assert issues == list(range(len(starts)))
+    assert all(e["args"]["overlap"] for e in starts)
+    assert all(e["args"]["in_flight"] == e["args"]["issue"] + 1
+               for e in starts)
+    assert {"rs_ag"} == {e["args"]["path"] for e in starts}
+    names = [e.get("name") for e in events]
+    assert "REDUCESCATTER" in names and "ALLGATHER" in names
+    # Every span closes.
+    ends = [e for e in events if e["ph"] == "E"]
+    assert len(ends) >= len(starts)

@@ -84,6 +84,55 @@ def test_dp_step_moves_exactly_gradient_bytes(hvd):
     assert len(psums) <= 4, psums
 
 
+def test_overlap_dp_step_conserves_gradient_bytes(hvd):
+    """Overlap mode (fusion.py): the DP step's reduce traffic stays
+    EXACTLY the gradient bytes — reverse-order multi-bucket psums sum to
+    the same total, and a scatter-form bucket's psum_scatter + all_gather
+    pair is the same ring bytes as the allreduce it replaces (modulo the
+    divisibility pad). The shape changes, the volume cannot."""
+    import optax
+
+    from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+    model = models.MNISTNet()
+    state, _ = models.create_train_state(
+        jax.random.PRNGKey(0), model, optax.sgd(0.1, momentum=0.9),
+        jnp.zeros((1, 28, 28, 1)))
+    # Rewrap with a 64 KB threshold (multi-bucket plan) + overlap on.
+    opt = DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               fusion_threshold=64 * 1024, overlap="on")
+    state["opt_state"] = opt.init(state["params"])
+    step = models.make_train_step(model, opt)
+    batch = {"image": jnp.zeros((16, 28, 28, 1)),
+             "label": jnp.zeros((16,), jnp.int32)}
+    st = _state.global_state()
+    tok = _state.set_spmd_axis("hvd")
+    saved_scatter = st.config.overlap_scatter_threshold
+    # Scatter floor 0 so every bucket takes the rs+ag form (the default
+    # 4 MiB floor would leave this tiny model all-psum).
+    st.config.overlap_scatter_threshold = 0
+    try:
+        jaxpr = jax.make_jaxpr(jax.shard_map(
+            step, mesh=hvd.mesh(), in_specs=(P(), P("hvd")),
+            out_specs=(P(), P()), check_vma=False))(state, batch)
+    finally:
+        st.config.overlap_scatter_threshold = saved_scatter
+        _state.reset_spmd_axis(tok)
+    grad_bytes = sum(l.size * 4
+                     for l in jax.tree_util.tree_leaves(state["params"]))
+    colls = collect_collectives(jaxpr)
+    psum_grad = sum(b for n, b in colls if n.startswith("psum") and b > 64)
+    rs = sum(b for n, b in colls
+             if n in ("reduce_scatter", "psum_scatter"))
+    ag = sum(b for n, b in colls if n == "all_gather")
+    # psum buckets + scatter-form buckets together carry every gradient
+    # byte exactly once (scatter pad < one 8-lane round per bucket).
+    assert grad_bytes <= psum_grad + rs <= grad_bytes + 8 * 4 * 16, (
+        psum_grad, rs, grad_bytes)
+    # Each scatter-form bucket's gather returns the 1/8 shards.
+    assert ag * 8 == rs, (ag, rs)
+
+
 def test_zero_step_reduce_scatters_instead_of_allreducing(hvd):
     colls, grad_bytes = _trace_step(zero=True)
     names = {n for n, _ in colls}

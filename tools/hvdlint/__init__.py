@@ -17,6 +17,10 @@ than generic style:
   fragility, VERDICT round-5 weak #6).
 * **HVD005** shutdown/cleanup calls in a ``try`` body that belong in
   ``finally`` (the ``_dryrun_hier_dp`` leak, ADVICE round-5 #2).
+* **HVD006** per-tensor reduce collective issued from a Python loop
+  where the bucketed fusion lane (``grouped_allreduce``/
+  ``fused_reduce``) should amortize it — one latency + dispatch per
+  tensor, and invisible to the HOROVOD_OVERLAP bucket scheduler.
 
 Run as ``python -m tools.hvdlint <paths...>``; suppress a finding with
 a ``# hvdlint: disable=HVDxxx`` comment on (or immediately above) the

@@ -1,4 +1,4 @@
-"""The hvdlint rule catalogue: AST checks for the five distributed-training
+"""The hvdlint rule catalogue: AST checks for the distributed-training
 bug classes in this repo's incident history (see tools/hvdlint/__init__.py
 and docs/static_analysis.md for the case studies behind each rule).
 
@@ -455,10 +455,89 @@ def check_hvd005(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD006
+
+#: Reduce-type collectives that the bucketed fusion lane
+#: (grouped_allreduce / fused_reduce / DistributedOptimizer) amortizes:
+#: issuing one of these PER TENSOR from a Python loop pays one
+#: collective's latency + dispatch per tensor where one flat bucket
+#: would pay it once (the reference built its whole fusion buffer to
+#: kill exactly this pattern, operations.cc:2160-2264).
+PER_TENSOR_REDUCE_NAMES = {
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "psum", "pmean", "pmin", "pmax",
+}
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    return {sub.id for sub in ast.walk(target) if isinstance(sub, ast.Name)}
+
+
+def check_hvd006(tree: ast.AST) -> List[RawFinding]:
+    """Per-tensor collective in a Python loop where the bucketed fusion
+    lane belongs: a ``for`` loop (or comprehension) that issues a
+    reduce-type collective on the loop variable reduces each tensor as
+    its own collective — one latency + dispatch charge per tensor.
+    ``grouped_allreduce``/``fused_reduce`` (or the DistributedOptimizer,
+    which fuses internally) packs them into flat buckets and pays it
+    per bucket. Loop-invariant collectives (a per-step metric allreduce
+    inside a training loop) do not mention the loop variable and stay
+    silent, as do loops over steps/epochs dispatching a train step.
+    """
+    findings: List[RawFinding] = []
+    loops: List[Tuple[Set[str], List[ast.AST]]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            loops.append((_target_names(node.target),
+                          _subtree_nodes(node.body)))
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp, ast.DictComp)):
+            targets: Set[str] = set()
+            for gen in node.generators:
+                targets |= _target_names(gen.target)
+            elts = ([node.key, node.value] if isinstance(node, ast.DictComp)
+                    else [node.elt])
+            body: List[ast.AST] = []
+            for e in elts:
+                body.extend(ast.walk(e))
+            loops.append((targets, body))
+    for targets, body in loops:
+        if not targets:
+            continue
+        for call in body:
+            if not (isinstance(call, ast.Call)
+                    and trailing_name(call.func) in PER_TENSOR_REDUCE_NAMES):
+                continue
+            arg_names = {
+                sub.id
+                for a in list(call.args) + [kw.value for kw in call.keywords]
+                for sub in ast.walk(a) if isinstance(sub, ast.Name)
+            }
+            if arg_names & targets:
+                findings.append(RawFinding(
+                    call.lineno, call.col_offset, "HVD006", "warning",
+                    f"per-tensor collective "
+                    f"'{trailing_name(call.func)}' issued inside a Python "
+                    "loop over tensors: each iteration pays a full "
+                    "collective latency + dispatch; fuse them with "
+                    "grouped_allreduce/fused_reduce (one flat bucket per "
+                    "fusion-threshold window) instead"))
+    # De-duplicate (nested loops sharing a target report the call twice).
+    seen: Set[Tuple[int, int]] = set()
+    out = []
+    for f in findings:
+        key = (f.line, f.col)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
     "HVD003": check_hvd003,
     "HVD004": check_hvd004,
     "HVD005": check_hvd005,
+    "HVD006": check_hvd006,
 }

@@ -37,6 +37,20 @@ LANES = [
     # 2,320). Record carries metric ..._win30, vs_baseline null.
     ("resnet50_win30", ["bench.py", "--steps-per-dispatch", "30"]),
     ("resnet50_fused_bn", ["bench.py", "--fused-bn"]),
+    # Overlap A/B (round-7 tentpole, horovod_tpu/jax/fusion.py):
+    # backward-overlapped bucketed collectives (reverse-order issue,
+    # rs+ag for big buckets) vs the legacy post-backward block —
+    # adjacent so the pair shares chip condition. A 1 MiB fusion
+    # threshold gives ResNet-50's 98 MB of fp32 gradients a ~100-bucket
+    # plan, the regime where issue order and async scheduling can
+    # matter; the record's "overlap"/"buckets" stamps carry the
+    # dispatch-shape evidence. (Single chip prices dispatch overhead
+    # only; the scaling win is the tools/scaling_model.py prediction
+    # until a multi-chip slice exists.)
+    ("resnet50_overlap_on", ["bench.py", "--overlap", "on"],
+     {"HOROVOD_FUSION_THRESHOLD": "1048576"}),
+    ("resnet50_overlap_off", ["bench.py", "--overlap", "off"],
+     {"HOROVOD_FUSION_THRESHOLD": "1048576"}),
     # Honest re-adjudication lanes (round 5): both options were priced
     # under dispatch timing ("within noise" / never measured) — the
     # fixed protocol decides them on device time.
@@ -155,6 +169,30 @@ LANES = [
     # the cache column in PERF_RUNS.tsv records whether it did), so the
     # measured lane that follows starts from a warm cache and fits its
     # budget even on a congested tunnel.
+    # GPT-2-medium MFU lane (VERDICT r5 ask #4): 24L x d-model 1024 x 16
+    # heads (~355M params) prices the "26% MFU is device-bound at this
+    # size" claim — if MFU rises with width, the 12L/768d number was
+    # model-bound, not framework-bound. batch 4 seqs/chip (8k tok) +
+    # --remat bound the dense lane's activation memory; the fused-CE and
+    # flash variants A/B the same recipe questions as the base LM lanes.
+    # Big first compile -> one warm compile-only pass, then one whole-
+    # window attempt each (the *_warm/slow pattern vgg16 proved).
+    ("transformer_lm_medium_warm",
+     ["bench.py", "--model", "transformer_lm", "--d-model", "1024",
+      "--lm-layers", "24", "--lm-heads", "16", "--batch-size", "4",
+      "--remat", "--compile-only"], "slow"),
+    ("transformer_lm_medium",
+     ["bench.py", "--model", "transformer_lm", "--d-model", "1024",
+      "--lm-layers", "24", "--lm-heads", "16", "--batch-size", "4",
+      "--remat"], "slow"),
+    ("transformer_lm_medium_fused_ce",
+     ["bench.py", "--model", "transformer_lm", "--d-model", "1024",
+      "--lm-layers", "24", "--lm-heads", "16", "--batch-size", "4",
+      "--remat", "--fused-ce"], "slow"),
+    ("transformer_lm_medium_flash",
+     ["bench.py", "--model", "transformer_lm", "--d-model", "1024",
+      "--lm-layers", "24", "--lm-heads", "16", "--batch-size", "4",
+      "--remat", "--attention", "flash"], "slow"),
     ("vgg16_warm", ["bench.py", "--model", "vgg16", "--compile-only"],
      "slow"),
     ("vgg16", ["bench.py", "--model", "vgg16"], "slow"),
@@ -301,9 +339,16 @@ def main() -> int:
             print(f"[sweep] {lane}: already recorded today, skipping",
                   file=sys.stderr)
             continue
+        # Tags: the string "slow" (one whole-window attempt) and/or a
+        # dict of extra env for the lane (e.g. the overlap A/B pair pins
+        # HOROVOD_FUSION_THRESHOLD so both sides run the same plan).
+        extra_env = {k: v for t in tags if isinstance(t, dict)
+                     for k, v in t.items()}
         lane_env = env
-        if "slow" in tags:
+        if "slow" in tags or extra_env:
             lane_env = dict(env)
+            lane_env.update(extra_env)
+        if "slow" in tags:
             lane_env["HVD_BENCH_ATTEMPTS"] = "1"
             lane_env["HVD_BENCH_ATTEMPT_TIMEOUT"] = str(
                 max(60, int(args.timeout - 60)))

@@ -74,15 +74,29 @@ def flash_grid_cell(rec):
     return cell
 
 
+def overlap_cell(rec):
+    """Compact render of the record's overlap/bucket stamps (bench.py
+    --overlap; horovod_tpu/jax/fusion.py): "on(98b)" = overlap on over a
+    98-bucket plan. Pre-overlap records (and ZeRO lanes, whose exchange
+    is already scatter-shaped) render as em-dash."""
+    mode = rec.get("overlap")
+    if not mode:
+        return "—"
+    b = rec.get("buckets")
+    if isinstance(b, dict) and b.get("count") is not None:
+        return f"{mode}({b['count']}b)"
+    return str(mode)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | flash grid | peak | probe TF "
-          "| stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|")
+    print("| lane | value | unit | window | overlap | flash grid | peak "
+          "| probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -92,6 +106,7 @@ def main():
         window = rec.get("window")
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
               f"| {window if window is not None else '—'} "
+              f"| {overlap_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
