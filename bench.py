@@ -202,6 +202,56 @@ def run_timed(run_step, state, batch, args, units_per_iter, unit, log):
     return mean, conf, float(np.max(rates))
 
 
+def measure_snapshot_ms(state, log, samples: int = 3):
+    """Measured cost of ONE elastic host-RAM snapshot of ``state``
+    (synchronous d2h through horovod_tpu.elastic.Snapshotter), in ms.
+
+    Min over ``samples`` takes: the steady-state cost is what the
+    cadence amortizes — a one-off allocator warmup in the mean would
+    overstate the overhead. Runs BEFORE the timed windows (the state is
+    donated inside them); gradients share the state's shapes so the d2h
+    cost is the same one training would pay."""
+    import jax
+
+    from horovod_tpu.elastic.snapshot import Snapshotter
+
+    jax.block_until_ready(state)
+    snap = Snapshotter(every=1)
+    times = []
+    for i in range(samples):
+        t0 = time.perf_counter()
+        snap.take(i + 1, state, sync=True)
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = min(times)
+    log(f"Snapshot probe: {ms:.2f} ms per sync host-RAM snapshot "
+        f"(min of {samples})", file=sys.stderr)
+    return ms
+
+
+def snapshot_field(args, snap_ms, mean, units_per_step):
+    """The ``"snapshot"`` JSON stamp: cadence, ms/snapshot and measured
+    overhead %% of step time — the elastic acceptance evidence (budget:
+    <= 2%% at the default cadence; docs/elastic.md cadence math).
+    ``mean`` is the measured rate in units/sec; ``units_per_step``
+    converts it to a per-training-step time."""
+    if snap_ms is None:
+        return {"snapshot": None}
+    field = {"every": args.snapshot_every,
+             "ms_per_snapshot": round(snap_ms, 3)}
+    if mean and mean > 0:
+        step_secs = units_per_step / mean
+        overhead = (100.0 * (snap_ms / 1e3)
+                    / (args.snapshot_every * step_secs))
+        # 3 significant digits at ANY magnitude: fixed-decimal rounding
+        # would floor a tiny-but-real overhead (fast steps on a quiet
+        # host) to exactly 0.0, misreporting the measured cost the
+        # stamp exists to evidence.
+        field["overhead_pct"] = float(f"{overhead:.3g}")
+    else:
+        field["overhead_pct"] = None
+    return {"snapshot": field}
+
+
 def apply_window(step_fn, batch, steps_per_dispatch):
     """Window-lane wiring (--steps-per-dispatch K): one-call delegate to
     the shared synthetic-window stager so the bench and the profiler
@@ -285,6 +335,9 @@ def bench_image(args, log):
         + (f", {k}-step dispatch windows" if k > 1 else ""),
         file=sys.stderr)
     stamp = overlap_stamp(args, state, log)
+    snap_ms = (measure_snapshot_ms(state, log)
+               if args.snapshot_every > 0 and not args.compile_only
+               else None)
     units_per_iter = batch_size * k * args.num_batches_per_iter
     mean, conf, peak = run_timed(run_step, state, batch, args,
                                  units_per_iter, "img/sec", log)
@@ -292,6 +345,7 @@ def bench_image(args, log):
         log(f"Total img/sec on {n} chip(s): {mean * n:.1f} +-{conf * n:.1f}",
             file=sys.stderr)
     metric, unit = metric_contract(args)
+    stamp = {**stamp, **snapshot_field(args, snap_ms, mean, batch_size)}
     return mean, peak, unit, metric, stamp
 
 
@@ -434,12 +488,17 @@ def bench_lm(args, log):
         file=sys.stderr)
     units_per_iter = batch_size * L * k * args.num_batches_per_iter
     stamp = overlap_stamp(args, state, log)
+    snap_ms = (measure_snapshot_ms(state, log)
+               if args.snapshot_every > 0 and not args.compile_only
+               else None)
     mean, conf, peak = run_timed(run_step, state, batch, args,
                                  units_per_iter, "tokens/sec", log)
     if not args.compile_only:
         log(f"Total tokens/sec on {n} chip(s): {mean * n:.1f} "
             f"+-{conf * n:.1f}", file=sys.stderr)
     metric, unit = metric_contract(args)
+    stamp = {**stamp,
+             **snapshot_field(args, snap_ms, mean, batch_size * L)}
     return mean, peak, unit, metric, {"attention": attention,
                                       "flash_grid": flash_grid,
                                       **stamp}
@@ -574,6 +633,7 @@ def supervise(argv, args):
             "vs_baseline": None, "peak": None, "probe_tflops": None,
             "window": getattr(args, "steps_per_dispatch", 1),
             "overlap": getattr(args, "overlap", None),
+            "snapshot": None,
             "error": f"supervisor received signal {signum} mid-run "
                      f"(outer/driver deadline?); last state: {last_err}",
         }), flush=True)
@@ -674,6 +734,7 @@ def supervise(argv, args):
         "vs_baseline": None, "peak": None, "probe_tflops": None,
         "window": getattr(args, "steps_per_dispatch", 1),
         "overlap": getattr(args, "overlap", None),
+        "snapshot": None,
         "error": last_err,
     }))
     return 0
@@ -732,6 +793,17 @@ def build_parser():
                              "Default: the HOROVOD_OVERLAP env knob "
                              "(auto). The record stamps the mode plus "
                              "the bucket plan (count/MB/oversize)")
+    parser.add_argument("--snapshot-every", type=int, default=0,
+                        help="measure the elastic snapshot overhead at "
+                             "this cadence (steps between host-RAM "
+                             "snapshots; horovod_tpu.elastic) and stamp "
+                             "{'every', 'ms_per_snapshot', "
+                             "'overhead_pct'} into the record as "
+                             "'snapshot'. 0 (default) = off. The "
+                             "elastic default cadence is 100 "
+                             "(HOROVOD_SNAPSHOT_EVERY); acceptance "
+                             "budget: overhead <= 2%% of step time at "
+                             "the default cadence")
     parser.add_argument("--flash-attention", action="store_true",
                         help="transformer_lm: run the Pallas flash "
                              "attention kernel instead of dense "

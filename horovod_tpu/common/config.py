@@ -30,6 +30,18 @@ OVERLAP_MODES = ("auto", "on", "off")
 FUSION_BUFFER_ATOMIC_UNIT = 64
 # Reference: STALL_WARNING_TIME 60s (operations.cc:258).
 DEFAULT_STALL_WARNING_SECS = 60.0
+# Bounded deadline on native-lane collective completion
+# (HOROVOD_NEGOTIATION_TIMEOUT, seconds). 0 = reference behavior: warn
+# on stalls, wait forever. Non-zero: NativeCore.wait raises a typed
+# HorovodTimeoutError past the deadline instead of hanging silently —
+# the elastic supervisor (horovod_tpu/elastic/) converts that into a
+# relaunch from the last snapshot.
+DEFAULT_NEGOTIATION_TIMEOUT_SECS = 0.0
+# Elastic snapshot cadence (steps between host-RAM snapshots). Sized so
+# a ~1 ms/100 MB d2h snapshot against a ~20 ms step stays well under a
+# 2% overhead budget at the default; docs/elastic.md has the cadence
+# math (HOROVOD_SNAPSHOT_EVERY).
+DEFAULT_SNAPSHOT_EVERY = 100
 
 
 def _env_bool(name: str) -> bool:
@@ -90,6 +102,11 @@ class Config:
     # Stall detection (HOROVOD_STALL_CHECK_DISABLE).
     stall_check_disable: bool = False
     stall_warning_secs: float = DEFAULT_STALL_WARNING_SECS
+    # Native collective completion deadline (HOROVOD_NEGOTIATION_TIMEOUT,
+    # seconds; 0 = wait forever, the reference's semantics).
+    negotiation_timeout_secs: float = DEFAULT_NEGOTIATION_TIMEOUT_SECS
+    # Elastic snapshot cadence (HOROVOD_SNAPSHOT_EVERY, steps).
+    snapshot_every: int = DEFAULT_SNAPSHOT_EVERY
     # Hierarchical collectives: on TPU this selects the explicit two-level
     # ladder (reduce-scatter in the fast domain, cross-reduce, all-gather)
     # rather than NCCL+MPI staging (reference semantics:
@@ -125,6 +142,13 @@ class Config:
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
             stall_warning_secs=_env_float(
                 "HOROVOD_STALL_WARNING_TIME", DEFAULT_STALL_WARNING_SECS
+            ),
+            negotiation_timeout_secs=_env_float(
+                "HOROVOD_NEGOTIATION_TIMEOUT",
+                DEFAULT_NEGOTIATION_TIMEOUT_SECS,
+            ),
+            snapshot_every=_env_int(
+                "HOROVOD_SNAPSHOT_EVERY", DEFAULT_SNAPSHOT_EVERY
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),

@@ -39,6 +39,25 @@ class InvalidArgumentError(HorovodError, ValueError):
     negotiation (reference ConstructMPIResponse, operations.cc:321-523)."""
 
 
+class HorovodTimeoutError(HorovodError):
+    """A native collective sat past its bounded deadline
+    (``HOROVOD_NEGOTIATION_TIMEOUT``) without completing.
+
+    The reference only *warned* on stalls (CheckForStalledTensors,
+    operations.cc:1625-1672) and then hung forever; the elastic
+    subsystem (:mod:`horovod_tpu.elastic`) needs a typed, attributable
+    failure instead — the supervisor treats it like a crashed rank and
+    relaunches from the last snapshot. Carries the observing rank and
+    the stalled tensor's name; the op may still be in flight, so the
+    only safe recovery is process exit + relaunch."""
+
+    def __init__(self, message: str, rank: int = -1,
+                 tensor_name: str = ""):
+        super().__init__(message)
+        self.rank = rank
+        self.tensor_name = tensor_name
+
+
 class StalledTensorWarning(UserWarning):
     """Emitted when a tensor sits un-negotiated past the stall deadline
     (reference CheckForStalledTensors, operations.cc:1625-1672)."""

@@ -1,0 +1,73 @@
+"""horovod_tpu.elastic — preemption-tolerant training.
+
+The subsystem upstream Horovod grew right after the reference's 0.15
+era (Elastic Horovod, v0.20), rebuilt TPU-native: a single preempted
+worker or reclaimed TPU must cost at most one snapshot cadence of
+recomputation, never the run.
+
+Pieces (each its own module, composable a la carte):
+
+* :mod:`~horovod_tpu.elastic.snapshot` — double-buffered host-RAM
+  snapshots every K steps (async d2h), spilled through the
+  :class:`~horovod_tpu.flax.CheckpointManager` on a slower cadence with
+  an atomic **resume manifest** (step, RNG key, data-shard cursor);
+* :mod:`~horovod_tpu.elastic.signals` — SIGTERM/preemption hook:
+  flag-only handler, drain + final sync snapshot at the next step
+  boundary, exit with the distinct ``EXIT_PREEMPTED`` (75) status;
+* :mod:`~horovod_tpu.elastic.supervisor` — the
+  ``hvdrun --elastic --max-restarts N`` relaunch policy over the
+  launcher's per-rank exit classification;
+* :mod:`~horovod_tpu.elastic.faults` — ``HOROVOD_FAULT_PLAN``
+  deterministic fault injection (kill/preempt/stall/exit per rank per
+  step), so every recovery path runs in CI on CPU;
+* :mod:`~horovod_tpu.elastic.loop` — :func:`run_elastic`, the loop that
+  wires all of it around any ``(state, batch) -> (state, metrics)``
+  step function (plain or ``lax.scan``-windowed).
+
+Quick start::
+
+    ckpt = hvd_flax.CheckpointManager("/ckpts")
+    state, metrics, resumed = elastic.run_elastic(
+        train_step, state, source.batch_at, num_steps=10_000,
+        manager=ckpt, snapshot_every=100, spill_every=5)
+
+launched as::
+
+    hvdrun --elastic --max-restarts 3 -np 8 python train.py
+
+docs/elastic.md has the cadence math, manifest format, fault-plan
+grammar and the preemption runbook.
+"""
+
+from horovod_tpu.elastic.faults import (FaultAction, FaultInjector,
+                                        FaultPlanError, parse_fault_plan)
+from horovod_tpu.elastic.loop import ShardedBatchSource, run_elastic
+from horovod_tpu.elastic.signals import EXIT_PREEMPTED, PreemptionHandler
+from horovod_tpu.elastic.snapshot import (ResumeManifest, Snapshotter,
+                                          latest_manifest, manifest_steps,
+                                          read_manifest, write_manifest)
+from horovod_tpu.elastic.supervisor import supervise
+from horovod_tpu.run.driver import (EXIT_CLEAN, EXIT_USAGE, WorkerExit,
+                                    classify_exit)
+
+__all__ = [
+    "run_elastic",
+    "ShardedBatchSource",
+    "Snapshotter",
+    "ResumeManifest",
+    "write_manifest",
+    "read_manifest",
+    "latest_manifest",
+    "manifest_steps",
+    "PreemptionHandler",
+    "FaultInjector",
+    "FaultAction",
+    "FaultPlanError",
+    "parse_fault_plan",
+    "supervise",
+    "classify_exit",
+    "WorkerExit",
+    "EXIT_CLEAN",
+    "EXIT_PREEMPTED",
+    "EXIT_USAGE",
+]

@@ -1,0 +1,186 @@
+"""Deterministic fault injection: every recovery path testable on CPU.
+
+A recovery subsystem that is only exercised by real preemptions is an
+untested subsystem. ``HOROVOD_FAULT_PLAN`` describes, in one line, which
+rank fails, how, and at which step::
+
+    HOROVOD_FAULT_PLAN="kill:rank=1,step=7;stall:rank=2,step=12"
+
+Grammar (semicolon-separated actions)::
+
+    <kind>:key=value[,key=value...]
+
+    kind    kill     | die by SIGKILL (crash: no cleanup, no snapshot —
+                     | the OOM-kill / hardware-loss shape)
+            preempt  | deliver SIGTERM to self (exercises the
+                     | signals.py drain -> snapshot -> EXIT_PREEMPTED path)
+            stall    | stop making progress for `secs` (default: forever)
+                     | — exercises the bounded-deadline path
+                     | (HOROVOD_NEGOTIATION_TIMEOUT -> HorovodTimeoutError)
+            exit     | plain sys.exit(`code`) (default 1)
+    rank    which global rank fires the action (required)
+    step    the training step BOUNDARY at or after which it fires
+            (required; window loops hit the first boundary >= step)
+    attempt which elastic launch attempt it fires on (default 0: the
+            first launch only, so the relaunch survives — the
+            supervisor exports HOROVOD_ELASTIC_RESTART)
+    secs    stall duration (stall only)
+    code    exit code (exit only)
+
+The plan is parsed (and validated fail-fast) by the launcher
+(``hvdrun --fault-plan``), threaded to workers through the environment,
+and consumed at step boundaries by :class:`FaultInjector` —
+:func:`horovod_tpu.elastic.loop.run_elastic` calls ``maybe_inject``
+before every window dispatch. Each action fires at most once per
+process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+KINDS = ("kill", "preempt", "stall", "exit")
+
+_INT_KEYS = ("rank", "step", "attempt", "code")
+_FLOAT_KEYS = ("secs",)
+
+
+class FaultPlanError(ValueError):
+    """Malformed HOROVOD_FAULT_PLAN — raised at parse (launcher) time so
+    a typo'd plan fails the launch, not silently injects nothing."""
+
+
+@dataclasses.dataclass
+class FaultAction:
+    kind: str
+    rank: int
+    step: int
+    attempt: int = 0
+    secs: Optional[float] = None   # stall duration; None = forever
+    code: int = 1                  # exit code (kind="exit")
+
+    def __str__(self) -> str:
+        extra = ""
+        if self.kind == "stall" and self.secs is not None:
+            extra = f",secs={self.secs:g}"
+        if self.kind == "exit":
+            extra = f",code={self.code}"
+        return (f"{self.kind}:rank={self.rank},step={self.step}"
+                f",attempt={self.attempt}{extra}")
+
+
+def parse_fault_plan(plan: str) -> List[FaultAction]:
+    """Parse the ``HOROVOD_FAULT_PLAN`` grammar into actions.
+
+    Empty/whitespace plans parse to ``[]``; anything malformed raises
+    :class:`FaultPlanError` naming the offending clause.
+    """
+    actions: List[FaultAction] = []
+    for clause in (plan or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, sep, rest = clause.partition(":")
+        kind = kind.strip().lower()
+        if not sep or kind not in KINDS:
+            raise FaultPlanError(
+                f"fault plan clause {clause!r}: expected "
+                f"'<kind>:rank=R,step=S[,...]' with kind in {KINDS}")
+        kv = {}
+        for pair in rest.split(","):
+            key, psep, value = pair.partition("=")
+            key = key.strip().lower()
+            if not psep or (key not in _INT_KEYS
+                            and key not in _FLOAT_KEYS):
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: bad key/value "
+                    f"{pair.strip()!r} (keys: rank, step, attempt, "
+                    "secs, code)")
+            try:
+                kv[key] = (float(value) if key in _FLOAT_KEYS
+                           else int(value))
+            except ValueError:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: {key}={value!r} is "
+                    "not a number") from None
+        if "rank" not in kv or "step" not in kv:
+            raise FaultPlanError(
+                f"fault plan clause {clause!r}: rank= and step= are "
+                "required")
+        actions.append(FaultAction(
+            kind=kind, rank=kv["rank"], step=kv["step"],
+            attempt=kv.get("attempt", 0), secs=kv.get("secs"),
+            code=kv.get("code", 1)))
+    return actions
+
+
+class FaultInjector:
+    """Per-process executor of the fault plan.
+
+    Filtered at construction to this rank + this elastic attempt, then
+    ``maybe_inject(step)`` fires each matching action exactly once at
+    the first step boundary at or past its ``step``. With no plan it is
+    a no-op whose fast path is one ``if not self._armed``.
+    """
+
+    def __init__(self, actions: Optional[List[FaultAction]] = None,
+                 rank: Optional[int] = None,
+                 attempt: Optional[int] = None):
+        if actions is None:
+            actions = parse_fault_plan(
+                os.environ.get("HOROVOD_FAULT_PLAN", ""))
+        if rank is None:
+            rank = int(os.environ.get("HOROVOD_RANK", "0"))
+        if attempt is None:
+            attempt = int(os.environ.get("HOROVOD_ELASTIC_RESTART", "0"))
+        self.rank = rank
+        self.attempt = attempt
+        self._armed = sorted(
+            (a for a in actions
+             if a.rank == rank and a.attempt == attempt),
+            key=lambda a: a.step)
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        return cls()
+
+    @property
+    def pending(self) -> List[FaultAction]:
+        return list(self._armed)
+
+    def maybe_inject(self, step: int, preemption=None) -> None:
+        """Fire every armed action whose step boundary has been reached.
+
+        ``preemption``: an optional
+        :class:`horovod_tpu.elastic.signals.PreemptionHandler`; when
+        given, ``preempt`` actions trigger it directly (deterministic,
+        no signal-delivery race) instead of signalling the process.
+        """
+        if not self._armed:
+            return
+        while self._armed and self._armed[0].step <= step:
+            action = self._armed.pop(0)
+            self._fire(action, preemption)
+
+    def _fire(self, action: FaultAction, preemption=None) -> None:
+        print(f"[hvd elastic] fault injection: {action} firing at "
+              f"rank {self.rank} attempt {self.attempt}",
+              file=sys.stderr, flush=True)
+        if action.kind == "kill":
+            # SIGKILL to self: the closest CPU-testable stand-in for an
+            # OOM-kill / node loss — no atexit, no snapshot, no flush.
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif action.kind == "preempt":
+            if preemption is not None:
+                preemption.trigger()
+            else:
+                os.kill(os.getpid(), signal.SIGTERM)
+        elif action.kind == "stall":
+            time.sleep(action.secs if action.secs is not None else 10**9)
+        elif action.kind == "exit":
+            sys.exit(action.code)

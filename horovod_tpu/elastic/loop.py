@@ -1,0 +1,203 @@
+"""The elastic training loop: resume, snapshot, inject, survive.
+
+:func:`run_elastic` wraps any ``(state, batch) -> (state, metrics)``
+step function with the full preemption-tolerance stack:
+
+* **resume** — restore the newest manifested snapshot from the
+  :class:`~horovod_tpu.flax.CheckpointManager` before the first step
+  (bit-exact: weights + opt state + step counter come back as written;
+  the data stream re-derives from the step because
+  :mod:`horovod_tpu.data.sharding` is deterministic in
+  ``(seed, epoch, rank, size)``);
+* **snapshot** — a :class:`~horovod_tpu.elastic.snapshot.Snapshotter`
+  on a window-aligned cadence (async d2h, disk spill + manifest on the
+  slower ``spill_every`` cadence);
+* **preemption** — a deferred SIGTERM flag checked at every window
+  boundary; on trigger: drain, final sync snapshot, exit
+  ``EXIT_PREEMPTED`` (:mod:`horovod_tpu.elastic.signals`);
+* **fault injection** — ``HOROVOD_FAULT_PLAN`` actions fire at their
+  step boundaries (:mod:`horovod_tpu.elastic.faults`), so every one of
+  these paths is CPU-testable.
+
+Windows: ``steps_per_dispatch=K`` compiles K steps into one
+``lax.scan`` program (:mod:`horovod_tpu.jax.window`); boundaries —
+snapshot points, preemption checks, injection points — then fall every
+K steps. The train state is NOT donated here: an async snapshot may
+still be copying a buffer the next dispatch would otherwise reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.elastic.faults import FaultInjector
+from horovod_tpu.elastic.signals import PreemptionHandler
+from horovod_tpu.elastic.snapshot import Snapshotter
+
+
+class ShardedBatchSource:
+    """Deterministic, cursor-addressable per-rank batch stream.
+
+    Wraps :func:`horovod_tpu.data.sharding.shard_indices` so that the
+    batch for global step ``s`` is a pure function of
+    ``(seed, rank, size, s)`` — which is what makes the resume manifest
+    one integer instead of an iterator pickle. ``cursor(step)`` reports
+    the classic ``{"epoch": e, "offset": o}`` per-rank shard position
+    for the manifest.
+    """
+
+    def __init__(self, arrays: dict, batch_size: int,
+                 rank: Optional[int] = None, size: Optional[int] = None,
+                 shuffle: bool = True, seed: int = 0):
+        from horovod_tpu.data.sharding import _resolve
+
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"array lengths differ: {lengths}")
+        self.arrays = arrays
+        self.n = next(iter(lengths.values()))
+        self.batch_size = int(batch_size)
+        self.rank, self.size = _resolve(rank, size)
+        self.shuffle = shuffle
+        self.seed = seed
+        per_rank = -(-self.n // self.size)  # ceil: padded shard length
+        self.steps_per_epoch = max(1, per_rank // self.batch_size)
+
+    def cursor(self, step: int) -> dict:
+        return {"epoch": step // self.steps_per_epoch,
+                "offset": (step % self.steps_per_epoch) * self.batch_size,
+                "rank": self.rank, "size": self.size}
+
+    def batch_at(self, step: int) -> dict:
+        from horovod_tpu.data.sharding import shard_indices
+
+        cur = self.cursor(step)
+        idx = shard_indices(self.n, cur["epoch"], self.rank, self.size,
+                            self.shuffle, self.seed)
+        sel = idx[cur["offset"]:cur["offset"] + self.batch_size]
+        return {k: v[sel] for k, v in self.arrays.items()}
+
+    __call__ = batch_at
+
+
+def run_elastic(
+    step_fn: Callable,
+    state: Any,
+    batch_for_step: Callable[[int], Any],
+    num_steps: int,
+    *,
+    manager=None,
+    snapshot_every: Optional[int] = None,
+    spill_every: int = 1,
+    steps_per_dispatch: int = 1,
+    rng_key=None,
+    snapshotter: Optional[Snapshotter] = None,
+    injector: Optional[FaultInjector] = None,
+    preemption: Optional[PreemptionHandler] = None,
+    cursor_fn: Optional[Callable[[int], Any]] = None,
+    on_step: Optional[Callable[[int, Any], None]] = None,
+    jit: bool = True,
+    final_snapshot: bool = True,
+) -> Tuple[Any, List[Tuple[int, Any]], int]:
+    """Run ``num_steps`` of ``step_fn`` with snapshots and auto-resume.
+
+    ``batch_for_step(step) -> batch`` must be deterministic in the step
+    (use :class:`ShardedBatchSource` for real datasets) — that, plus
+    the restored state, is the whole bit-exactness argument: replayed
+    steps see identical inputs and identical carried state, so the loss
+    trajectory after a kill/restore is the fault-free trajectory.
+
+    Returns ``(state, metrics, resumed_from)`` where ``metrics`` is a
+    list of ``(completed_steps, window_metrics)`` for the windows this
+    invocation actually ran, and ``resumed_from`` the snapshot step the
+    run restored (0 = fresh start). ``on_step`` is called with the same
+    pair after each window (streaming logs that survive a kill).
+    """
+    import jax
+
+    from horovod_tpu.jax.window import stack_batches, windowed
+
+    k = max(1, int(steps_per_dispatch))
+    if num_steps % k:
+        raise ValueError(
+            f"num_steps {num_steps} must be a multiple of "
+            f"steps_per_dispatch {k}")
+    if snapshotter is None:
+        snapshotter = Snapshotter(manager, every=snapshot_every,
+                                  spill_every=spill_every)
+    snapshotter.check_alignment(k)
+    if injector is None:
+        injector = FaultInjector.from_env()
+    own_handler = preemption is None
+    if own_handler:
+        preemption = PreemptionHandler()
+    if cursor_fn is None:
+        cursor_fn = getattr(batch_for_step, "cursor", lambda s: s)
+
+    # ---- resume -----------------------------------------------------
+    # Gate on the SNAPSHOTTER's manager: a caller passing a pre-built
+    # Snapshotter(manager=...) must resume too, not just spill.
+    # (restore itself returns None when there is no manager anywhere.)
+    resumed_from = 0
+    restored = snapshotter.restore(state)
+    if restored is not None:
+        state, manifest = restored
+        resumed_from = manifest.step
+        if manifest.rng_key is not None and rng_key is not None:
+            rng_key = jax.numpy.asarray(
+                manifest.rng(), dtype=np.asarray(rng_key).dtype)
+        if resumed_from % k:
+            raise ValueError(
+                f"manifest step {resumed_from} is not a window "
+                f"boundary for steps_per_dispatch {k} — it was written "
+                "by a loop with a different window size; rerun with "
+                "the original steps_per_dispatch")
+
+    window_fn = windowed(step_fn, k)
+    if jit:
+        window_fn = jax.jit(window_fn)
+
+    def _aux(step):
+        aux = {"cursor": cursor_fn(step)}
+        if rng_key is not None:
+            aux["rng_key"] = rng_key
+        return aux
+
+    metrics_out: List[Tuple[int, Any]] = []
+    step = resumed_from
+    try:
+        while step < num_steps:
+            injector.maybe_inject(step, preemption=preemption)
+            if preemption.check():
+                preemption.finalize(snapshotter, step, state,
+                                    **_aux(step))
+            if k == 1:
+                batch = batch_for_step(step)
+            else:
+                batch = stack_batches(
+                    [batch_for_step(s) for s in range(step, step + k)])
+            state, metrics = window_fn(state, batch)
+            step += k
+            snapshotter.maybe(step, state, **_aux(step))
+            metrics_out.append((step, metrics))
+            if on_step is not None:
+                on_step(step, metrics)
+        # One final boundary: a preemption that arrived during the last
+        # window still exits preempted (a terminating cluster would
+        # otherwise SIGKILL us mid-teardown), and the finished run
+        # leaves a complete manifest behind so re-invocation is a no-op
+        # resume.
+        injector.maybe_inject(step, preemption=preemption)
+        if preemption.check():
+            preemption.finalize(snapshotter, step, state, **_aux(step))
+        if final_snapshot and snapshotter.manager is not None:
+            state = jax.block_until_ready(state)
+            snapshotter.flush(step, state, **_aux(step))
+    finally:
+        # A handler this loop installed must not outlive it (finalize's
+        # exit path uninstalls on its own before exiting).
+        if own_handler:
+            preemption.uninstall()
+    return state, metrics_out, resumed_from

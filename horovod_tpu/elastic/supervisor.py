@@ -1,0 +1,97 @@
+"""Supervised elastic relaunch: `hvdrun --elastic --max-restarts N`.
+
+The launcher's fail-fast kill-all (reference MPI semantics) is the
+right *teardown*; this module adds the right *recovery*: classify the
+incident from the trigger worker's exit code
+(:func:`horovod_tpu.run.driver.classify_exit`), tear the world down,
+and relaunch every rank. Workers find the latest resume manifest on
+disk (:mod:`horovod_tpu.elastic.snapshot`) and continue from the last
+committed snapshot — so a preempted or crashed rank costs at most one
+snapshot cadence of recomputation, not the run.
+
+Per-incident policy:
+
+* ``clean``     -> done, exit 0
+* ``usage``     -> exit 2 immediately (deterministic; reruns identically)
+* ``preempted`` -> relaunch (does NOT consume the restart budget by
+  default: preemptions are the environment's fault and can recur
+  arbitrarily often; ``count_preemptions=True`` restores strict
+  budgeting)
+* ``crashed``   -> relaunch, consuming one restart
+
+Each attempt exports ``HOROVOD_ELASTIC=1`` and
+``HOROVOD_ELASTIC_RESTART=<attempt>`` so fault plans
+(:mod:`horovod_tpu.elastic.faults`) stay attempt-deterministic and
+training code can tell a relaunch from a first launch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, Optional, Sequence
+
+from horovod_tpu.run import launch_job
+from horovod_tpu.run.driver import EXIT_USAGE
+
+
+def _log(msg: str) -> None:
+    print(f"hvdrun[elastic]: {msg}", file=sys.stderr, flush=True)
+
+
+def supervise(cmd: Sequence[str], np: int,
+              hosts: Optional[str] = None,
+              env: Optional[Dict[str, str]] = None,
+              jax_distributed: bool = False,
+              max_restarts: int = 1,
+              restart_delay: float = 0.0,
+              count_preemptions: bool = False,
+              max_total_attempts: int = 1000,
+              _launch=launch_job) -> int:
+    """Run ``cmd`` elastically; returns the final job exit code.
+
+    ``max_restarts`` bounds crash-triggered relaunches; preemptions
+    relaunch for free unless ``count_preemptions`` (with
+    ``max_total_attempts`` as the runaway backstop either way).
+    ``_launch`` is injectable for tests.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    base_env = dict(env if env is not None else os.environ)
+    restarts_used = 0
+    attempt = 0
+    while True:
+        wenv = dict(base_env)
+        wenv["HOROVOD_ELASTIC"] = "1"
+        wenv["HOROVOD_ELASTIC_RESTART"] = str(attempt)
+        result = _launch(cmd, np=np, hosts=hosts, env=wenv,
+                         jax_distributed=jax_distributed)
+        category = result.category
+        if category == "clean":
+            if attempt:
+                _log(f"job completed after {attempt} relaunch(es)")
+            return 0
+        if category == "usage":
+            # Exit code 2 reruns identically (bad flags, import-time
+            # misuse); burning the budget only delays the real error.
+            _log(f"{result.describe()} — deterministic usage error, "
+                 "not relaunching")
+            return EXIT_USAGE
+        consumes = category == "crashed" or count_preemptions
+        budget_left = max_restarts - restarts_used
+        if (consumes and budget_left <= 0) \
+                or attempt + 1 >= max_total_attempts:
+            _log(f"{result.describe()} — restart budget exhausted "
+                 f"({restarts_used}/{max_restarts} used); giving up")
+            return result.code
+        if consumes:
+            restarts_used += 1
+        attempt += 1
+        _log(f"{result.describe()} — relaunching all ranks from the "
+             f"latest snapshot (attempt {attempt}; "
+             f"{max_restarts - restarts_used} crash restart(s) left)")
+        if restart_delay > 0:
+            # ssh-remote teardown is asynchronous (pty HUP): let it
+            # settle before the relaunch contends for devices.
+            time.sleep(restart_delay)

@@ -20,6 +20,7 @@ from horovod_tpu.flax.callbacks import (
     BroadcastGlobalVariablesCallback,
     Callback,
     CheckpointCallback,
+    ElasticSnapshotCallback,
     LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
@@ -169,6 +170,7 @@ __all__ = [
     "load_model",
     "CheckpointManager",
     "CheckpointCallback",
+    "ElasticSnapshotCallback",
     "get_hyperparam",
     "set_hyperparam",
 ]

@@ -191,6 +191,49 @@ class CheckpointCallback(Callback):
         self.manager.wait_until_finished()
 
 
+class ElasticSnapshotCallback(Callback):
+    """Elastic-subsystem binding for :class:`TrainLoop`: cadence
+    snapshots of ``loop.state`` plus the deferred-preemption epilogue,
+    at batch boundaries (the keras-lane face of
+    :func:`horovod_tpu.elastic.run_elastic`).
+
+    ``snapshotter``: a :class:`horovod_tpu.elastic.Snapshotter`.
+    ``preemption``: a :class:`horovod_tpu.elastic.PreemptionHandler`
+    (default: install one on SIGTERM at train begin). ``step_counter``
+    maps the loop state to the global step id (default: the state's
+    ``"step"`` entry, the :class:`horovod_tpu.models.TrainState`
+    layout); snapshots/manifests are labelled with it, so a relaunched
+    loop can restore via ``snapshotter.restore(state)`` before ``fit``.
+    """
+
+    def __init__(self, snapshotter, preemption=None, step_counter=None):
+        self.snapshotter = snapshotter
+        self.preemption = preemption
+        self.step_counter = (step_counter
+                             or (lambda state: int(state["step"])))
+
+    def on_train_begin(self, logs=None):
+        if self.preemption is None:
+            from horovod_tpu.elastic.signals import PreemptionHandler
+
+            self.preemption = PreemptionHandler()
+
+    def on_batch_end(self, batch, logs=None):
+        step = self.step_counter(self.loop.state)
+        if self.preemption.check():
+            # Boundary-time drain + final sync snapshot + exit(75):
+            # the deferred half of the flag-only signal handler.
+            self.preemption.finalize(self.snapshotter, step,
+                                     self.loop.state)
+        self.snapshotter.maybe(step, self.loop.state)
+
+    def on_train_end(self, logs=None):
+        self.snapshotter.flush(self.step_counter(self.loop.state),
+                               self.loop.state)
+        if self.preemption is not None:
+            self.preemption.uninstall()
+
+
 class MetricAverageCallback(Callback):
     """Average epoch-end metrics over ranks (reference :33-67). Metrics
     produced inside ``spmd_run`` are already chip-averaged; this covers

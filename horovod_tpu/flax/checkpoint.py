@@ -1,4 +1,4 @@
-"""Orbax-backed checkpointing — the TPU-native checkpoint/resume path.
+"""Checkpointing — orbax-backed when available, pure-numpy otherwise.
 
 The reference delegated checkpointing to frameworks and contributed the
 *discipline*: write on rank 0 only, restore then re-broadcast (reference
@@ -10,6 +10,19 @@ larger than any single host, so "rank 0 writes everything" stops being
 possible. Orbax writes each array shard from the process that owns it,
 commits atomically, and restores arrays directly to their target
 shardings — no gather, no re-broadcast.
+
+Two backends behind one :class:`CheckpointManager` surface:
+
+* **orbax** (default when importable) — the full pod story: cross-host
+  sharded arrays, async commit, the OCDBT formats.
+* **numpy** (automatic fallback, or ``backend="numpy"`` /
+  ``HVD_CHECKPOINT_BACKEND=numpy``) — a dependency-free per-process
+  shard writer with atomic rename-commit, so the elastic disk spill
+  (:mod:`horovod_tpu.elastic.snapshot`) and its CI run in environments
+  without orbax. It handles every state whose leaves are addressable by
+  the writing process (single-host jobs, including locally-sharded ZeRO
+  state); cross-host sharded leaves need orbax. Restore requires a
+  ``template`` (the structure/dtype/sharding donor).
 
 Usage::
 
@@ -26,21 +39,104 @@ Usage::
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+BACKENDS = ("auto", "orbax", "numpy")
+
+
+def _resolve_backend(backend: Optional[str]) -> str:
+    choice = (backend
+              or os.environ.get("HVD_CHECKPOINT_BACKEND", "").strip().lower()
+              or "auto")
+    if choice not in BACKENDS:
+        raise ValueError(
+            f"checkpoint backend {choice!r}: expected one of {BACKENDS}")
+    if choice == "numpy":
+        return "numpy"
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return "orbax"
+    except ImportError:
+        if choice == "orbax":
+            raise
+        return "numpy"
 
 
 class CheckpointManager:
-    """Thin veneer over ``orbax.checkpoint.CheckpointManager`` wired to
-    horovod_tpu semantics: every process participates (required for
-    sharded state), saves are atomic, old steps are garbage-collected."""
+    """Thin veneer wired to horovod_tpu semantics: every process
+    participates (required for sharded state), saves are atomic, old
+    steps are garbage-collected. ``backend`` pins the implementation
+    (``auto`` | ``orbax`` | ``numpy``; env ``HVD_CHECKPOINT_BACKEND``);
+    the :attr:`backend` attribute reports what was resolved."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, backend: Optional[str] = None):
+        self.backend = _resolve_backend(backend)
+        impl = (_OrbaxManager if self.backend == "orbax"
+                else _NumpyManager)
+        self._impl = impl(os.path.abspath(directory),
+                          max_to_keep=max_to_keep, async_save=async_save)
+
+    @property
+    def directory(self) -> str:
+        return self._impl.directory
+
+    def save(self, step: int, state: Any) -> bool:
+        """Save ``state`` (any pytree of arrays, sharded or replicated)
+        under ``step``. Returns whether a save was performed (the manager
+        may skip per its policy)."""
+        return self._impl.save(int(step), state)
+
+    def restore(self, step: Optional[int] = None, template: Any = None):
+        """Restore ``step`` (default: latest). ``template`` — a concrete
+        or abstract (ShapeDtypeStruct) pytree — pins structure, dtypes and
+        target shardings; sharded leaves come back sharded. The numpy
+        backend requires it."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}")
+        return self._impl.restore(int(step), template)
+
+    def latest_step(self) -> Optional[int]:
+        return self._impl.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return self._impl.all_steps()
+
+    def wait_until_finished(self) -> None:
+        """Block until outstanding async saves are committed."""
+        self._impl.wait_until_finished()
+
+    def close(self) -> None:
+        self._impl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _OrbaxManager:
+    """The orbax path (unchanged semantics from the pre-fallback
+    manager)."""
+
+    def __init__(self, directory: str, max_to_keep: int,
+                 async_save: bool):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        directory = os.path.abspath(directory)
+        self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             directory,
@@ -51,47 +147,200 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any) -> bool:
-        """Save ``state`` (any pytree of arrays, sharded or replicated)
-        under ``step``. Returns whether a save was performed (the manager
-        may skip per its policy)."""
         return self._mngr.save(
-            int(step), args=self._ocp.args.StandardSave(state)
-        )
+            step, args=self._ocp.args.StandardSave(state))
 
-    def restore(self, step: Optional[int] = None, template: Any = None):
-        """Restore ``step`` (default: latest). ``template`` — a concrete
-        or abstract (ShapeDtypeStruct) pytree — pins structure, dtypes and
-        target shardings; sharded leaves come back sharded."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found under {self._mngr.directory}"
-                )
+    def restore(self, step: int, template: Any):
         args = (
             self._ocp.args.StandardRestore(template)
             if template is not None
             else self._ocp.args.StandardRestore()
         )
-        return self._mngr.restore(int(step), args=args)
+        return self._mngr.restore(step, args=args)
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
-    def all_steps(self):
+    def all_steps(self) -> List[int]:
         return sorted(self._mngr.all_steps())
 
     def wait_until_finished(self) -> None:
-        """Block until outstanding async saves are committed."""
         self._mngr.wait_until_finished()
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
         self._mngr.close()
 
-    def __enter__(self):
-        return self
 
-    def __exit__(self, *exc):
-        self.close()
-        return False
+# ------------------------------------------------------------- numpy shard
+# Layout:  <root>/step_<n>/shard-<proc>.bin   raw little-endian leaf bytes
+#          <root>/step_<n>/shard-<proc>.json  leaf dtypes/shapes/offsets
+#          <root>/step_<n>/COMMIT             commit marker (written last)
+# Every file lands via tmp + os.replace; the COMMIT marker (written by
+# process 0 once every process's shard json exists) makes the whole step
+# atomic — readers ignore uncommitted step dirs.
+
+_COMMIT = "COMMIT"
+
+
+def _proc_info():
+    from horovod_tpu.common import basics
+
+    if basics.is_initialized():
+        return basics.process_rank(), basics.process_count()
+    return 0, 1
+
+
+class _NumpyManager:
+    """Pure-numpy per-process shard writer with atomic rename-commit."""
+
+    def __init__(self, directory: str, max_to_keep: int,
+                 async_save: bool):
+        # async_save accepted for API parity; writes are synchronous
+        # (the elastic Snapshotter provides the async layer above).
+        del async_save
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # ----------------------------------------------------------- helpers
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def _committed(self, path: str) -> bool:
+        return os.path.exists(os.path.join(path, _COMMIT))
+
+    # -------------------------------------------------------------- save
+    def save(self, step: int, state: Any) -> bool:
+        import jax
+
+        proc, nproc = _proc_info()
+        step_dir = self._step_dir(step)
+        os.makedirs(step_dir, exist_ok=True)
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        meta = []
+        offset = 0
+        bin_tmp = os.path.join(step_dir, f".shard-{proc}.bin.tmp")
+        with open(bin_tmp, "wb") as f:
+            for i, leaf in enumerate(leaves):
+                # np.asarray keeps 0-d shape (ascontiguousarray would
+                # promote scalars to (1,)); tobytes C-order-copies any
+                # non-contiguous input.
+                arr = np.asarray(leaf)
+                data = arr.tobytes()
+                f.write(data)
+                meta.append({"dtype": arr.dtype.name,
+                             "shape": list(arr.shape),
+                             "offset": offset, "nbytes": len(data)})
+                offset += len(data)
+        os.replace(bin_tmp, os.path.join(step_dir, f"shard-{proc}.bin"))
+        json_tmp = os.path.join(step_dir, f".shard-{proc}.json.tmp")
+        with open(json_tmp, "w") as f:
+            json.dump({"leaves": meta, "proc": proc, "nproc": nproc}, f)
+        # The json landing second marks THIS shard complete (its .bin is
+        # already in place); the dir-level COMMIT lands after all shards.
+        os.replace(json_tmp, os.path.join(step_dir, f"shard-{proc}.json"))
+        if proc == 0:
+            self._wait_for_shards(step_dir, nproc)
+            tmp = os.path.join(step_dir, f".{_COMMIT}.tmp")
+            with open(tmp, "w") as f:
+                f.write(f"{nproc}\n")
+            os.replace(tmp, os.path.join(step_dir, _COMMIT))
+            self._gc()
+        return True
+
+    def _wait_for_shards(self, step_dir: str, nproc: int,
+                         timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            present = [p for p in range(nproc) if os.path.exists(
+                os.path.join(step_dir, f"shard-{p}.json"))]
+            if len(present) == nproc:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"checkpoint commit: only {len(present)}/{nproc} "
+                    f"process shards landed in {step_dir} within "
+                    f"{timeout:.0f}s — a peer died mid-save; the step "
+                    "stays uncommitted (readers will use the previous "
+                    "one)")
+            time.sleep(0.05)
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for old in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)
+
+    # ----------------------------------------------------------- restore
+    def restore(self, step: int, template: Any):
+        import jax
+
+        if template is None:
+            raise ValueError(
+                "the numpy checkpoint backend needs a template pytree "
+                "to restore into (structure/dtype/sharding donor); pass "
+                "restore(step, template=state)")
+        step_dir = self._step_dir(step)
+        if not self._committed(step_dir):
+            raise FileNotFoundError(
+                f"no committed checkpoint for step {step} under "
+                f"{self.directory}")
+        proc, _ = _proc_info()
+        with open(os.path.join(step_dir, f"shard-{proc}.json")) as f:
+            meta = json.load(f)["leaves"]
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(meta) != len(t_leaves):
+            raise ValueError(
+                f"checkpoint step {step} holds {len(meta)} leaves but "
+                f"the template has {len(t_leaves)} — structure changed "
+                "since the save")
+        with open(os.path.join(step_dir, f"shard-{proc}.bin"), "rb") as f:
+            blob = f.read()
+        out = []
+        for entry, tmpl in zip(meta, t_leaves):
+            arr = np.frombuffer(
+                blob, dtype=np.dtype(entry["dtype"]),
+                count=int(np.prod(entry["shape"], dtype=np.int64))
+                if entry["shape"] else 1,
+                offset=entry["offset"]).reshape(entry["shape"])
+            sharding = getattr(tmpl, "sharding", None)
+            if sharding is not None and not isinstance(
+                    sharding, jax.sharding.SingleDeviceSharding):
+                # Mesh-sharded template leaves come back SHARDED.
+                arr = jax.device_put(arr, sharding)
+            else:
+                # Single-device templates stay host-side/uncommitted:
+                # device_put would COMMIT the leaf to that one device
+                # and poison any later multi-device dispatch (jit is
+                # free to place uncommitted arrays).
+                arr = arr.copy()  # frombuffer views are read-only
+            out.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ------------------------------------------------------- bookkeeping
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def all_steps(self) -> List[int]:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        steps = []
+        for n in names:
+            if not n.startswith("step_"):
+                continue
+            try:
+                step = int(n[len("step_"):])
+            except ValueError:
+                continue
+            if self._committed(os.path.join(self.directory, n)):
+                steps.append(step)
+        return sorted(steps)
+
+    def wait_until_finished(self) -> None:
+        pass  # synchronous writes: nothing outstanding
+
+    def close(self) -> None:
+        pass

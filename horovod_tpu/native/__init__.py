@@ -299,7 +299,19 @@ class NativeCore:
         # writes through raw pointers (mirrors reference _handle_map,
         # torch/mpi_ops.py:51-54).
         self._live: dict = {}
+        self._names: dict = {}
         self._live_lock = threading.Lock()
+        # Bounded completion deadline (HOROVOD_NEGOTIATION_TIMEOUT secs;
+        # 0 = reference behavior, wait forever). A stalled negotiation —
+        # a peer died mid-run, or rank-divergent control flow skipped a
+        # collective — then raises a typed HorovodTimeoutError instead
+        # of hanging silently; the elastic supervisor turns that into a
+        # relaunch from the last snapshot (horovod_tpu/elastic/).
+        try:
+            self._default_timeout = float(
+                os.environ.get("HOROVOD_NEGOTIATION_TIMEOUT", "0") or "0")
+        except ValueError:
+            self._default_timeout = 0.0
 
     # -- lifecycle ---------------------------------------------------------
     def init(self, rank: int = 0, size: int = 1, local_rank: int = 0,
@@ -362,11 +374,14 @@ class NativeCore:
         return (ctypes.c_int64 * arr.ndim)(*arr.shape) if arr.ndim else \
             (ctypes.c_int64 * 0)()
 
-    def _track(self, handle: int, arr: np.ndarray) -> int:
+    def _track(self, handle: int, arr: np.ndarray,
+               name: str = "") -> int:
         if handle < 0:
             raise NativeError(StatusCode.INVALID_ARGUMENT, self._error(-1))
         with self._live_lock:
             self._live[handle] = arr
+            if name:
+                self._names[handle] = name
         return handle
 
     def allreduce_async_(self, name: str, arr: np.ndarray) -> int:
@@ -375,19 +390,19 @@ class NativeCore:
         assert arr.flags["C_CONTIGUOUS"] and arr.flags["WRITEABLE"]
         return self._track(self.lib.hvdtpu_enqueue_allreduce(
             name.encode(), arr.ctypes.data, self._dtype_id(arr), arr.ndim,
-            self._dims(arr)), arr)
+            self._dims(arr)), arr, name)
 
     def allgather_async(self, name: str, arr: np.ndarray) -> int:
         assert arr.flags["C_CONTIGUOUS"]
         return self._track(self.lib.hvdtpu_enqueue_allgather(
             name.encode(), arr.ctypes.data, self._dtype_id(arr), arr.ndim,
-            self._dims(arr)), arr)
+            self._dims(arr)), arr, name)
 
     def broadcast_async_(self, name: str, arr: np.ndarray, root: int) -> int:
         assert arr.flags["C_CONTIGUOUS"] and arr.flags["WRITEABLE"]
         return self._track(self.lib.hvdtpu_enqueue_broadcast(
             name.encode(), arr.ctypes.data, self._dtype_id(arr), arr.ndim,
-            self._dims(arr), root), arr)
+            self._dims(arr), root), arr, name)
 
     # -- completion --------------------------------------------------------
     def poll(self, handle: int) -> bool:
@@ -399,8 +414,42 @@ class NativeCore:
         self.lib.hvdtpu_error(handle, buf, n + 1)
         return buf.value.decode(errors="replace")
 
-    def wait(self, handle: int) -> None:
-        """Block until done; raises NativeError on non-OK status."""
+    def wait(self, handle: int, timeout: Optional[float] = None) -> None:
+        """Block until done; raises NativeError on non-OK status.
+
+        ``timeout`` (seconds; default: the HOROVOD_NEGOTIATION_TIMEOUT
+        env knob, 0 = wait forever) bounds the wait: past the deadline a
+        typed :class:`~horovod_tpu.common.exceptions.HorovodTimeoutError`
+        is raised naming this rank and the stalled tensor. The op stays
+        enqueued and its array stays pinned (the background thread may
+        still write through the raw pointer), so the only safe recovery
+        after a timeout is process exit — which is exactly what the
+        elastic supervisor relaunch path does.
+        """
+        if timeout is None:
+            timeout = self._default_timeout
+        if timeout and timeout > 0:
+            import time as _time
+
+            deadline = _time.monotonic() + timeout
+            pause = 0.0002
+            while not self.lib.hvdtpu_poll(handle):
+                if _time.monotonic() >= deadline:
+                    from horovod_tpu.common.exceptions import \
+                        HorovodTimeoutError
+
+                    rank = self.rank()
+                    name = self._names.get(handle, f"handle {handle}")
+                    raise HorovodTimeoutError(
+                        f"collective '{name}' did not complete within "
+                        f"{timeout:g}s on rank {rank} "
+                        "(HOROVOD_NEGOTIATION_TIMEOUT): a peer died or "
+                        "skipped the collective. The op is still "
+                        "in flight — exit this process and relaunch "
+                        "(hvdrun --elastic resumes from the last "
+                        "snapshot).", rank=rank, tensor_name=name)
+                _time.sleep(pause)
+                pause = min(pause * 2, 0.005)
         rc = self.lib.hvdtpu_wait(handle)
         if rc != StatusCode.OK:
             msg = self._error(handle)
@@ -432,6 +481,7 @@ class NativeCore:
         self.lib.hvdtpu_release(handle)
         with self._live_lock:
             self._live.pop(handle, None)
+            self._names.pop(handle, None)
 
     # -- knobs + aux -------------------------------------------------------
     def set_fusion_threshold(self, nbytes: int) -> None:

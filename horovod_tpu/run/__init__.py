@@ -28,7 +28,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-from horovod_tpu.run.driver import Driver
+import dataclasses
+
+from horovod_tpu.run.driver import (Driver, WorkerExit, classify_exit,
+                                    EXIT_CLEAN, EXIT_PREEMPTED, EXIT_USAGE)
 from horovod_tpu.run.network import make_secret_key
 
 
@@ -36,6 +39,36 @@ class LaunchError(RuntimeError):
     def __init__(self, message: str, failures: Optional[dict] = None):
         super().__init__(message)
         self.failures = failures or {}
+
+
+@dataclasses.dataclass
+class JobResult:
+    """Outcome of one :func:`launch_job` attempt, with PER-WORKER exit
+    codes instead of the single collapsed code the kill-all used to
+    return. ``trigger`` is the first worker observed failing (the one
+    whose death caused the kill-all); the other ranks' codes then
+    reflect the supervisor's SIGTERM, not their own fault."""
+
+    exit_codes: Dict[int, Optional[int]]
+    trigger: Optional[WorkerExit] = None
+
+    @property
+    def code(self) -> int:
+        return self.trigger.code if self.trigger is not None else EXIT_CLEAN
+
+    @property
+    def category(self) -> str:
+        """clean | usage | preempted | crashed — the trigger worker's
+        classification (see run.driver.classify_exit)."""
+        return classify_exit(self.code)
+
+    def describe(self) -> str:
+        if self.trigger is None:
+            return "all ranks exited cleanly"
+        return (f"rank {self.trigger.rank} "
+                f"{self.trigger.category} (exit {self.trigger.code}); "
+                "per-rank codes "
+                + str({r: c for r, c in sorted(self.exit_codes.items())}))
 
 
 def _free_port() -> int:
@@ -197,10 +230,24 @@ def launch_command(cmd: Sequence[str], np: int,
                    hosts: Optional[str] = None,
                    env: Optional[Dict[str, str]] = None,
                    jax_distributed: bool = False) -> int:
-    """Run ``cmd`` as an N-rank job; returns the job's exit code.
+    """Run ``cmd`` as an N-rank job; returns the job's exit code
+    (back-compat wrapper over :func:`launch_job`)."""
+    return launch_job(cmd, np, hosts=hosts, env=env,
+                      jax_distributed=jax_distributed).code
+
+
+def launch_job(cmd: Sequence[str], np: int,
+               hosts: Optional[str] = None,
+               env: Optional[Dict[str, str]] = None,
+               jax_distributed: bool = False) -> JobResult:
+    """Run ``cmd`` as an N-rank job; returns a :class:`JobResult` with
+    per-worker exit codes and the classified trigger failure.
 
     Fails fast: the first non-zero rank kills the rest (the reference
-    relied on mpirun for exactly this).
+    relied on mpirun for exactly this) — but unlike the reference's
+    collapsed mpirun code, the result records WHICH rank died and HOW
+    (clean / usage / preempted / crashed), so the elastic supervisor
+    can decide relaunch-vs-fail per incident.
 
     ``jax_distributed``: also stand up a jax coordination service address
     (HOROVOD_JAX_COORDINATOR) so each worker's ``hvd.init()`` joins one
@@ -252,14 +299,24 @@ def launch_command(cmd: Sequence[str], np: int,
         # Supervise: poll until all exit or one fails.
         while True:
             codes = [p.poll() for p in procs]
-            bad = [c for c in codes if c not in (None, 0)]
-            if bad:
+            bad_ranks = [r for r, c in enumerate(codes)
+                         if c not in (None, 0)]
+            if bad_ranks:
+                # The lowest failing rank at this poll is the trigger;
+                # its code (not the peers' kill-all SIGTERMs) classifies
+                # the incident. Record every code observed BEFORE the
+                # kill so self-inflicted exits stay distinguishable.
+                trigger = WorkerExit(bad_ranks[0], codes[bad_ranks[0]])
                 _kill_all(procs)
                 _drain_output(procs)
-                return bad[0]
+                return JobResult(
+                    exit_codes={r: p.poll()
+                                for r, p in enumerate(procs)},
+                    trigger=trigger)
             if all(c == 0 for c in codes):
                 _drain_output(procs)
-                return 0
+                return JobResult(
+                    exit_codes=dict(enumerate(codes)), trigger=None)
             time.sleep(0.05)
     except KeyboardInterrupt:
         _kill_all(procs)
@@ -328,4 +385,6 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
         driver.close()
 
 
-__all__ = ["run", "launch_command", "LaunchError"]
+__all__ = ["run", "launch_command", "launch_job", "JobResult",
+           "WorkerExit", "classify_exit", "LaunchError",
+           "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_USAGE"]

@@ -80,6 +80,56 @@ def probe_service(addrs, key: bytes, timeout: float = 1.5):
         "no driver endpoint reachable; tried: " + ", ".join(tried))
 
 
+# --------------------------------------------------------------- exit codes
+# Per-worker exit taxonomy, the contract between workers, the launcher's
+# supervision loop and the elastic supervisor (horovod_tpu/elastic/
+# supervisor.py). The reference collapsed every failure into mpirun's
+# opaque kill-all; propagating the class lets `hvdrun --elastic` decide
+# relaunch-vs-fail-fast per incident.
+
+#: Clean completion.
+EXIT_CLEAN = 0
+#: argparse/usage convention: deterministic, reruns identically — a
+#: restart budget must never be burned on these.
+EXIT_USAGE = 2
+#: Preempted: the worker received SIGTERM (TPU maintenance event, spot
+#: reclaim), drained, wrote its final snapshot and exited on purpose.
+#: 75 = EX_TEMPFAIL from sysexits.h — "transient, retry later".
+EXIT_PREEMPTED = 75
+
+
+def classify_exit(code) -> str:
+    """Map a worker exit code to ``clean|usage|preempted|crashed``.
+
+    Negative codes are subprocess ``-signum`` deaths: ``-SIGTERM`` is
+    classed *preempted* (the cluster reclaimed the worker before the
+    in-process handler could convert it to :data:`EXIT_PREEMPTED` — same
+    recovery either way), every other signal (SIGKILL = OOM-kill or
+    fault-injected crash, SIGSEGV, ...) is *crashed*.
+    """
+    import signal as _signal
+
+    if code == EXIT_CLEAN:
+        return "clean"
+    if code == EXIT_USAGE:
+        return "usage"
+    if code == EXIT_PREEMPTED or code == -_signal.SIGTERM:
+        return "preempted"
+    return "crashed"
+
+
+@dataclasses.dataclass
+class WorkerExit:
+    """One worker's observed exit: rank, raw code, classified category."""
+
+    rank: int
+    code: int
+
+    @property
+    def category(self) -> str:
+        return classify_exit(self.code)
+
+
 class Driver:
     """Runs in the launcher process; workers talk to it over the
     authenticated RPC."""

@@ -9,6 +9,7 @@ placement and the Horovod environment itself — no MPI runtime. The
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -34,6 +35,25 @@ def main(argv=None) -> int:
                              "flax.CheckpointCallback) the relaunch resumes "
                              "from the last saved step. 0 = fail fast, the "
                              "reference's MPI semantics")
+    parser.add_argument("--elastic", action="store_true",
+                        help="preemption-tolerant supervision "
+                             "(horovod_tpu.elastic): classify each "
+                             "worker exit (clean / usage / preempted / "
+                             "crashed), tear down the world and relaunch "
+                             "all ranks; workers resume from the latest "
+                             "snapshot manifest (elastic.run_elastic / "
+                             "Snapshotter). Preemptions (exit 75 or "
+                             "SIGTERM) relaunch for free; crashes consume "
+                             "the --max-restarts budget")
+    parser.add_argument("--max-restarts", type=int, default=1,
+                        help="crash-restart budget for --elastic "
+                             "(default 1; preemptions don't consume it)")
+    parser.add_argument("--fault-plan", default=None,
+                        help="deterministic fault injection plan, e.g. "
+                             "'kill:rank=1,step=7;stall:rank=2,step=12' "
+                             "— validated here, exported to workers as "
+                             "HOROVOD_FAULT_PLAN (grammar: "
+                             "docs/elastic.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
@@ -41,9 +61,35 @@ def main(argv=None) -> int:
         parser.error("no command given")
     if args.restarts < 0:
         parser.error("--restarts must be >= 0")
+    if args.max_restarts < 0:
+        parser.error("--max-restarts must be >= 0")
+    if args.restarts and args.elastic:
+        parser.error("--restarts and --elastic are mutually exclusive "
+                     "(--elastic already relaunches; use --max-restarts)")
+    env = None
+    if args.fault_plan is not None:
+        # Validate the grammar HERE so a typo'd plan is a usage error at
+        # launch, not a silently-injecting-nothing "green" run.
+        from horovod_tpu.elastic.faults import FaultPlanError, \
+            parse_fault_plan
+
+        try:
+            parse_fault_plan(args.fault_plan)
+        except FaultPlanError as e:
+            parser.error(str(e))
+        env = dict(os.environ)
+        env["HOROVOD_FAULT_PLAN"] = args.fault_plan
     cmd = args.command[1:] if args.command[0] == "--" else args.command
+    if args.elastic:
+        from horovod_tpu.elastic.supervisor import supervise
+
+        return supervise(cmd, np=args.num_proc, hosts=args.hosts,
+                         env=env, jax_distributed=args.jax_distributed,
+                         max_restarts=args.max_restarts,
+                         restart_delay=3.0 if args.hosts else 0.0)
     for attempt in range(args.restarts + 1):
         rc = launch_command(cmd, np=args.num_proc, hosts=args.hosts,
+                            env=env,
                             jax_distributed=args.jax_distributed)
         if rc == 0:
             return 0

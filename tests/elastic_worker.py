@@ -1,0 +1,89 @@
+"""Elastic e2e worker: tiny deterministic training under hvdrun.
+
+Launched by tests/test_elastic.py as::
+
+    hvdrun --elastic --max-restarts 1 --fault-plan "kill:rank=1,step=7" \
+        -np 2 python tests/elastic_worker.py OUTDIR CKPTDIR STEPS EVERY K
+
+Each rank trains the same tiny least-squares model over its
+:class:`~horovod_tpu.elastic.ShardedBatchSource` shard with
+:func:`~horovod_tpu.elastic.run_elastic` (snapshot cadence EVERY,
+spill_every=1 so every snapshot is durable, window size K), APPENDING a
+"step repr(loss)" line per dispatched window to OUTDIR/rank<r>.traj and
+a final state digest to OUTDIR/rank<r>.final. The test compares
+last-write-wins trajectories and digests between a fault-injected run
+and a fault-free run: bit-exact resume means they are identical.
+"""
+
+import hashlib
+import os
+import sys
+
+
+def main() -> int:
+    out_dir, ckpt_dir, steps, every, k = sys.argv[1:6]
+    steps, every, k = int(steps), int(every), int(k)
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+
+    # Each rank is an independent jax process here (no cross-process CPU
+    # collectives in this jaxlib); force the CPU platform in-process.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu import elastic
+    from horovod_tpu.flax.checkpoint import CheckpointManager
+
+    # Deterministic dataset, sharded per rank by the seeded sampler.
+    root = np.random.RandomState(0)
+    n, d = 64, 4
+    source = elastic.ShardedBatchSource(
+        {"x": root.normal(size=(n, d)).astype(np.float32),
+         "y": root.normal(size=(n, 1)).astype(np.float32)},
+        batch_size=4, rank=rank, size=size, seed=0)
+
+    def step_fn(state, batch):
+        def loss_fn(w):
+            pred = batch["x"] @ w
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(state["w"])
+        return ({"w": state["w"] - 0.05 * g,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    state = {"w": jnp.zeros((d, 1), jnp.float32),
+             "step": jnp.zeros((), jnp.int32)}
+
+    os.makedirs(out_dir, exist_ok=True)
+    traj = open(os.path.join(out_dir, f"rank{rank}.traj"), "a")
+
+    def on_step(completed, metrics):
+        # repr() keeps full float precision: the comparison is bit-exact,
+        # not approximately-equal.
+        traj.write(f"{completed} {float(metrics['loss'])!r}\n")
+        traj.flush()
+
+    with CheckpointManager(os.path.join(ckpt_dir, f"rank{rank}"),
+                           backend="numpy") as manager:
+        state, _, resumed = elastic.run_elastic(
+            step_fn, state, source.batch_at, steps,
+            manager=manager, snapshot_every=every, spill_every=1,
+            steps_per_dispatch=k, on_step=on_step)
+    traj.close()
+
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        digest.update(np.asarray(leaf).tobytes())
+    final = os.path.join(out_dir, f"rank{rank}.final")
+    with open(f"{final}.tmp", "w") as f:
+        f.write(f"{digest.hexdigest()} resumed={resumed}\n")
+    os.replace(f"{final}.tmp", final)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

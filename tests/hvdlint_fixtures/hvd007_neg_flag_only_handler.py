@@ -1,0 +1,20 @@
+"""NEGATIVE: the supported defer-to-step-boundary pattern
+(horovod_tpu/elastic/signals.py): the handler ONLY sets a flag —
+async-signal-safe by construction — and the training loop performs the
+drain + snapshot at its next step boundary. hvdlint must stay silent."""
+
+import signal
+
+
+class DeferredPreemption:
+    def __init__(self):
+        self.triggered = False
+        self.signum = None
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.triggered = True
+        self.signum = signum
+
+    def check(self):
+        return self.triggered

@@ -1,0 +1,26 @@
+"""NEGATIVE: ordinary checkpoint writes in plain (non-handler) code —
+the same open/write/replace calls HVD007 flags inside handlers are the
+CORRECT atomic-commit idiom at a step boundary. Only functions actually
+registered via signal.signal() are handler context; this module
+registers none of these."""
+
+import json
+import os
+import signal
+
+
+def write_manifest(path, manifest):
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(manifest))
+    os.replace(tmp, path)
+
+
+def boundary_epilogue(handler_flag, path, manifest):
+    # The loop (not the handler) reacts to the deferred flag.
+    if handler_flag.triggered:
+        write_manifest(path, manifest)
+
+
+def install(handler_flag):
+    signal.signal(signal.SIGTERM, handler_flag.on_signal)

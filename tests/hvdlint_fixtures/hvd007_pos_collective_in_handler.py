@@ -1,0 +1,21 @@
+"""POSITIVE: a blocking collective issued directly from a SIGTERM
+handler. The signal interrupts arbitrary code — possibly a rank already
+inside a negotiation — so the handler's own allreduce deadlocks the
+coordinator exactly when the preemption grace window is ticking. The
+supported pattern is defer-to-step-boundary (elastic/signals.py)."""
+
+import signal
+
+import horovod_tpu.jax as hvd
+
+
+class EagerPreemptionSaver:
+    def __init__(self, state):
+        self.state = state
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def _on_sigterm(self, signum, frame):
+        # "Just average the metrics before dying" — from handler context
+        # this re-enters the collective machinery mid-negotiation.
+        self.state["loss"] = hvd.allreduce(  # EXPECT: HVD007
+            self.state["loss"], average=True)

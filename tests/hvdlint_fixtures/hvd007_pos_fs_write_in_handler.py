@@ -1,0 +1,18 @@
+"""POSITIVE: filesystem writes issued directly from a SIGTERM handler.
+The interrupted code may be mid-write to the same checkpoint file (or
+holding the allocator/IO locks the write needs) — the handler must only
+set a flag; the loop snapshots at its next boundary."""
+
+import json
+import signal
+
+
+class PanicCheckpointer:
+    def __init__(self, path, state):
+        self.path = path
+        self.state = state
+        signal.signal(signal.SIGTERM, self._panic_save)
+
+    def _panic_save(self, signum, frame):
+        with open(self.path, "w") as f:  # EXPECT: HVD007
+            f.write(json.dumps(self.state))  # EXPECT: HVD007

@@ -211,6 +211,33 @@ def test_overlap_and_bucket_stamps_in_record():
     assert out["value"] > 0
 
 
+def test_snapshot_stamp_in_record():
+    """--snapshot-every K measures the elastic host-RAM snapshot cost
+    and stamps cadence / ms-per-snapshot / overhead%% into the record
+    (ISSUE acceptance: overhead <= 2%% of step time at the default
+    cadence of 100). The tiny-LM CPU lane has millisecond steps against
+    a sub-millisecond state copy, so the budget holds here too."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--snapshot-every", "100",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--lm-dim", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    s = out["snapshot"]
+    assert s["every"] == 100
+    assert s["ms_per_snapshot"] > 0
+    assert 0 < s["overhead_pct"] <= 2.0
+    assert out["value"] > 0
+    # Off by default: the historical record shape gains an explicit null.
+    out_off, _ = _run_bench(
+        "--model", "transformer_lm", "--batch-size", "2",
+        "--seq-len", "64", "--vocab", "256", "--lm-layers", "1",
+        "--lm-dim", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out_off["snapshot"] is None
+
+
 def test_compile_only_lane_contract():
     """--compile-only (the sweep's *_warm lanes): one first step, metric
     <model>_first_step_secs, vs_baseline null — the warm-cache pass big

@@ -1,10 +1,15 @@
 #!/usr/bin/env bash
-# Development gate: hvdlint sweep + the fast lint-fixture tests, with an
-# opt-in sanitizer lane.
+# Development gate: hvdlint sweep + the fast lint-fixture tests + the
+# elastic fault-injection smoke, with an opt-in sanitizer lane.
 #
 #   tools/check.sh              hvdlint (horovod_tpu/ tools/ bench.py must
 #                               be at zero unsuppressed findings) + the
-#                               hvdlint fixture/suppression test suite
+#                               hvdlint fixture/suppression test suite +
+#                               the elastic fault-injection smoke (a real
+#                               `hvdrun --elastic` job loses rank 1 to a
+#                               HOROVOD_FAULT_PLAN SIGKILL mid-run and
+#                               must finish bit-exact after the relaunch)
+#   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
 #                               the self-building loader) and run the
@@ -15,10 +20,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 SANITIZE=0
+ELASTIC=1
 for arg in "$@"; do
   case "$arg" in
     --sanitize) SANITIZE=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize]" >&2; exit 2 ;;
+    --no-elastic) ELASTIC=0 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic]" >&2; exit 2 ;;
   esac
 done
 
@@ -27,6 +34,12 @@ python -m tools.hvdlint horovod_tpu/ tools/ bench.py
 
 echo "== hvdlint rule fixtures =="
 python -m pytest tests/test_hvdlint.py -q -p no:cacheprovider
+
+if [[ "$ELASTIC" == "1" ]]; then
+  echo "== elastic fault-injection smoke (kill rank 1, relaunch, bit-exact) =="
+  python -m pytest tests/test_elastic.py::TestEndToEnd -q \
+    -p no:cacheprovider -m 'not slow'
+fi
 
 if [[ "$SANITIZE" == "1" ]]; then
   echo "== native stress lane under ASAN + TSAN =="

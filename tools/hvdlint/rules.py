@@ -533,6 +533,98 @@ def check_hvd006(tree: ast.AST) -> List[RawFinding]:
     return out
 
 
+# ----------------------------------------------------------------- HVD007
+
+#: Filesystem-mutating call names: none of these belong in a signal
+#: handler (a handler interrupts arbitrary code — possibly mid-write to
+#: the same file, holding allocator/IO locks).
+FS_WRITE_NAMES = {
+    "write", "writelines", "write_text", "write_bytes", "replace",
+    "rename", "renames", "makedirs", "mkdir", "unlink", "remove",
+    "rmtree", "save", "savez", "savez_compressed", "dump", "truncate",
+}
+
+#: open() modes that mutate the filesystem.
+_WRITE_MODE_CHARS = set("wax+")
+
+
+def _handler_names(tree: ast.AST) -> Set[str]:
+    """Function/method names registered as signal handlers via
+    ``signal.signal(sig, fn)`` (or bare ``signal(sig, fn)``). SIG_DFL/
+    SIG_IGN constants are not handlers."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and trailing_name(node.func) == "signal"
+                and len(node.args) >= 2):
+            continue
+        name = trailing_name(node.args[1])
+        if name and not name.startswith("SIG"):
+            out.add(name)
+    return out
+
+
+def _open_writes(call: ast.Call) -> bool:
+    if trailing_name(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    return (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+            and bool(set(mode.value) & _WRITE_MODE_CHARS))
+
+
+def check_hvd007(tree: ast.AST) -> List[RawFinding]:
+    """Blocking collective or filesystem write issued directly inside a
+    signal handler.
+
+    A handler interrupts arbitrary code: the process may be
+    mid-collective (a second negotiation from handler context deadlocks
+    the coordinator), mid-write to the very file the handler touches, or
+    holding allocator locks. The supported pattern — the one
+    ``horovod_tpu/elastic/signals.py`` is the reference for — is
+    defer-to-step-boundary: the handler ONLY sets a flag; the training
+    loop drains and snapshots at its next boundary, where state is
+    consistent and nothing is in flight. Handlers are recognized by
+    their registration (``signal.signal(sig, fn)``); flag-setting
+    handlers stay silent.
+    """
+    findings: List[RawFinding] = []
+    handlers = _handler_names(tree)
+    if not handlers:
+        return findings
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in handlers):
+            continue
+        for call in _subtree_nodes(node.body):
+            if not isinstance(call, ast.Call):
+                continue
+            name = trailing_name(call.func)
+            if name in COLLECTIVE_NAMES:
+                findings.append(RawFinding(
+                    call.lineno, call.col_offset, "HVD007", "error",
+                    f"collective '{name}' issued inside signal handler "
+                    f"'{node.name}': a handler interrupts arbitrary "
+                    "code (possibly mid-collective) -> deadlock; set a "
+                    "flag and drain/collect at the next step boundary "
+                    "(the elastic signals.py pattern)"))
+            elif name in FS_WRITE_NAMES or _open_writes(call):
+                findings.append(RawFinding(
+                    call.lineno, call.col_offset, "HVD007", "error",
+                    f"filesystem write '{name}' inside signal handler "
+                    f"'{node.name}': the interrupted code may hold the "
+                    "same file/locks -> corruption; set a flag and "
+                    "snapshot at the next step boundary (the elastic "
+                    "signals.py pattern)"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -540,4 +632,5 @@ RULES = {
     "HVD004": check_hvd004,
     "HVD005": check_hvd005,
     "HVD006": check_hvd006,
+    "HVD007": check_hvd007,
 }

@@ -88,15 +88,30 @@ def overlap_cell(rec):
     return str(mode)
 
 
+def snapshot_cell(rec):
+    """Compact render of the record's elastic snapshot stamp (bench.py
+    --snapshot-every; horovod_tpu.elastic): "100/1.2ms/0.05%" = cadence
+    100 steps, 1.2 ms per host-RAM snapshot, 0.05% of step time —
+    acceptance budget is <= 2% at the default cadence. Records without
+    the stamp render as em-dash."""
+    s = rec.get("snapshot")
+    if not isinstance(s, dict):
+        return "—"
+    cell = f"{s.get('every', '?')}/{s.get('ms_per_snapshot', '?')}ms"
+    if s.get("overhead_pct") is not None:
+        cell += f"/{s['overhead_pct']:g}%"
+    return cell
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | overlap | flash grid | peak "
-          "| probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|")
+    print("| lane | value | unit | window | overlap | flash grid "
+          "| snapshot | peak | probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -108,6 +123,7 @@ def main():
               f"| {window if window is not None else '—'} "
               f"| {overlap_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
+              f"| {snapshot_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")
