@@ -335,6 +335,7 @@ def bench_image(args, log):
         + (f", {k}-step dispatch windows" if k > 1 else ""),
         file=sys.stderr)
     stamp = overlap_stamp(args, state, log)
+    stamp.update(collectives_stamp(run_step, state, batch, log))
     snap_ms = (measure_snapshot_ms(state, log)
                if args.snapshot_every > 0 and not args.compile_only
                else None)
@@ -488,6 +489,7 @@ def bench_lm(args, log):
         file=sys.stderr)
     units_per_iter = batch_size * L * k * args.num_batches_per_iter
     stamp = overlap_stamp(args, state, log)
+    stamp.update(collectives_stamp(run_step, state, batch, log))
     snap_ms = (measure_snapshot_ms(state, log)
                if args.snapshot_every > 0 and not args.compile_only
                else None)
@@ -532,6 +534,36 @@ def overlap_stamp(args, state, log):
         f"{summary['oversize_singletons']} oversize singleton(s), "
         f"overlap={mode}", file=sys.stderr)
     return {"overlap": mode, "buckets": summary}
+
+
+def collectives_stamp(run_step, state, batch, log):
+    """The ``"collectives"`` static-audit field: count + bytes of every
+    collective in THIS lane's compiled step program, from the hvdverify
+    schedule walker (tools/hvdverify — the HVV105 accounting surface,
+    cross-checked against the dynamic jaxpr accounting in
+    tests/test_wire_bytes.py). Traced on abstract twins of the real
+    state/batch BEFORE the timed windows donate the state; pure
+    tracing, so it costs seconds of host time and zero device work.
+    HVD_BENCH_NO_STATIC_AUDIT=1 skips it (stamps null); a failed audit
+    degrades to null rather than killing the measurement."""
+    if os.environ.get("HVD_BENCH_NO_STATIC_AUDIT"):
+        return {"collectives": None}
+    try:
+        from tools.hvdverify import abstractify, audit_collectives
+
+        audit = audit_collectives(lambda s, b: run_step(s, b),
+                                  abstractify(state), abstractify(batch))
+        field = {"count": audit["count"], "bytes": audit["bytes"],
+                 "mb": audit["mb"], "by_kind": audit["by_kind"]}
+        log(f"Static collective audit: {field['count']} collective(s), "
+            f"{field['mb']} MB per step program "
+            f"({', '.join(f'{k}:{v}' for k, v in field['by_kind'].items())})",
+            file=sys.stderr)
+        return {"collectives": field}
+    except Exception as exc:  # never fail the measurement for the audit
+        log(f"Static collective audit skipped: "
+            f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return {"collectives": None}
 
 
 def resolve_attention(args) -> str:
@@ -634,6 +666,7 @@ def supervise(argv, args):
             "window": getattr(args, "steps_per_dispatch", 1),
             "overlap": getattr(args, "overlap", None),
             "snapshot": None,
+            "collectives": None,
             "error": f"supervisor received signal {signum} mid-run "
                      f"(outer/driver deadline?); last state: {last_err}",
         }), flush=True)
@@ -735,6 +768,7 @@ def supervise(argv, args):
         "window": getattr(args, "steps_per_dispatch", 1),
         "overlap": getattr(args, "overlap", None),
         "snapshot": None,
+        "collectives": None,
         "error": last_err,
     }))
     return 0

@@ -105,7 +105,9 @@ def repeat_batch(batch, steps_per_dispatch: int):
 
 
 def stage_synthetic_window(step_fn, batch, steps_per_dispatch: int,
-                           batch_specs: Any = P("hvd")):
+                           # LogicalMesh work list: default batch spec
+                           # spells the DP axis.
+                           batch_specs: Any = P("hvd")):  # hvdlint: disable=HVD008
     """Synthetic-benchmark window staging, in one place for every timing
     harness (bench.py, tools/profile_step.py): wrap the step in the scan
     window, broadcast the single reusable batch under the K-long window
@@ -138,9 +140,9 @@ def run_steps(
     steps_per_dispatch: int = 1,
     *,
     mesh=None,
-    axis_name: str = "hvd",
+    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
     state_specs: Any = P(),
-    batch_specs: Any = P("hvd"),
+    batch_specs: Any = P("hvd"),  # hvdlint: disable=HVD008 (LogicalMesh work list)
     metric_specs: Any = P(),
     donate: bool = True,
     prefetch: int = 2,

@@ -120,7 +120,7 @@ def _split_group(flat, leaves, idxs, out: list) -> None:
 def sharded_distributed_optimizer(
     optimizer: optax.GradientTransformation,
     average: bool = True,
-    axis_name: str = "hvd",
+    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
     compression=None,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` with ZeRO-1 sharding over the ``axis_name`` mesh
@@ -265,7 +265,7 @@ def sharded_distributed_optimizer(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def state_partition_specs(opt_state, axis_name: str = "hvd"):
+def state_partition_specs(opt_state, axis_name: str = "hvd"):  # hvdlint: disable=HVD008 (LogicalMesh work list)
     """Partition specs for a (possibly nested) optimizer state containing
     :class:`ZeroState` nodes: the flat sharded vectors get ``P(axis)``,
     everything else (scalar counts, non-ZeRO states) stays replicated.

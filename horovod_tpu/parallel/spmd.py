@@ -56,7 +56,7 @@ def _default_mesh() -> Mesh:
     return st.mesh
 
 
-def axis_size(mesh: Optional[Mesh] = None, axis: str = "hvd") -> int:
+def axis_size(mesh: Optional[Mesh] = None, axis: str = "hvd") -> int:  # hvdlint: disable=HVD008 (LogicalMesh work list)
     mesh = mesh or _default_mesh()
     return mesh.shape[axis]
 
@@ -65,7 +65,7 @@ def spmd_fn(
     fn,
     *,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",
+    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
     in_specs: Any = P(),
     out_specs: Any = P(),
     # False BY DESIGN (not a leftover): this harness implements the
@@ -261,7 +261,7 @@ def spmd_run(
     fn,
     *args,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",
+    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
     in_specs: Any = P(),
     out_specs: Any = P(),
     check_vma: bool = False,
@@ -305,7 +305,7 @@ def spmd(
     fn=None,
     *,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",
+    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
     in_specs: Any = P(),
     out_specs: Any = P(),
     check_vma: bool = False,

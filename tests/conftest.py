@@ -105,6 +105,11 @@ _SLOW_TESTS = {
     # path, and TestRunElastic::test_resume_is_bit_exact_windowed pins
     # the windowed resume numerics in-process.
     "test_elastic.py::TestEndToEnd::test_kill_rank1_resumes_bit_exact[3]",
+    # ~25s: traces the FULL hvdverify registry (9 big-model gate lanes).
+    # Fast stand-in: test_repo_sweep_core_is_clean covers the
+    # optimizer/parallel/elastic programs; the gate lanes run here and
+    # in tools/check.sh --verify.
+    "test_hvdverify.py::test_repo_sweep_is_clean",
 }
 
 

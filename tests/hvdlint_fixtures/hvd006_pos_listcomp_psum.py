@@ -8,13 +8,13 @@ import jax
 from jax import lax
 
 
-def reduce_tree(grads):
+def reduce_tree(grads, axis):
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    reduced = [lax.psum(leaf, "hvd") for leaf in leaves]  # EXPECT: HVD006
+    reduced = [lax.psum(leaf, axis) for leaf in leaves]  # EXPECT: HVD006
     return jax.tree_util.tree_unflatten(treedef, reduced)
 
 
-def mean_tree(grads):
+def mean_tree(grads, axis):
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    reduced = {i: lax.pmean(g, "hvd") for i, g in enumerate(leaves)}  # EXPECT: HVD006
+    reduced = {i: lax.pmean(g, axis) for i, g in enumerate(leaves)}  # EXPECT: HVD006
     return treedef, reduced

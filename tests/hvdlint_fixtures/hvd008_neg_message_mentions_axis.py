@@ -1,0 +1,11 @@
+"""HVD008 negative: prose that merely MENTIONS an axis name — log
+lines, error messages, docstrings — is not an axis-name use site; only
+exact-match string constants fire."""
+
+
+def explain(axis):
+    if axis is None:
+        raise ValueError(
+            "no active mesh axis; run inside spmd_run (the default "
+            "mesh names its data-parallel axis 'hvd')")
+    return f"reducing over {axis} (an hvd-style 1-D mesh)"

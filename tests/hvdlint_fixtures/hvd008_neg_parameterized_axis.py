@@ -1,0 +1,13 @@
+"""HVD008 negative: axis names flow in as PARAMETERS (the per-module
+axes "tp"/"pp"/"sp"/"ep" already work this way) — no hardcoded
+hvd/ici/dcn literal, nothing couples to the global spelling."""
+
+from jax import lax
+
+
+def all_mean(x, axis):
+    return lax.psum(x, axis) / lax.axis_size(axis)
+
+
+def tp_block(x, w, axis="tp"):
+    return lax.psum(x @ w, axis)

@@ -1,0 +1,9 @@
+"""HVD008 positive: the hierarchical ladder's axis names spelled inline
+at a use site — "ici"/"dcn" are mesh-factory vocabulary
+(parallel/mesh.py owns them; everywhere else is convention coupling)."""
+
+
+def ladder_axes(flat):
+    inner = {"ici": 8}  # EXPECT: HVD008
+    outer = {"dcn": flat // 8}  # EXPECT: HVD008
+    return {**outer, **inner}

@@ -1,0 +1,15 @@
+"""HVD008 positive: a module hand-rolls its sharding against the
+data-parallel axis by string convention — the exact per-module coupling
+ROADMAP item 2's LogicalMesh refactor must unwind. Every flagged line
+is one rewrite site on that refactor's work list."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def all_mean(x):
+    return lax.psum(x, "hvd") / lax.axis_size("hvd")  # EXPECT: HVD008  # EXPECT: HVD008
+
+
+def batch_spec():
+    return P("hvd")  # EXPECT: HVD008

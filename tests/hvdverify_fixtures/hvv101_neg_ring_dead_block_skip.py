@@ -1,0 +1,46 @@
+"""HVV101 negative: the SHIPPED ring-attention shape (PR 3) — the
+causal dead-block skip's rank-divergent cond keeps only local COMPUTE
+conditional; the K/V rotation ppermutes unconditionally every step, so
+the ring stays rank-uniform. This is the legitimate twin of the
+hvv101_pos_ring_rotation_in_cond incident and must stay silent (the
+repo sweep traces the real ring_attention too)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    size = 4
+
+    def ring_step_right(q, k):
+        rank = lax.axis_index("sp")
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        Lq = q.shape[1]
+        Lk = k.shape[1]
+
+        def body(p, carry):
+            k_blk, acc = carry
+            src = (rank - p) % size
+
+            def live(kb):
+                return jnp.einsum("bqhd,bkhd->bhqk", q, kb).sum()
+
+            has_live = rank * Lq + Lq - 1 >= src * Lk
+            contrib = lax.cond(has_live, live,
+                               lambda kb: jnp.float32(0.0), k_blk)
+            # The rotation stays OUTSIDE the cond: every rank feeds the
+            # ring every step (ring_attention.py's documented contract).
+            k_blk = lax.ppermute(k_blk, "sp", perm)
+            return k_blk, acc + contrib
+
+        _, acc = lax.fori_loop(0, size, body, (k, jnp.float32(0.0)))
+        return acc
+
+    fn = shmap(ring_step_right, mesh(sp=4),
+               in_specs=(P(None, "sp"), P(None, "sp")),
+               out_specs=P())
+    return fn, (f32(2, 8, 2, 4), f32(2, 8, 2, 4))

@@ -1,0 +1,26 @@
+"""HVV101 negative: a collective inside a cond whose predicate is a
+REPLICATED traced value (a config flag, a loss threshold) — every rank
+takes the same branch, so the collective stays rank-uniform. The
+coordinator never sees a missing rank; hvdverify must stay silent."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    def program(x, use_mean):
+        return lax.cond(
+            use_mean,
+            lambda v: lax.psum(v, "hvd") / 8.0,
+            lambda v: v,
+            x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=(P("hvd"), P()),
+               out_specs=P("hvd"))
+    import jax
+
+    return fn, (f32(8, 4), jax.ShapeDtypeStruct((), jnp.bool_))

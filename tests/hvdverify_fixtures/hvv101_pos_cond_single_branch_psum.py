@@ -1,0 +1,25 @@
+"""HVV101 positive: a collective inside ONE branch of a cond whose
+predicate derives from axis_index — ranks taking the other branch never
+join the psum. The runtime spelling is the coordinator's missing-rank
+stall (60 s watchdog, then silence); the jaxpr knows at trace time."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV101",)
+
+
+def build():
+    def program(x):
+        rank = lax.axis_index("hvd")
+        return lax.cond(
+            rank == 0,
+            lambda v: lax.psum(v, "hvd"),   # only rank 0 enters
+            lambda v: v * jnp.float32(2.0),
+            x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

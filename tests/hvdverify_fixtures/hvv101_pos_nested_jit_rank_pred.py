@@ -1,0 +1,29 @@
+"""HVV101 positive: the rank predicate is computed by a NESTED jitted
+helper — ``axis_index`` lives inside a pjit sub-jaxpr and only its
+RESULT reaches the cond. Taint must surface through the call's outvars
+(walker outvar-lift), or this guaranteed all-mesh deadlock is
+misclassified as a uniform cond and verifies clean."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV101",)
+
+
+def build():
+    def program(x):
+        # The helper is opaque at the call site: no tainted invar, the
+        # rank-derivation happens entirely inside the sub-jaxpr.
+        rank = jax.jit(lambda: lax.axis_index("hvd"))()
+        return lax.cond(
+            rank == 0,
+            lambda v: lax.psum(v, "hvd"),   # only rank 0 enters
+            lambda v: v * jnp.float32(2.0),
+            x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

@@ -1,0 +1,53 @@
+"""HVV101 positive — THE NAMED INCIDENT (PR 3, ring attention).
+
+The causal dead-block skip wraps a visiting K/V block's update in a
+rank-divergent ``lax.cond`` (``has_live`` derives from the chip's axis
+index). The shipped code keeps ONLY the einsums conditional and rotates
+K/V unconditionally — "the rotation itself is never skipped —
+collectives stay rank-uniform" (parallel/ring_attention.py). This
+fixture is the variant that review had to catch by eye: the ppermute
+rotation moved INSIDE the cond, so ranks whose blocks are dead skip the
+collective while their peers wait on the ring — on hardware, a
+deadlock mid-scan. hvdverify decides it at trace time."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV101",)
+
+
+def build():
+    size = 4
+
+    def ring_step_wrong(q, k):
+        rank = lax.axis_index("sp")
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        Lq = q.shape[1]
+        Lk = k.shape[1]
+
+        def body(p, carry):
+            k_blk, acc = carry
+            src = (rank - p) % size
+
+            def live(kb):
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, kb)
+                # WRONG: the rotation rides inside the rank-divergent
+                # branch — dead ranks never feed the ring.
+                return lax.ppermute(kb, "sp", perm), s.sum()
+
+            def dead(kb):
+                return kb, jnp.float32(0.0)
+
+            has_live = rank * Lq + Lq - 1 >= src * Lk
+            k_blk, contrib = lax.cond(has_live, live, dead, k_blk)
+            return k_blk, acc + contrib
+
+        _, acc = lax.fori_loop(0, size, body, (k, jnp.float32(0.0)))
+        return acc
+
+    fn = shmap(ring_step_wrong, mesh(sp=4),
+               in_specs=(P(None, "sp"), P(None, "sp")),
+               out_specs=P())
+    return fn, (f32(2, 8, 2, 4), f32(2, 8, 2, 4))

@@ -1,0 +1,31 @@
+"""HVV101 positive: a while loop whose TRIP COUNT derives from
+axis_index, with a collective in the body — ranks exit after different
+iteration counts, so the k-th psum has no partner on the early-exit
+ranks. AST rules cannot see this (the divergence is in traced data
+flow, not an ``if rank():`` statement)."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV101",)
+
+
+def build():
+    def program(x):
+        rank = lax.axis_index("hvd")
+
+        def cond(carry):
+            i, _ = carry
+            return i < rank + 1   # per-rank trip count
+
+        def body(carry):
+            i, v = carry
+            return i + 1, lax.psum(v, "hvd")
+
+        _, out = lax.while_loop(cond, body, (0, x))
+        return out
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

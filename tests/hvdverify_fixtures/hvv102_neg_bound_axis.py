@@ -1,0 +1,18 @@
+"""HVV102 negative: collectives over the axis the enclosing shard_map
+binds — the ordinary data-parallel program."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    def program(x):
+        s = lax.psum(x, "hvd")
+        return s + lax.all_gather(x, "hvd", tiled=True).sum()
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

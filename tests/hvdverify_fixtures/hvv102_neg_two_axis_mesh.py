@@ -1,0 +1,22 @@
+"""HVV102 negative: the hierarchical ladder's two-axis mesh — psum over
+("dcn",) and ("ici",) separately and over both; all bound by the
+enclosing 2-D shard_map (parallel/mesh.py's hierarchical_allreduce
+phase structure)."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    def program(x):
+        inner = lax.psum(x, "ici")
+        cross = lax.psum(inner, "dcn")
+        return cross + lax.psum(x, ("dcn", "ici"))
+
+    m = mesh(dcn=2, ici=4)
+    fn = shmap(program, m, in_specs=P("dcn", "ici"),
+               out_specs=P("dcn", "ici"))
+    return fn, (f32(8, 8),)

@@ -1,0 +1,17 @@
+"""HVV102 positive: a collective with NO enclosing mesh at all — a
+shard_map-less helper calling ``lax.psum(x, "dcn")`` as if the
+hierarchical mesh were active. Runs fine in unit tests that monkeypatch
+the collective away, explodes the first time the real program traces."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import f32
+
+EXPECT = ("HVV102",)
+
+
+def build():
+    def program(x):
+        return lax.psum(x * 2.0, "dcn")
+
+    return program, (f32(4, 4),)

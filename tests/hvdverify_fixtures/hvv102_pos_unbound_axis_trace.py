@@ -1,0 +1,22 @@
+"""HVV102 positive: a collective over an axis name the enclosing mesh
+does not bind — shard_map over ("hvd",) while the body psums over
+"tp". The classic spelling: a tensor-parallel helper pasted into a
+data-parallel region (exactly the composition mistake the LogicalMesh
+refactor exists to make impossible). The trace itself fails; hvdverify
+converts the unbound-axis NameError into a structured finding."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV102",)
+
+
+def build():
+    def program(x):
+        h = x @ x.T
+        return lax.psum(h, "tp")   # "tp" is not an axis of this mesh
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 8),)

@@ -1,0 +1,27 @@
+"""HVV103 negative: rank-divergent branches with IDENTICAL collective
+schedules — the root-prepares-payload idiom done right: every rank
+joins the same psum of the same shape/dtype, only the local payload
+differs (root contributes data, the rest contribute zeros). This is how
+mpi_ops.broadcast is built; it must stay silent."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    def program(x):
+        rank = lax.axis_index("hvd")
+        payload = lax.cond(
+            rank == 0,
+            lambda v: lax.psum(v, "hvd"),
+            lambda v: lax.psum(jnp.zeros_like(v), "hvd"),
+            x)
+        return payload
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

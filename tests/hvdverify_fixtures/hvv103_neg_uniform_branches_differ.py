@@ -1,0 +1,27 @@
+"""HVV103 negative: branches DO disagree (psum vs no collective at
+all), but the predicate is a replicated input — every rank takes the
+same branch, the schedules never have to pair across branches. This is
+the overlap knob / config-flag pattern (HOROVOD_OVERLAP selects a
+different emission shape for everyone at once)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def build():
+    def program(x, fused):
+        return lax.cond(
+            fused,
+            lambda v: lax.psum(v.ravel(), "hvd").reshape(v.shape),
+            lambda v: lax.psum(v, "hvd") * jnp.float32(1.0),
+            x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=(P("hvd"), P()),
+               out_specs=P("hvd"))
+    import jax
+
+    return fn, (f32(8, 4), jax.ShapeDtypeStruct((), jnp.bool_))

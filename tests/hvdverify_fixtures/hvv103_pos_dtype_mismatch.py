@@ -1,0 +1,27 @@
+"""HVV103 positive: rank-divergent branches BOTH collect, but over
+different wire dtypes — branch 0 psums fp32, branch 1 psums the bf16
+cast. At runtime the coordinator's dtype-mismatch validation kills the
+job mid-negotiation ("tensor type mismatch"); statically it is a
+one-line diff of the branch schedules."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV103",)
+
+
+def build():
+    def program(x):
+        rank = lax.axis_index("hvd")
+        return lax.cond(
+            rank < 4,
+            lambda v: lax.psum(v, "hvd"),
+            lambda v: lax.psum(
+                v.astype(jnp.bfloat16), "hvd").astype(jnp.float32),
+            x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

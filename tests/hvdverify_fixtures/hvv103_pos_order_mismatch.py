@@ -1,0 +1,30 @@
+"""HVV103 positive: rank-divergent branches issue the SAME two
+collectives in OPPOSITE order — half the ranks enter the psum while the
+other half enter the all_gather. Same count, same ops, deadlocked
+pairing: the coordinator's issue-order invariant (collectives execute
+in compiled program order), decided at trace time."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV103",)
+
+
+def build():
+    def program(x):
+        rank = lax.axis_index("hvd")
+
+        def psum_first(v):
+            s = lax.psum(v, "hvd")
+            return s + lax.all_gather(v, "hvd", tiled=True).sum()
+
+        def gather_first(v):
+            g = lax.all_gather(v, "hvd", tiled=True).sum()
+            return lax.psum(v, "hvd") + g
+
+        return lax.cond(rank < 4, psum_first, gather_first, x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    return fn, (f32(8, 4),)

@@ -1,0 +1,32 @@
+"""HVV104 negative: the SHIPPED elastic window shape — the scan window
+jitted with NO donation, under the same forbid-donation invariant the
+registry enforces on the real elastic.windowed_loop program. Clean by
+construction: nothing donates, so nothing can race the in-flight
+snapshot copy."""
+
+import jax
+import jax.numpy as jnp
+
+from tests.hvdverify_fixtures._common import f32
+
+EXPECT = ()
+FORBID_DONATION = True
+FORBID_DONATION_WHY = ("the elastic windowed loop forbids state donation "
+                       "while async snapshot d2h copies are in flight")
+
+
+def build():
+    def step_fn(state, batch):
+        new = jax.tree_util.tree_map(
+            lambda p: p - 0.1 * batch.mean(), state)
+        return new, {"loss": batch.mean()}
+
+    from horovod_tpu.jax.window import windowed
+
+    window_fn = jax.jit(windowed(step_fn, 4))  # loop.py: NOT donated
+
+    def program(state, batches):
+        return window_fn(state, batches)
+
+    state = {"w": f32(16, 16), "m": f32(16, 16)}
+    return program, (state, jax.ShapeDtypeStruct((4, 8), jnp.float32))

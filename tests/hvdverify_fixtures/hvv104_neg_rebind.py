@@ -1,0 +1,24 @@
+"""HVV104 negative: the supported donation pattern — rebind from the
+call result (``state = f(state)``) and only ever read the NEW buffers.
+bench.py's timed loop and the window scan both live on this shape."""
+
+import functools
+
+import jax
+
+from tests.hvdverify_fixtures._common import f32
+
+EXPECT = ()
+
+
+def build():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, grad):
+        return state - 0.1 * grad
+
+    def program(state, grad):
+        state = update(state, grad)
+        state = update(state, grad * 0.5)
+        return state, state.sum()
+
+    return program, (f32(32, 32), f32(32, 32))

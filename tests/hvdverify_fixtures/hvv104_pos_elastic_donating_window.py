@@ -1,0 +1,38 @@
+"""HVV104 positive — THE NAMED INVARIANT (PR 5, elastic loop).
+
+``run_elastic`` compiles its window as ``jax.jit(windowed(step_fn, k))``
+with NO donation: "an async snapshot may still be copying a buffer the
+next dispatch would otherwise reuse" (horovod_tpu/elastic/loop.py). This
+fixture is the donating variant — one ``donate_argnums=(0,)`` away from
+the shipped code, numerically identical on every test run, and a
+use-after-free race against the snapshot d2h copy on hardware. The
+registry's elastic.windowed_loop entry enforces the invariant on the
+real program; this fixture pins that a donating drift is FLAGGED."""
+
+import jax
+import jax.numpy as jnp
+
+from tests.hvdverify_fixtures._common import f32
+
+EXPECT = ("HVV104",)
+FORBID_DONATION = True
+FORBID_DONATION_WHY = ("the elastic windowed loop forbids state donation "
+                       "while async snapshot d2h copies are in flight")
+
+
+def build():
+    def step_fn(state, batch):
+        new = jax.tree_util.tree_map(
+            lambda p: p - 0.1 * batch.mean(), state)
+        return new, {"loss": batch.mean()}
+
+    from horovod_tpu.jax.window import windowed
+
+    window_fn = jax.jit(windowed(step_fn, 4),
+                        donate_argnums=(0,))  # the forbidden donation
+
+    def program(state, batches):
+        return window_fn(state, batches)
+
+    state = {"w": f32(16, 16), "m": f32(16, 16)}
+    return program, (state, jax.ShapeDtypeStruct((4, 8), jnp.float32))

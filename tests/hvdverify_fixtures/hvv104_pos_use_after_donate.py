@@ -1,0 +1,28 @@
+"""HVV104 positive: a buffer donated to a jitted call is read again in
+the same program — IR-level HVD003. The AST rule sees only lexical
+``donate_argnums`` assignments; here the donation is a call-graph fact
+(the jaxpr's ``donated_invars``), and the stale read is a dataflow
+edge. On hardware the read returns garbage; the CPU backend often
+tolerates it, which is why this must be caught statically."""
+
+import functools
+
+import jax
+
+from tests.hvdverify_fixtures._common import f32
+
+EXPECT = ("HVV104",)
+
+
+def build():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(state, grad):
+        return state - 0.1 * grad
+
+    def program(state, grad):
+        new_state = update(state, grad)
+        # WRONG: `state` was donated into `update`; its buffer is gone.
+        drift = (new_state - state).sum()
+        return new_state, drift
+
+    return program, (f32(32, 32), f32(32, 32))

@@ -1,0 +1,49 @@
+"""HVV105 negative: the real fused exchange — fused_reduce over the
+same leaves and threshold the ReconcileSpec declares. Every bucket's
+flat psum matches its planned bytes exactly; the accounting reconciles
+the way the repo sweep's optimizer.* programs do."""
+
+import jax.numpy as jnp
+from jax import lax  # noqa: F401
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+_THRESHOLD = 300  # 128f32=512B > 300 -> one bucket per tensor
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((128,), jnp.float32),
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8)
+
+
+def build():
+    from horovod_tpu.common import state as _state
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+
+    def exchange(a, b):
+        tok = _state.set_spmd_axis("hvd")
+        try:
+            return tuple(fused_reduce([a, b], average=True,
+                                      fusion_threshold=_THRESHOLD,
+                                      overlap="off", name="grads"))
+        finally:
+            _state.reset_spmd_axis(tok)
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(), P()),
+               out_specs=(P(), P()))
+    return fn, (f32(128), f32(64))

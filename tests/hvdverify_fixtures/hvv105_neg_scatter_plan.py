@@ -1,0 +1,52 @@
+"""HVV105 negative: the overlap SCATTER form — every bucket takes
+psum_scatter -> sharded-update -> all_gather (scatter threshold 0). The
+reconciliation must accept the rs+ag pair per bucket: the scatter's
+payload is the bucket padded to an axis-size multiple, the gather
+returns the 1/n shard — same ring wire bytes as the allreduce it
+replaces (fusion.py's documented decomposition)."""
+
+import jax.numpy as jnp
+from jax import lax  # noqa: F401
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+_THRESHOLD = 300
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((130,), jnp.float32),  # pads to 136
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8)
+
+
+def build():
+    from horovod_tpu.common import state as _state
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+
+    def exchange(a, b):
+        tok = _state.set_spmd_axis("hvd")
+        try:
+            return tuple(fused_reduce([a, b], average=True,
+                                      fusion_threshold=_THRESHOLD,
+                                      overlap="on", scatter_threshold=0,
+                                      name="grads"))
+        finally:
+            _state.reset_spmd_axis(tok)
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(), P()),
+               out_specs=(P(), P()))
+    return fn, (f32(130), f32(64))

@@ -1,0 +1,39 @@
+"""HVV105 positive: the traced exchange silently DROPS a tensor from
+the declared plan — the flat psum carries one leaf's bytes while the
+plan (and the scaling model pricing it) claims both. The training bug
+this encodes: a gradient leaf falls out of the fused exchange (a tree
+filter, a stale mask) and one parameter silently stops averaging across
+ranks — no crash, no failing assertion, just divergence."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV105",)
+
+_THRESHOLD = 1 << 20
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((128,), jnp.float32),
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8)
+
+
+def build():
+    def exchange(a, b):
+        reduced = lax.psum(a.ravel(), "hvd") / 8.0  # b never reduced
+        return reduced.reshape(a.shape), b
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(), P()),
+               out_specs=(P(), P()))
+    return fn, (f32(128), f32(64))

@@ -1,0 +1,40 @@
+"""HVV105 positive: the program claims the fused bucket plan (one
+bucket packing both tensors under the threshold) but EXECUTES a
+per-tensor exchange — two separate psums whose payloads match no
+bucket. This is HVD006's perf bug at the IR level, and it also breaks
+the byte accounting tools/scaling_model.py and bench's "collectives"
+stamp publish: the plan prices one collective's latency, the wire pays
+two."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV105",)
+
+_THRESHOLD = 1 << 20  # both tensors pack into ONE bucket
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((128,), jnp.float32),
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8)
+
+
+def build():
+    def exchange(a, b):
+        # WRONG: one psum per tensor; the declared plan fuses them.
+        return lax.psum(a, "hvd") / 8.0, lax.psum(b, "hvd") / 8.0
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(), P()),
+               out_specs=(P(), P()))
+    return fn, (f32(128), f32(64))

@@ -209,6 +209,14 @@ def test_overlap_and_bucket_stamps_in_record():
     assert b["count"] >= 1 and b["total_bytes"] > 0
     assert {"total_mb", "oversize_singletons", "largest_bytes"} <= set(b)
     assert out["value"] > 0
+    # The static collective audit (tools/hvdverify) rides every record:
+    # the step program's reduce traffic must carry at least the bucket
+    # plan's bytes (scalar metric psums ride on top), with per-kind
+    # counts for the perf_summary column.
+    c = out["collectives"]
+    assert c["count"] >= b["count"]
+    assert c["bytes"] >= b["total_bytes"]
+    assert c["by_kind"] and sum(c["by_kind"].values()) == c["count"]
 
 
 def test_snapshot_stamp_in_record():

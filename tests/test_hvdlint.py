@@ -140,6 +140,22 @@ def test_select_filters_rules():
     assert lint_file(path, select=["HVD004"])
 
 
+def test_hvd008_path_exemption():
+    """parallel/mesh.py and common/config.py OWN axis naming: HVD008 is
+    path-exempt there (PATH_EXEMPT in rules.py) and fires anywhere
+    else, while other rules still apply to the exempt files."""
+    src = 'AXES = ("hvd", "ici")\n'
+    hits = [f for f in lint_source(src, "horovod_tpu/parallel/spmd.py")
+            if f.rule == "HVD008"]
+    assert len(hits) == 2, hits
+    assert lint_source(src, "horovod_tpu/parallel/mesh.py") == []
+    assert lint_source(src, "horovod_tpu/common/config.py") == []
+    # Exemption is per-rule, not per-file: HVD004 still fires in mesh.py.
+    cls = "class H:\n    def __del__(self):\n        pass\n"
+    assert any(f.rule == "HVD004" for f in
+               lint_source(cls, "horovod_tpu/parallel/mesh.py"))
+
+
 def test_repo_sweep_is_clean():
     """The shipping gate (acceptance criterion): zero unsuppressed
     findings across the swept surface."""

@@ -1,0 +1,405 @@
+"""Tests for tools/hvdverify: every HVV rule must fire on its positive
+traced-program fixtures (tests/hvdverify_fixtures/) and stay silent on
+the negatives, and the repo's real program registry must sweep clean.
+
+Fixture contract: each module defines ``build() -> (fn, args)`` plus an
+``EXPECT`` tuple of rule ids (empty for ``*_neg_*`` files), with
+optional ``FORBID_DONATION``/``FORBID_DONATION_WHY`` and ``RECONCILE``
+(zero-arg callable -> ReconcileSpec). The corpus includes the two named
+incidents: the PR-3 ring-attention rotation-inside-the-rank-divergent-
+cond shape (hvv101_pos_ring_rotation_in_cond) and the PR-5 elastic
+donating-window variant (hvv104_pos_elastic_donating_window).
+"""
+
+import importlib
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "hvdverify_fixtures"
+
+sys.path.insert(0, str(REPO))
+
+from tools.hvdverify import (  # noqa: E402
+    FAST_GROUPS,
+    REGISTRY,
+    RULES,
+    programs,
+    verify,
+    verify_programs,
+)
+
+
+def _fixture_modules():
+    files = sorted(p for p in FIXTURES.glob("hvv*.py"))
+    assert files, "fixture corpus missing"
+    return files
+
+
+def _load(path: Path):
+    return importlib.import_module(
+        f"tests.hvdverify_fixtures.{path.stem}")
+
+
+def _verify_fixture(mod, name):
+    fn, args = mod.build()
+    reconcile = getattr(mod, "RECONCILE", None)
+    return verify(
+        fn, args, name=name,
+        forbid_donation=getattr(mod, "FORBID_DONATION", False),
+        forbid_donation_why=getattr(mod, "FORBID_DONATION_WHY", ""),
+        reconcile=reconcile() if reconcile else None)
+
+
+@pytest.mark.parametrize("path", _fixture_modules(),
+                         ids=lambda p: p.stem)
+def test_fixture(path, hvd):
+    mod = _load(path)
+    result = _verify_fixture(mod, path.stem)
+    fired = {f.rule for f in result.findings}
+    expected = set(mod.EXPECT)
+    if "_neg_" in path.name:
+        assert not expected, f"negative fixture {path.name} sets EXPECT"
+        assert not fired, (
+            f"negative fixture {path.name} produced findings:\n"
+            + "\n".join(f.format() for f in result.findings))
+    else:
+        assert expected, f"positive fixture {path.name} lacks EXPECT"
+        assert fired == expected, (
+            f"{path.name}: expected {sorted(expected)}, got "
+            f"{sorted(fired)}:\n"
+            + "\n".join(f.format() for f in result.findings))
+
+
+def test_corpus_covers_every_rule_both_ways():
+    """>= 2 positive and >= 2 negative fixtures per rule (the ISSUE's
+    corpus floor), counting hvv10X-prefixed files."""
+    for rule in RULES:
+        prefix = rule.lower()
+        pos = list(FIXTURES.glob(f"{prefix}_pos_*.py"))
+        neg = list(FIXTURES.glob(f"{prefix}_neg_*.py"))
+        assert len(pos) >= 2, f"{rule}: {len(pos)} positive fixtures (<2)"
+        assert len(neg) >= 2, f"{rule}: {len(neg)} negative fixtures (<2)"
+
+
+def test_named_incident_fixtures_present():
+    """The two historical shapes ride the corpus by name: PR 3's
+    rank-divergent ring rotation and PR 5's donating elastic window."""
+    assert (FIXTURES / "hvv101_pos_ring_rotation_in_cond.py").exists()
+    assert (FIXTURES / "hvv104_pos_elastic_donating_window.py").exists()
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_shape():
+    """The acceptance floor: >= 9 gate lanes, 3 optimizer modes, all 6
+    parallel modules, the elastic loop — and the byte-reconciled +
+    donation-forbidden entries are actually marked."""
+    by_group = {}
+    for p in REGISTRY:
+        by_group.setdefault(p.group, []).append(p)
+    assert len(by_group["gate"]) >= 9
+    assert len(by_group["optimizer"]) == 3
+    names = {p.name for p in by_group["parallel"]}
+    assert names == {
+        "parallel.spmd", "parallel.tp", "parallel.pipeline",
+        "parallel.ulysses", "parallel.ring_attention", "parallel.moe"}
+    elastic = by_group["elastic"]
+    assert len(elastic) == 1 and elastic[0].forbid_donation
+    assert all(p.reconcile is not None for p in by_group["optimizer"])
+
+
+def test_repo_sweep_core_is_clean(hvd):
+    """The fast-lane shipping gate: the optimizer/parallel/elastic
+    registry programs (cheap traces) verify at zero unsuppressed
+    findings. The full registry incl. the big-model gate lanes is
+    pinned by test_repo_sweep_is_clean (slow) and tools/check.sh
+    --verify."""
+    results = verify_programs(programs(groups=FAST_GROUPS))
+    bad = [f.format() for r in results for f in r.active]
+    assert not bad, "\n".join(bad)
+    # Schedules must be non-trivially extracted, not vacuously clean.
+    with_colls = [r for r in results if r.summary["count"]]
+    assert len(with_colls) >= 8, [
+        (r.name, r.summary["count"]) for r in results]
+
+
+def test_repo_sweep_is_clean(hvd):
+    """The full acceptance gate, mirroring hvdlint's
+    test_repo_sweep_is_clean: EVERY registry program — the 9 driver
+    gate lanes included — traces at zero unsuppressed findings."""
+    results = verify_programs(programs())
+    bad = [f.format() for r in results for f in r.active]
+    assert not bad, "\n".join(bad)
+    assert len(results) == len(REGISTRY)
+
+
+def test_optimizer_overlap_issue_order_is_reverse(hvd):
+    """The IR-level pin of PR 4's reverse-order overlap emission: with
+    overlap on, the FIRST issued bucket is the LAST plan bucket
+    (backward availability order), vs forward order with overlap off —
+    read directly off the verified schedules' issue indices."""
+    fused, over = verify_programs(
+        programs(names=["optimizer.fused", "optimizer.overlap"]))
+    fwd = [op.payload_bytes for op in fused.schedule]
+    rev = [op.payload_bytes for op in over.schedule]
+    assert fwd == rev[::-1], (fwd, rev)
+    assert len(fwd) >= 2  # multi-bucket plan, or the pin is vacuous
+
+
+def test_scan_multiplier_accounting(hvd):
+    """Collectives under lax.scan are accounted once per iteration: a
+    K-step window multiplies its per-step collective bytes by K."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvd_mod
+    from horovod_tpu.jax.window import windowed
+
+    def step(state, batch):
+        return state + hvd_mod.allreduce(batch.mean()), batch.mean()
+
+    k = 5
+    run = hvd_mod.spmd_fn(windowed(step, k),
+                          in_specs=(P(), P(None, "hvd")),
+                          out_specs=(P(), P()))
+    state = jax.ShapeDtypeStruct((), jnp.float32)
+    batch = jax.ShapeDtypeStruct((k, 8, 4), jnp.float32)
+    res = verify(lambda s, b: run(s, b), (state, batch), name="win")
+    assert res.summary["count"] == 1
+    (op,) = res.schedule
+    assert op.times == k
+    assert res.summary["bytes"] == op.payload_bytes * k
+
+
+def test_elastic_donating_variant_is_flagged(hvd):
+    """The PR-5 invariant as a regression test: take the REAL elastic
+    window builder, swap in the donating jit, and the verifier must
+    flag it under forbid_donation (the registry entry guards the
+    shipped, non-donating build)."""
+    import jax
+
+    from horovod_tpu.jax.window import windowed
+    from tools.hvdverify.registry import (
+        _ELASTIC_WHY,
+        _build_elastic_windowed_loop,
+    )
+
+    fn, args = _build_elastic_windowed_loop()
+    clean = verify(fn, args, name="elastic", forbid_donation=True,
+                   forbid_donation_why=_ELASTIC_WHY)
+    assert not clean.findings
+
+    def donating(state, batch):
+        import optax
+
+        from horovod_tpu import models
+
+        model = models.MNISTNet()
+        step_fn = models.make_train_step(model, optax.sgd(0.1),
+                                         average_loss=False)
+        window_fn = jax.jit(windowed(step_fn, 4), donate_argnums=(0,))
+        return window_fn(state, batch)
+
+    flagged = verify(donating, args, name="elastic-donating",
+                     forbid_donation=True,
+                     forbid_donation_why=_ELASTIC_WHY)
+    assert [f.rule for f in flagged.findings] == ["HVV104"]
+    assert "snapshot" in flagged.findings[0].message
+
+
+def test_while_condition_findings_are_merged(hvd):
+    """Findings produced INSIDE a while-loop condition's sub-walk (here
+    a rank-divergent one-branch cond) must surface alongside the
+    collective-in-condition finding, not be dropped with the sub-walker."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+    def program(x):
+        rank = lax.axis_index("hvd")
+
+        def cond_fn(carry):
+            i, v = carry
+            s = lax.cond(rank == 0,
+                         lambda u: lax.psum(u, "hvd"),
+                         lambda u: u, v)
+            return i < jnp.int32(3) + (jnp.sum(s) * 0).astype(jnp.int32)
+
+        def body_fn(carry):
+            i, v = carry
+            return i + 1, v + 1.0
+
+        _, out = lax.while_loop(cond_fn, body_fn, (jnp.int32(0), x))
+        return out
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    res = verify(fn, (f32(8, 4),), name="while-cond")
+    msgs = [f.message for f in res.findings if f.rule == "HVV101"]
+    assert any("only some branches" in m for m in msgs), msgs
+    assert any("CONDITION" in m for m in msgs), msgs
+
+
+def test_while_body_born_taint_makes_trip_count_divergent(hvd):
+    """A while loop whose BODY writes axis_index into the carry counter
+    is rank-divergent even though the initial carry is clean — the
+    carry-taint fixpoint must surface it (each rank exits after a
+    different iteration count; the body psum then deadlocks)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+    def program(x):
+        def cond_fn(carry):
+            i, _ = carry
+            return i < 8
+
+        def body_fn(carry):
+            i, v = carry
+            # Taint born HERE: the counter advances by a rank-derived
+            # stride, so ranks trip the condition at different counts.
+            return (i + lax.axis_index("hvd") + 1,
+                    lax.psum(v, "hvd"))
+
+        _, out = lax.while_loop(cond_fn, body_fn, (jnp.int32(0), x))
+        return out
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    res = verify(fn, (f32(8, 4),), name="body-born-taint")
+    msgs = [f.message for f in res.findings if f.rule == "HVV101"]
+    assert any("trip count" in m for m in msgs), [
+        f.format() for f in res.findings]
+
+
+def test_hvv105_flags_untagged_exchange_beside_tagged(hvd):
+    """A hand-rolled gradient-sized psum on the gradient axis is
+    unplanned traffic even when a TAGGED fused exchange exists — the
+    tag pre-filter must not blind the rule to the bypass (metric-sized
+    psums stay exempt)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from horovod_tpu.jax.fusion import fused_reduce
+    from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+    from tools.hvdverify.rules import ReconcileSpec
+
+    leaves = [jax.ShapeDtypeStruct((128,), jnp.float32)]
+
+    def exchange(a):
+        (g,) = fused_reduce([a])              # the tagged, planned path
+        stray = lax.psum(a * 2.0, "hvd")      # hand-rolled bypass
+        metric = lax.psum(jnp.sum(a), "hvd")  # loss mean: stays exempt
+        return g + stray + metric
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(),), out_specs=P())
+    # fused_reduce reads the SPMD-axis contextvar hvd.spmd_run sets;
+    # the raw shard_map fixture must set it for the tagged path.
+    from horovod_tpu.common.state import reset_spmd_axis, set_spmd_axis
+
+    token = set_spmd_axis("hvd")
+    try:
+        res = verify(fn, (f32(128),), name="tagged-plus-stray",
+                     reconcile=ReconcileSpec(leaves=leaves,
+                                             threshold=1 << 20,
+                                             axis_size=8))
+    finally:
+        reset_spmd_axis(token)
+    assert [f.rule for f in res.findings] == ["HVV105"], [
+        f.format() for f in res.findings]
+    assert "OUTSIDE the tagged fused exchange" in res.findings[0].message
+
+
+def test_hvv105_flags_gather_without_scatter(hvd):
+    """A stray all_gather on the gradient axis that matches no bucket is
+    unplanned traffic, same as a stray psum — the leftover pool must
+    include the gathers."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+    from tools.hvdverify.rules import ReconcileSpec
+
+    leaves = [jax.ShapeDtypeStruct((128,), jnp.float32)]
+
+    def exchange(a):
+        g = lax.psum(a, "hvd") / 8.0          # the planned fused bucket
+        extra = lax.all_gather(a[:2], "hvd")  # matches no bucket
+        return g + jnp.sum(extra) * 0
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(),), out_specs=P())
+    res = verify(fn, (f32(128),), name="stray-gather",
+                 reconcile=ReconcileSpec(leaves=leaves,
+                                         threshold=1 << 20, axis_size=8))
+    assert [f.rule for f in res.findings] == ["HVV105"], [
+        f.format() for f in res.findings]
+    assert "all_gather" in res.findings[0].message
+
+
+def test_suppression_reported_not_failing(hvd):
+    """A suppressed finding is carried (with its reason) but does not
+    count as active — the hvdlint suppression contract."""
+    from jax import lax
+
+    from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+    def program(x):
+        rank = lax.axis_index("hvd")
+        return lax.cond(rank == 0,
+                        lambda v: lax.psum(v, "hvd"),
+                        lambda v: v, x)
+
+    fn = shmap(program, mesh(hvd=8), in_specs=P("hvd"),
+               out_specs=P("hvd"))
+    res = verify(fn, (f32(8, 4),), name="sup",
+                 suppress={"HVV101": "fixture: justification text"})
+    assert res.findings and all(f.suppressed for f in res.findings)
+    assert not res.active
+    assert res.findings[0].suppress_reason.startswith("fixture")
+
+
+def test_cli_contracts():
+    """--list-rules and --list run without a backend; an unknown
+    --program is a usage error; a clean program exits 0."""
+    env_cwd = str(REPO)
+    rules = subprocess.run(
+        [sys.executable, "-m", "tools.hvdverify", "--list-rules"],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert rules.returncode == 0
+    for rule in RULES:
+        assert rule in rules.stdout
+    listing = subprocess.run(
+        [sys.executable, "-m", "tools.hvdverify", "--list"],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert listing.returncode == 0
+    for p in REGISTRY:
+        assert p.name in listing.stdout
+    bogus = subprocess.run(
+        [sys.executable, "-m", "tools.hvdverify", "--program", "nope"],
+        cwd=env_cwd, capture_output=True, text=True)
+    assert bogus.returncode == 2, bogus.stderr
+
+
+def test_cli_clean_program_exits_zero():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdverify",
+         "--program", "optimizer.fused", "--json"],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    import json
+
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["program"] == "optimizer.fused"
+    assert rec["collectives"]["count"] >= 2
+    assert rec["findings"] == []

@@ -229,6 +229,46 @@ def test_moe_two_alltoalls_of_slot_bytes(hvd):
     assert colls == [("all_to_all", slot_bytes)] * 2, (colls, slot_bytes)
 
 
+def test_static_audit_matches_dynamic_accounting(hvd):
+    """hvdverify cross-check (docs/static_analysis.md): the schedule
+    walker behind bench.py's ``"collectives"`` stamp and HVV105 must
+    agree EXACTLY — per-op count and payload bytes — with this file's
+    independent dynamic jaxpr accounting, on both step shapes it pins
+    (fused DP and ZeRO-1). Two walkers, two authors, one jaxpr: any
+    divergence means one of the two audits is lying about the wire."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.hvdverify.schedule import ScheduleWalker, summarize
+
+    model = models.MNISTNet()
+    for zero in (False, True):
+        state, opt = models.create_train_state(
+            jax.random.PRNGKey(0), model, optax.sgd(0.1, momentum=0.9),
+            jnp.zeros((1, 28, 28, 1)), zero=zero)
+        step = models.make_train_step(model, opt)
+        spec = models.state_partition_specs(state) if zero else P()
+        batch = {"image": jnp.zeros((16, 28, 28, 1)),
+                 "label": jnp.zeros((16,), jnp.int32)}
+        tok = _state.set_spmd_axis("hvd")
+        try:
+            jaxpr = jax.make_jaxpr(jax.shard_map(
+                step, mesh=hvd.mesh(), in_specs=(spec, P("hvd")),
+                out_specs=(spec, P()), check_vma=False))(state, batch)
+        finally:
+            _state.reset_spmd_axis(tok)
+        dynamic = collect_collectives(jaxpr)
+        walker = ScheduleWalker().walk(jaxpr)
+        static = [(op.kind, op.payload_bytes) for op in walker.schedule]
+        assert sorted(static) == sorted(dynamic), (zero, static, dynamic)
+        # No scan in these steps, so the summarized stamp (bench.py's
+        # "collectives" field) is the plain sum of the dynamic walk.
+        summary = summarize(walker.schedule)
+        assert summary["count"] == len(dynamic)
+        assert summary["bytes"] == sum(b for _, b in dynamic)
+
+
 def test_pipeline_hops_one_microbatch_per_tick(hvd):
     """GPipe claim (parallel/pipeline.py): each tick ppermutes ONE
     microbatch activation to the next stage; the only other traffic is

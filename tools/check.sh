@@ -1,14 +1,22 @@
 #!/usr/bin/env bash
-# Development gate: hvdlint sweep + the fast lint-fixture tests + the
-# elastic fault-injection smoke, with an opt-in sanitizer lane.
+# Development gate: hvdlint sweep + the fast lint/verify fixture tests +
+# the elastic fault-injection smoke, with opt-in sanitizer and full
+# hvdverify lanes.
 #
 #   tools/check.sh              hvdlint (horovod_tpu/ tools/ bench.py must
 #                               be at zero unsuppressed findings) + the
 #                               hvdlint fixture/suppression test suite +
+#                               the hvdverify rule fixtures + fast-group
+#                               registry sweep (optimizer/parallel/elastic
+#                               programs at zero unsuppressed findings) +
 #                               the elastic fault-injection smoke (a real
 #                               `hvdrun --elastic` job loses rank 1 to a
 #                               HOROVOD_FAULT_PLAN SIGKILL mid-run and
 #                               must finish bit-exact after the relaunch)
+#   tools/check.sh --verify     additionally run the FULL hvdverify sweep
+#                               (`python -m tools.hvdverify --sweep`): all
+#                               registry programs incl. the 9 driver gate
+#                               lanes traced at zero unsuppressed findings
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -21,11 +29,13 @@ cd "$(dirname "$0")/.."
 
 SANITIZE=0
 ELASTIC=1
+VERIFY=0
 for arg in "$@"; do
   case "$arg" in
     --sanitize) SANITIZE=1 ;;
     --no-elastic) ELASTIC=0 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic]" >&2; exit 2 ;;
+    --verify) VERIFY=1 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -34,6 +44,14 @@ python -m tools.hvdlint horovod_tpu/ tools/ bench.py
 
 echo "== hvdlint rule fixtures =="
 python -m pytest tests/test_hvdlint.py -q -p no:cacheprovider
+
+echo "== hvdverify rule fixtures + fast-group registry sweep =="
+python -m pytest tests/test_hvdverify.py -q -p no:cacheprovider -m 'not slow'
+
+if [[ "$VERIFY" == "1" ]]; then
+  echo "== hvdverify FULL registry sweep (gate lanes included) =="
+  python -m tools.hvdverify --sweep
+fi
 
 if [[ "$ELASTIC" == "1" ]]; then
   echo "== elastic fault-injection smoke (kill rank 1, relaunch, bit-exact) =="

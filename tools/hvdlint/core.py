@@ -26,7 +26,7 @@ import tokenize
 from pathlib import Path
 from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
-from tools.hvdlint.rules import RULES
+from tools.hvdlint.rules import PATH_EXEMPT, RULES
 
 _SUPPRESS_RE = re.compile(
     r"#\s*hvdlint:\s*disable(?P<scope>-file)?\s*=\s*"
@@ -91,6 +91,15 @@ def _is_suppressed(line: int, rule: str,
     return False
 
 
+def _path_exempt(rule: str, path: str) -> bool:
+    """True when ``rule`` declares ``path`` as its own turf (PATH_EXEMPT
+    in rules.py — e.g. HVD008 lets the mesh factory and config name the
+    axes it bans everywhere else)."""
+    suffixes = PATH_EXEMPT.get(rule, ())
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(sfx) for sfx in suffixes)
+
+
 def lint_source(source: str, path: str = "<string>",
                 select: Sequence[str] = ()) -> List[Finding]:
     """Lint one source string; returns ALL findings with .suppressed set
@@ -98,7 +107,7 @@ def lint_source(source: str, path: str = "<string>",
     tree = ast.parse(source, filename=path)
     per_line, file_level = _suppressions(source)
     rules = {k: v for k, v in RULES.items()
-             if not select or k in select}
+             if (not select or k in select) and not _path_exempt(k, path)}
     findings: List[Finding] = []
     for rule_id, check in sorted(rules.items()):
         for raw in check(tree):
@@ -156,7 +165,7 @@ def main(argv: Sequence[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.hvdlint",
         description="Distributed-training static analysis "
-                    "(rules HVD001-HVD007; docs/static_analysis.md).")
+                    "(rules HVD001-HVD008; docs/static_analysis.md).")
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint")
     parser.add_argument("--select", default="",

@@ -625,6 +625,52 @@ def check_hvd007(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD008
+
+#: The mesh-axis names the repo's modules currently hardcode. Scoped to
+#: the data-parallel / hierarchical axes (the ones every module spells
+#: identically today); the per-module axes ("tp"/"pp"/"sp"/"ep") are
+#: parameters already.
+MESH_AXIS_LITERALS = {"hvd", "ici", "dcn"}  # hvdlint: disable=HVD008 (the rule owns its vocabulary)
+
+#: Path suffixes allowed to own axis-name literals: the mesh factory
+#: and the config surface — exactly where ROADMAP item 2's LogicalMesh
+#: refactor will centralize axis naming. Consumed by the engine
+#: (core.lint_source) since rules themselves see only the AST.
+PATH_EXEMPT = {
+    "HVD008": ("parallel/mesh.py", "common/config.py"),
+}
+
+
+def check_hvd008(tree: ast.AST) -> List[RawFinding]:
+    """Hardcoded mesh-axis string literal outside the mesh/config layer:
+    a bare ``"hvd"``/``"ici"``/``"dcn"`` constant names a mesh axis at
+    the use site, so six parallel modules plus every harness must agree
+    on spellings by convention alone — the exact coupling the
+    LogicalMesh refactor (ROADMAP item 2) must unwind. Every finding
+    (or its justified suppression) is one site that refactor rewrites;
+    the suppression inventory IS the work list.
+
+    Only exact-match constants fire (a log message *containing* "hvd"
+    is not an axis name); ``parallel/mesh.py`` and ``common/config.py``
+    are path-exempt via ``PATH_EXEMPT`` — axis naming is their job.
+    """
+    findings: List[RawFinding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in MESH_AXIS_LITERALS):
+            continue
+        findings.append(RawFinding(
+            node.lineno, node.col_offset, "HVD008", "warning",
+            f"hardcoded mesh-axis literal '{node.value}' outside "
+            "parallel/mesh.py / common/config.py: axis naming by "
+            "string convention couples every module to every other; "
+            "route through the mesh factory / config (the LogicalMesh "
+            "refactor's work list, ROADMAP item 2)"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -633,4 +679,5 @@ RULES = {
     "HVD005": check_hvd005,
     "HVD006": check_hvd006,
     "HVD007": check_hvd007,
+    "HVD008": check_hvd008,
 }

@@ -1,0 +1,592 @@
+"""The hvdverify program registry: the repo's real traced programs.
+
+Every entry builds ``(fn, abstract_args)`` for :func:`tools.hvdverify.
+core.verify` — the exact code paths the driver gate, the
+DistributedOptimizer, the parallel modules, and the elastic loop
+execute, traced at reduced input sizes (tracing cost scales with op
+count, not tensor size; the collective schedule is size-independent in
+structure). Groups:
+
+* ``gate``     — the driver gate lanes bench.py composes: the model-zoo
+                 train steps (resnet50/vgg16/inception_v3/vit/
+                 transformer_lm families) through ``spmd_fn`` with the
+                 state donated, plus the window / overlap / ZeRO /
+                 fused-CE lane variants.
+* ``optimizer``— DistributedOptimizer's fused / overlap / scatter
+                 emission modes, each with an HVV105 ReconcileSpec
+                 pinning the traced bytes to ``plan_buckets``.
+* ``parallel`` — all six hand-rolled sharding modules
+                 (spmd collectives, tp, pipeline, ulysses,
+                 ring_attention, moe), gradients included where the
+                 module ships custom VJPs.
+* ``elastic``  — the PR-5 windowed loop program with the
+                 no-donation-while-snapshot-in-flight invariant
+                 enforced (``forbid_donation``).
+
+Abstract state comes from ``jax.eval_shape`` over the real init
+functions — zero FLOPs, no devices, runs on CPU anywhere (the same
+trick tools/scaling_model.py uses for bucket bytes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tools.hvdverify.rules import ReconcileSpec
+
+#: Virtual mesh size every program traces under (matches the test
+#: harness's 8-device CPU mesh, tests/conftest.py).
+WORLD = 8
+
+_ELASTIC_WHY = ("the elastic windowed loop forbids state donation while "
+                "async snapshot d2h copies are in flight")
+
+
+@dataclasses.dataclass
+class Program:
+    name: str
+    group: str
+    build: Callable[[], Tuple[Callable, tuple]]
+    forbid_donation: bool = False
+    forbid_donation_why: str = ""
+    reconcile: Optional[Callable[[], ReconcileSpec]] = None
+    #: rule id -> justification; suppressed findings never fail the gate
+    #: but are always reported (the hvdlint suppression discipline).
+    suppress: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _require_world():
+    """The sweep needs an ``WORLD``-way device set; tests/conftest.py and
+    the CLI (__main__) both force the 8-device virtual CPU mesh before
+    jax initializes."""
+    import jax
+
+    if len(jax.devices()) < WORLD:
+        raise RuntimeError(
+            f"hvdverify needs {WORLD} devices (have "
+            f"{len(jax.devices())}); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "JAX_PLATFORMS=cpu (python -m tools.hvdverify sets this "
+            "up itself)")
+
+
+def _init():
+    import horovod_tpu.jax as hvd
+
+    _require_world()
+    hvd.init()
+    return hvd
+
+
+def abstractify(tree):
+    """ShapeDtypeStruct twin of an arbitrary array pytree — what every
+    registry program (and bench.py's ``collectives`` stamp) traces on:
+    only shapes/dtypes matter, nothing is allocated or executed."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+def _abstract_train_state(model, optimizer, sample):
+    """ShapeDtypeStruct TrainState via eval_shape — the exact pytree
+    ``models.create_train_state`` builds, without running init."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import FrozenDict, freeze
+
+    from horovod_tpu.models import TrainState
+
+    variables = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        jax.random.PRNGKey(0), sample)
+    params = variables["params"]
+    batch_stats = freeze(variables.get("batch_stats", FrozenDict()))
+    opt_state = jax.eval_shape(optimizer.init, params)
+    return TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------- gate
+
+
+def _image_lane(model_name, *, image=64, per_chip=2, overlap=None,
+                zero=False, window=1, num_classes=100):
+    """A driver-gate image lane: models.build -> make_train_step ->
+    spmd_fn with the state donated — bench.py's bench_image composition
+    (window>1 adds the stage_synthetic_window scan, the --steps-per-
+    dispatch lane)."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import models
+        from horovod_tpu.jax.window import stacked_specs, windowed
+
+        hvd = _init()
+        model = models.build(model_name, num_classes=num_classes)
+        sgd = optax.sgd(0.01, momentum=0.9)
+        sample = jax.ShapeDtypeStruct((1, image, image, 3), jnp.float32)
+        if zero:
+            from horovod_tpu.jax.zero import sharded_distributed_optimizer
+
+            optimizer = sharded_distributed_optimizer(sgd)
+        else:
+            from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+            optimizer = DistributedOptimizer(sgd, overlap=overlap)
+        state = _abstract_train_state(model, optimizer, sample)
+        step_fn = models.make_train_step(model, optimizer,
+                                         average_loss=False)
+        state_spec = (models.state_partition_specs(state) if zero
+                      else P())
+        n = hvd.size()
+        batch = {
+            "image": jax.ShapeDtypeStruct(
+                (per_chip * n, image, image, 3), jnp.float32),
+            "label": jax.ShapeDtypeStruct((per_chip * n,), jnp.int32),
+        }
+        # hvdlint: disable=HVD008 (the verifier traces today's
+        # hand-rolled axis spellings; rewrites with LogicalMesh)
+        batch_spec = P("hvd")  # hvdlint: disable=HVD008
+        if window > 1:
+            # The --steps-per-dispatch lane: the scan window over a
+            # K-stacked batch (bench.py stages concrete arrays through
+            # stage_synthetic_window; abstract tracing stacks the
+            # ShapeDtypeStructs directly).
+            step_fn = windowed(step_fn, window)
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct((window,) + x.shape,
+                                               x.dtype), batch)
+            batch_spec = stacked_specs(batch_spec)
+        run = hvd.spmd_fn(
+            step_fn,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, P()),
+            donate_argnums=(0,),
+        )
+        return (lambda s, b: run(s, b)), (state, batch)
+
+    return build
+
+
+def _lm_lane(*, fused_ce=False, seq=256, per_chip=1, layers=4, dim=256,
+             heads=4, vocab=1024):
+    """The transformer_lm gate lane: bench.py's bench_lm step (dense
+    attention; the fused_ce variant routes the loss through
+    ops/xent.fused_cross_entropy exactly as --fused-ce does)."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import models
+
+        hvd = _init()
+        model = models.TransformerLM(
+            vocab_size=vocab, num_layers=layers, num_heads=heads,
+            embed_dim=dim, max_len=max(seq, 2048))
+        from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+        optimizer = DistributedOptimizer(optax.adam(1e-4))
+        sample = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+        state = _abstract_train_state(model, optimizer, sample)
+
+        def step_fn(state, batch):
+            tokens = batch["tokens"]
+            if fused_ce:
+                from horovod_tpu.ops.xent import fused_cross_entropy
+
+                def loss_fn(params):
+                    hidden = model.apply({"params": params}, tokens,
+                                         train=False, return_hidden=True)
+                    e = hidden.shape[-1]
+                    h = hidden[:, :-1].reshape(-1, e).astype(jnp.float32)
+                    wv = params["lm_head"]["kernel"].astype(jnp.float32)
+                    return fused_cross_entropy(
+                        h, wv, tokens[:, 1:].reshape(-1))
+            else:
+                def loss_fn(params):
+                    logits = model.apply({"params": params}, tokens,
+                                         train=False)
+                    logp = jax.nn.log_softmax(
+                        logits[:, :-1].astype(jnp.float32))
+                    tgt = tokens[:, 1:]
+                    nll = -jnp.take_along_axis(logp, tgt[..., None], -1)
+                    return jnp.mean(nll)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            return models.apply_gradients(optimizer, state, grads), loss
+
+        n = hvd.size()
+        batch = {"tokens": jax.ShapeDtypeStruct((per_chip * n, seq),
+                                                jnp.int32)}
+        run = hvd.spmd_fn(
+            step_fn,
+            in_specs=(P(), P("hvd")),  # hvdlint: disable=HVD008 (LogicalMesh work list)
+            out_specs=(P(), P()),
+            donate_argnums=(0,),
+        )
+        return (lambda s, b: run(s, b)), (state, batch)
+
+    return build
+
+
+# ------------------------------------------------------------ optimizer
+
+
+def _mnist_param_leaves():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import models
+
+    model = models.MNISTNet()
+    variables = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 28, 28, 1), jnp.float32))
+    return jax.tree_util.tree_leaves(variables["params"])
+
+
+_OPT_THRESHOLD = 64 * 1024  # multi-bucket plan on the MNIST tree
+
+
+def _optimizer_mode(*, overlap, scatter):
+    """DistributedOptimizer traced in one emission mode over the MNIST
+    parameter tree, inside shard_map over the "hvd" axis — the program
+    tests/test_overlap.py exercises dynamically, verified statically."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.common.state import global_state
+        from horovod_tpu.jax.fusion import fused_reduce
+
+        hvd = _init()
+        st = global_state()
+        scatter_threshold = 0 if scatter else (
+            st.config.overlap_scatter_threshold)
+        leaves = _mnist_param_leaves()
+
+        def exchange(*grads):
+            return tuple(fused_reduce(
+                list(grads), average=True,
+                fusion_threshold=_OPT_THRESHOLD,
+                overlap=overlap,
+                scatter_threshold=scatter_threshold,
+                name="grads"))
+
+        run = hvd.spmd_fn(
+            exchange,
+            in_specs=tuple(P() for _ in leaves),
+            out_specs=tuple(P() for _ in leaves),
+        )
+        args = tuple(jax.ShapeDtypeStruct(l.shape, jnp.float32)
+                     for l in leaves)
+        return (lambda *a: run(*a)), args
+
+    def reconcile():
+        return ReconcileSpec(
+            leaves=_mnist_param_leaves(),
+            threshold=_OPT_THRESHOLD,
+            axis_size=WORLD,
+        )
+
+    return build, reconcile
+
+
+# ------------------------------------------------------------- parallel
+
+
+def _submesh(axes: Dict[str, int]):
+    import jax
+
+    from horovod_tpu.parallel.mesh import make_mesh
+
+    n = 1
+    for v in axes.values():
+        n *= v
+    return make_mesh(axes, devices=jax.devices()[:n])
+
+
+def _shmapped(fn, mesh, in_specs, out_specs):
+    """Raw shard_map in the repo's version-compat spelling (the legacy
+    checker cannot type these rank-programs; the wire bytes and the
+    schedule are what hvdverify pins — same opt-out class as
+    tests/test_wire_bytes.py)."""
+    from horovod_tpu.parallel.spmd import _SHARD_MAP_CHECK_KW, _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      **{_SHARD_MAP_CHECK_KW: False})
+
+
+def _build_parallel_spmd():
+    """The hvd.* collective surface (mpi_ops) under spmd_fn: allreduce,
+    grouped_allreduce, allgather, alltoall, reducescatter, broadcast —
+    one program issuing each, the eager lane's SPMD twin."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    hvd = _init()
+
+    def program(x, pair):
+        a = hvd.allreduce(x, average=True)
+        g = hvd.grouped_allreduce([x, 2.0 * x], average=False)
+        cat = hvd.allgather(x)
+        t = hvd.alltoall(jnp.tile(x, (hvd.size(), 1)))
+        rs = hvd.reducescatter(jnp.tile(x, (hvd.size(), 1)),
+                               average=False)
+        b = hvd.broadcast(pair, root_rank=0)
+        return (a + g[0] + g[1] + rs + t.mean() + b,
+                cat.sum())
+
+    run = hvd.spmd_fn(program, in_specs=(P(), P()),
+                      out_specs=(P(), P()))
+    x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    pair = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+    return (lambda *a: run(*a)), (x, pair)
+
+
+def _build_parallel_tp():
+    """Megatron MLP (column->row) WITH gradients: the custom-VJP
+    conjugates (tp_region_output) put a psum in the backward — the
+    walker must find it through custom_vjp_call_jaxpr."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.parallel as par
+
+    _init()
+    mesh = _submesh({"tp": 4})
+    B, L, E, F = 2, 8, 16, 32
+
+    def loss(x, wu, bu, wd, bd):
+        return par.tp_mlp(x, wu, bu, wd, bd, axis="tp").sum()
+
+    fn = _shmapped(
+        jax.grad(loss, argnums=(1, 3)), mesh,
+        in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+        out_specs=(P(None, "tp"), P("tp", None)))
+    args = (jax.ShapeDtypeStruct((B, L, E), jnp.float32),
+            jax.ShapeDtypeStruct((E, F), jnp.float32),
+            jax.ShapeDtypeStruct((F,), jnp.float32),
+            jax.ShapeDtypeStruct((F, E), jnp.float32),
+            jax.ShapeDtypeStruct((E,), jnp.float32))
+    return fn, args
+
+
+def _build_parallel_pipeline():
+    """GPipe schedule: the scanned tick loop rank-divergently injects/
+    emits (jnp.where on axis_index — data-level, legal) and ppermutes
+    every tick — the schedule must show the rotation UNconditional."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.parallel as par
+
+    _init()
+    mesh = _submesh({"pp": 4})
+    D, M, Bm = 8, 6, 2
+    fn = _shmapped(
+        lambda ws, x: par.pipeline_apply(
+            lambda w, a: jnp.tanh(a @ w), ws, x, "pp"),
+        mesh, in_specs=(P("pp"), P()), out_specs=P())
+    args = (jax.ShapeDtypeStruct((4, D, D), jnp.float32),
+            jax.ShapeDtypeStruct((M, Bm, D), jnp.float32))
+    return fn, args
+
+
+def _build_parallel_ulysses():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.parallel as par
+
+    _init()
+    mesh = _submesh({"sp": 4})
+    B, L, H, D = 2, 32, 4, 8
+    fn = _shmapped(
+        lambda q, k, v: par.ulysses_attention(q, k, v, axis="sp",
+                                              causal=True),
+        mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"))
+    x = jax.ShapeDtypeStruct((B, L, H, D), jnp.float32)
+    return fn, (x, x, x)
+
+
+def _build_parallel_ring_attention():
+    """The PR-3 shape this whole tool exists for: the causal dead-block
+    skip is a RANK-DIVERGENT lax.cond — legal exactly because both
+    branches are collective-free (the ppermute rotation stays outside,
+    unconditional). HVV101 proves that property on every trace; the
+    fixture corpus keeps the historical rotation-inside-the-cond variant
+    as a named incident."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.parallel as par
+
+    _init()
+    mesh = _submesh({"sp": 4})
+    B, L, H, D = 2, 32, 2, 4
+    fn = _shmapped(
+        lambda q, k, v: par.ring_attention(
+            q, k, v, axis="sp", causal=True, skip_dead_blocks=True),
+        mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"))
+    x = jax.ShapeDtypeStruct((B, L, H, D), jnp.float32)
+    return fn, (x, x, x)
+
+
+def _build_parallel_moe():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.parallel as par
+
+    _init()
+    mesh = _submesh({"ep": 4})
+    T, D, experts = 64, 8, 4
+    fn = _shmapped(
+        lambda x, gw, ew: par.moe_layer(
+            x, gw, lambda p, t: t @ p["w"], ew, axis="ep",
+            capacity_factor=1.0),
+        mesh, in_specs=(P("ep"), P(), {"w": P("ep")}),
+        out_specs=P("ep"))
+    args = (jax.ShapeDtypeStruct((T, D), jnp.float32),
+            jax.ShapeDtypeStruct((D, experts), jnp.float32),
+            {"w": jax.ShapeDtypeStruct((experts, D, D), jnp.float32)})
+    return fn, args
+
+
+# -------------------------------------------------------------- elastic
+
+
+def _build_elastic_windowed_loop():
+    """The PR-5 elastic window program EXACTLY as run_elastic builds it:
+    ``jax.jit(windowed(step_fn, k))`` with NO donation — an async
+    snapshot may still be copying a buffer the next dispatch would
+    otherwise reuse. ``forbid_donation`` turns any donating variant
+    into an HVV104 finding (the regression test donates on purpose)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu import models
+    from horovod_tpu.jax.window import windowed
+
+    _init()
+    model = models.MNISTNet()
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    sample = jax.ShapeDtypeStruct((1, 28, 28, 1), jnp.float32)
+    state = _abstract_train_state(model, optimizer, sample)
+    step_fn = models.make_train_step(model, optimizer,
+                                     average_loss=False)
+    k = 4
+    window_fn = jax.jit(windowed(step_fn, k))  # loop.py: NOT donated
+    batch = {
+        "image": jax.ShapeDtypeStruct((k, 8, 28, 28, 1), jnp.float32),
+        "label": jax.ShapeDtypeStruct((k, 8), jnp.int32),
+    }
+    return (lambda s, b: window_fn(s, b)), (state, batch)
+
+
+# -------------------------------------------------------------- registry
+
+
+def _make_registry() -> List[Program]:
+    progs: List[Program] = []
+
+    # The driver gate lanes (bench.py's composition per lane).
+    progs += [
+        Program("gate.resnet50", "gate", _image_lane("resnet50")),
+        Program("gate.resnet50_win", "gate",
+                _image_lane("resnet50", window=4)),
+        Program("gate.resnet50_overlap", "gate",
+                _image_lane("resnet50", overlap="on")),
+        Program("gate.resnet50_zero", "gate",
+                _image_lane("resnet50", zero=True)),
+        Program("gate.vgg16", "gate", _image_lane("vgg16")),
+        Program("gate.inception_v3", "gate",
+                _image_lane("inception_v3", image=128)),
+        Program("gate.vit_s16", "gate", _image_lane("vit_s16")),
+        Program("gate.transformer_lm", "gate", _lm_lane()),
+        Program("gate.transformer_lm_fused_ce", "gate",
+                _lm_lane(fused_ce=True)),
+    ]
+
+    # DistributedOptimizer emission modes, byte-reconciled (HVV105).
+    for mode, overlap, scatter in (("fused", "off", False),
+                                   ("overlap", "on", False),
+                                   ("scatter", "on", True)):
+        build, reconcile = _optimizer_mode(overlap=overlap,
+                                           scatter=scatter)
+        progs.append(Program(f"optimizer.{mode}", "optimizer", build,
+                             reconcile=reconcile))
+
+    # All six hand-rolled sharding modules.
+    progs += [
+        Program("parallel.spmd", "parallel",
+                lambda: _build_parallel_spmd()),
+        Program("parallel.tp", "parallel",
+                lambda: _build_parallel_tp()),
+        Program("parallel.pipeline", "parallel",
+                lambda: _build_parallel_pipeline()),
+        Program("parallel.ulysses", "parallel",
+                lambda: _build_parallel_ulysses()),
+        Program("parallel.ring_attention", "parallel",
+                lambda: _build_parallel_ring_attention()),
+        Program("parallel.moe", "parallel",
+                lambda: _build_parallel_moe()),
+    ]
+
+    # The elastic windowed loop + its donation invariant.
+    progs.append(Program(
+        "elastic.windowed_loop", "elastic",
+        lambda: _build_elastic_windowed_loop(),
+        forbid_donation=True,
+        forbid_donation_why=_ELASTIC_WHY))
+
+    return progs
+
+
+REGISTRY: List[Program] = _make_registry()
+
+#: Programs cheap enough for the fast (tier-1) sweep pin: everything
+#: except the big-model gate lanes, whose tracing cost belongs to the
+#: full-suite / check.sh --verify gate.
+FAST_GROUPS = ("optimizer", "parallel", "elastic")
+
+
+def programs(groups=None, names=None) -> List[Program]:
+    out = REGISTRY
+    if groups:
+        out = [p for p in out if p.group in groups]
+    if names:
+        wanted = set(names)
+        missing = wanted - {p.name for p in out}
+        if missing:
+            known = ", ".join(sorted(p.name for p in REGISTRY))
+            raise KeyError(f"unknown program(s) {sorted(missing)}; "
+                           f"have: {known}")
+        out = [p for p in out if p.name in wanted]
+    return out

@@ -1,0 +1,203 @@
+"""The hvdverify rule catalogue: IR-level checks over a traced program's
+collective schedule.
+
+hvdlint (tools/hvdlint) catches these bug classes SYNTACTICALLY; the
+repo's riskiest programs are *traced* — ``lax.cond`` branches, scanned
+windows, overlap's reverse-order bucket schedules — where AST rules are
+blind. hvdverify re-decides the native coordinator's runtime mismatch
+checks (csrc/coordinator.cc: op/dtype/root/shape/ragged) at trace time,
+over the jaxpr.
+
+Rules HVV101-HVV104 are emitted during the schedule walk
+(tools/hvdverify/schedule.py); HVV105 runs after, reconciling the
+schedule's byte accounting against the bucket plan
+(:func:`horovod_tpu.jax.fusion.plan_buckets`) the program claims to
+execute. ``RULES`` maps rule id -> one-line doc (the --list-rules
+catalogue; the long-form catalogue lives in docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from tools.hvdverify.schedule import CollectiveOp, RawFinding
+
+RULES: Dict[str, str] = {
+    "HVV101": "collective present in only some branches of rank-divergent "
+              "control flow (cond/while on axis_index) -> deadlock; the "
+              "IR-level generalization of HVD002",
+    "HVV102": "collective over an axis name not bound by the enclosing "
+              "mesh/shard_map (caught at trace or in the walked IR)",
+    "HVV103": "rank-divergent branches submit collective schedules that "
+              "disagree in op/order/shape/dtype/params — the "
+              "coordinator's five runtime mismatch checks, decided "
+              "statically",
+    "HVV104": "donated buffer referenced after the donating call "
+              "(IR-level HVD003), or donation where a program forbids it "
+              "(the elastic no-donation-while-snapshot-in-flight "
+              "invariant)",
+    "HVV105": "static wire-byte accounting does not reconcile with the "
+              "declared fusion bucket plan "
+              "(horovod_tpu.jax.fusion.plan_buckets)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One verified-program finding (the hvdverify analogue of
+    hvdlint's Finding; programs are keyed by registry name, not file)."""
+
+    program: str
+    rule: str
+    message: str
+    path: str = ""
+    source: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def format(self) -> str:
+        tag = (f" (suppressed: {self.suppress_reason})"
+               if self.suppressed else "")
+        src = f" [{self.source}]" if self.source else ""
+        return (f"{self.program}: {self.rule} {self.message}"
+                f" @ {self.path}{src}{tag}")
+
+
+def from_raw(program: str, raw: RawFinding) -> Finding:
+    return Finding(program=program, rule=raw.rule, message=raw.message,
+                   path=raw.path, source=raw.source)
+
+
+# ------------------------------------------------------------------ HVV105
+
+
+@dataclasses.dataclass
+class ReconcileSpec:
+    """What a program claims its fused gradient exchange moves.
+
+    ``leaves``: the gradient leaves (arrays or ShapeDtypeStructs) the
+    bucketed exchange reduces; ``threshold``: the fusion threshold the
+    plan was built with; ``axis_size``: the collective axis size (the
+    scatter form pads flat buckets to a multiple of it).
+    """
+
+    leaves: Sequence
+    threshold: int
+    axis_size: int
+    axis: str = "hvd"  # hvdlint: disable=HVD008 (LogicalMesh work list)
+
+
+def _pad_up(nbytes: int, quantum: int) -> int:
+    return ((nbytes + quantum - 1) // quantum) * quantum
+
+
+def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
+                         spec: ReconcileSpec) -> List[Finding]:
+    """HVV105: the traced schedule's gradient-exchange collectives must
+    carry EXACTLY the bytes of the bucket plan the program claims.
+
+    Matching contract (per bucket of ``plan_buckets(leaves, threshold)``):
+
+    * a ``psum`` entry whose payload equals the bucket's bytes (the
+      fused flat allreduce), or
+    * a ``reduce_scatter``/``psum_scatter`` entry whose payload equals
+      the bucket's bytes padded up to ``axis_size`` elements (the
+      overlap scatter form) AND a matching ``all_gather`` of the 1/n
+      shard.
+
+    Entries are pre-filtered to the fusion data plane: collectives whose
+    jax name_stack carries the ``hvd_allreduce`` scope fusion.py wraps
+    every bucket in. When no tagged entry exists (a hand-rolled
+    exchange), every reduce-type collective over the spec's axis is
+    considered instead — so a per-tensor exchange that bypasses fusion
+    reconciles only if it happens to move the same flat buckets.
+    Leftover entries or unmatched buckets are findings.
+    """
+    import numpy as np
+
+    from horovod_tpu.jax.fusion import plan_buckets
+
+    plan = plan_buckets(list(spec.leaves), spec.threshold)
+    exchange_kinds = ("psum", "psum2", "reduce_scatter", "psum_scatter",
+                      "all_gather")
+    tagged = [op for op in schedule if "hvd_allreduce" in op.name_stack
+              and spec.axis in op.axes]
+    used_tag_filter = bool(tagged)
+    if not tagged:
+        tagged = [op for op in schedule
+                  if op.kind in exchange_kinds and spec.axis in op.axes]
+    findings: List[Finding] = []
+    # The tag filter keeps metric psums (loss means etc.) out of the
+    # reconciliation — but a HAND-ROLLED collective on the gradient
+    # axis moving a gradient-sized payload is exactly the per-tensor
+    # bypass this rule exists to catch, tagged exchange present or not.
+    if used_tag_filter:
+        pooled = {id(op) for op in tagged}
+        grad_sizes = {b.nbytes for b in plan}
+        for leaf in spec.leaves:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is not None:
+                grad_sizes.add(
+                    int(np.prod(shape, dtype=np.int64))
+                    * np.dtype(dtype).itemsize)
+        for op in schedule:
+            if (id(op) not in pooled and op.kind in exchange_kinds
+                    and spec.axis in op.axes
+                    and op.payload_bytes in grad_sizes):
+                findings.append(Finding(
+                    program, "HVV105",
+                    f"schedule entry {op.describe()} moves a "
+                    "gradient-sized payload on the gradient axis "
+                    "OUTSIDE the tagged fused exchange: a hand-rolled "
+                    "per-tensor collective bypassing the bucket plan",
+                    op.path, op.source))
+    # Pool entries by kind; match buckets greedily by exact byte size.
+    reduces = [op for op in tagged
+               if op.kind in ("psum", "psum2")]
+    scatters = [op for op in tagged
+                if op.kind in ("reduce_scatter", "psum_scatter")]
+    gathers = [op for op in tagged if op.kind == "all_gather"]
+
+    def _take(pool, nbytes):
+        for i, op in enumerate(pool):
+            if op.payload_bytes == nbytes:
+                return pool.pop(i)
+        return None
+
+    for bucket in plan:
+        itemsize = np.dtype(bucket.dtype).itemsize
+        if _take(reduces, bucket.nbytes) is not None:
+            continue
+        padded = _pad_up(bucket.nbytes, spec.axis_size * itemsize)
+        rs = _take(scatters, padded)
+        if rs is not None:
+            ag = _take(gathers, padded // spec.axis_size)
+            if ag is None:
+                findings.append(Finding(
+                    program, "HVV105",
+                    f"bucket {bucket.dtype}.b{bucket.index} "
+                    f"({bucket.nbytes} B) reduce-scatters but its "
+                    f"{padded // spec.axis_size} B all-gather of the "
+                    "shard is missing — the scatter form must gather "
+                    "back (fusion.py rs+ag contract)"))
+            continue
+        findings.append(Finding(
+            program, "HVV105",
+            f"bucket {bucket.dtype}.b{bucket.index} of the declared "
+            f"plan ({len(bucket.members)} tensor(s), {bucket.nbytes} B "
+            f"at threshold {spec.threshold}) has NO matching collective "
+            "in the traced schedule: the program does not execute the "
+            "bucket plan it claims (plan_buckets/scaling_model would "
+            "account bytes the wire never moves)"))
+    for op in reduces + scatters + gathers:
+        findings.append(Finding(
+            program, "HVV105",
+            f"schedule entry {op.describe()} matches NO bucket of the "
+            f"declared plan ({len(plan)} bucket(s) at threshold "
+            f"{spec.threshold}): unplanned traffic — a per-tensor "
+            "exchange, a gather without its reduce-scatter, or a "
+            "foreign collective on the gradient axis",
+            op.path, op.source))
+    return findings

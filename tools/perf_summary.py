@@ -88,6 +88,18 @@ def overlap_cell(rec):
     return str(mode)
 
 
+def collectives_cell(rec):
+    """Compact render of the record's static collective audit (bench.py
+    stamps it from the tools/hvdverify schedule walker): "4c/101.8MB" =
+    4 collectives moving 101.8 MB per step program. The static twin of
+    the overlap/bucket column; tests/test_wire_bytes.py pins it against
+    the dynamic jaxpr accounting. Pre-audit records render as em-dash."""
+    c = rec.get("collectives")
+    if not isinstance(c, dict):
+        return "—"
+    return f"{c.get('count', '?')}c/{c.get('mb', '?')}MB"
+
+
 def snapshot_cell(rec):
     """Compact render of the record's elastic snapshot stamp (bench.py
     --snapshot-every; horovod_tpu.elastic): "100/1.2ms/0.05%" = cadence
@@ -109,9 +121,9 @@ def main():
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | overlap | flash grid "
-          "| snapshot | peak | probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|")
+    print("| lane | value | unit | window | overlap | collectives "
+          "| flash grid | snapshot | peak | probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -122,6 +134,7 @@ def main():
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
               f"| {window if window is not None else '—'} "
               f"| {overlap_cell(rec)} "
+              f"| {collectives_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
               f"| {snapshot_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
