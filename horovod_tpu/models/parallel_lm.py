@@ -195,6 +195,58 @@ def lm_apply(params: Dict, tokens, sp: Optional[str] = None,
     return _logits(params, x)
 
 
+def lm_prefill(params: Dict, prompt, tp: Optional[str] = None):
+    """Full forward over the prompt, capturing each layer's K/V into
+    fixed-size [B, Lmax, H, D] caches (Lmax = the position table).
+
+    The cache-plumbing half of :func:`lm_decode`, public so serving
+    paths (:mod:`horovod_tpu.serve`) and tests can compose it with
+    :func:`lm_decode_step` directly. Returns ``(caches, logits_last)``:
+    per-layer ``{"k", "v"}`` dicts plus the last position's logits
+    [B, vocab] — what the first generated token is sampled from."""
+    B, Lp = prompt.shape
+    Lmax = params["pos"].shape[0]
+    x = params["embed"][prompt] + params["pos"][None, :Lp]
+    caches = []
+    for layer in params["layers"]:
+        q, k, v = _project_qkv(layer, x, tp)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        pad = [(0, 0), (0, Lmax - Lp), (0, 0), (0, 0)]
+        caches.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
+        attn = dot_product_attention(q, k, v, causal=True, scale=scale)
+        x = _attn_out_residual(layer, attn, x, tp)
+        x = _ffn_residual(layer, x, tp)
+    return caches, _logits(params, x[:, -1:])[:, 0]
+
+
+def lm_decode_step(params: Dict, caches, tok, t, tp: Optional[str] = None):
+    """One KV-cache decode step: write ``tok``'s K/V at position ``t``,
+    attend the new token against the masked cache, return
+    ``(new_caches, logits)`` with logits [B, vocab].
+
+    ``tok`` is [B] int32, ``t`` a (traced or static) scalar absolute
+    position; caches are :func:`lm_prefill`'s fixed-shape pytree, so the
+    step traces into one static program regardless of position. The
+    body of :func:`lm_decode`'s scan, public for serving paths."""
+    x = params["embed"][tok][:, None] + \
+        lax.dynamic_slice_in_dim(params["pos"], t, 1, 0)[None]
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        q, k, v = _project_qkv(layer, x, tp)              # [B, 1, H, D]
+        ck = lax.dynamic_update_slice_in_dim(cache["k"], k, t, 1)
+        cv = lax.dynamic_update_slice_in_dim(cache["v"], v, t, 1)
+        new_caches.append({"k": ck, "v": cv})
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        # The reference kernel with q_offset=t IS the cache mask
+        # (k_pos <= t; unwritten slots masked), keeping decode-step
+        # numerics identical to prefill/lm_apply.
+        attn = dot_product_attention(q, ck, cv, causal=True,
+                                     scale=scale, q_offset=t)
+        x = _attn_out_residual(layer, attn, x, tp)
+        x = _ffn_residual(layer, x, tp)
+    return new_caches, _logits(params, x)[:, 0]
+
+
 def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
               rng=None, tp: Optional[str] = None):
     """Autoregressive generation with a static-shape KV cache.
@@ -207,7 +259,13 @@ def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
     generation length. ``temperature=0`` is greedy argmax; otherwise
     categorical sampling with ``rng``. Composes with tp (head-sharded
     params inside shard_map; decode is forward-only). Returns the
-    generated ids [B, steps]."""
+    generated ids [B, steps].
+
+    Built from the public cache plumbing — :func:`lm_prefill` then a
+    scanned :func:`lm_decode_step` — which the continuous-batching
+    serving engine (:mod:`horovod_tpu.serve`) reuses with a paged cache
+    layout; the greedy engine output is pinned token-exact against this
+    function."""
     B, Lp = prompt.shape
     Lmax = params["pos"].shape[0]
     if Lp + steps > Lmax:
@@ -217,19 +275,7 @@ def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 requires an rng key")
 
-    # Prefill: full forward over the prompt, capturing each layer's K/V
-    # into the fixed-size caches.
-    x = params["embed"][prompt] + params["pos"][None, :Lp]
-    caches = []
-    for layer in params["layers"]:
-        q, k, v = _project_qkv(layer, x, tp)
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        pad = [(0, 0), (0, Lmax - Lp), (0, 0), (0, 0)]
-        caches.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
-        attn = dot_product_attention(q, k, v, causal=True, scale=scale)
-        x = _attn_out_residual(layer, attn, x, tp)
-        x = _ffn_residual(layer, x, tp)
-    logits_last = _logits(params, x[:, -1:])[:, 0]
+    caches, logits_last = lm_prefill(params, prompt, tp)
 
     def pick(logits, key):
         if temperature > 0:
@@ -242,23 +288,7 @@ def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
                     else (None, None))
         tok = pick(logits.astype(jnp.float32), sub)       # [B]
         t = Lp + i                                        # absolute position
-        x = params["embed"][tok][:, None] + \
-            lax.dynamic_slice_in_dim(params["pos"], t, 1, 0)[None]
-        new_caches = []
-        for layer, cache in zip(params["layers"], caches):
-            q, k, v = _project_qkv(layer, x, tp)          # [B, 1, H, D]
-            ck = lax.dynamic_update_slice_in_dim(cache["k"], k, t, 1)
-            cv = lax.dynamic_update_slice_in_dim(cache["v"], v, t, 1)
-            new_caches.append({"k": ck, "v": cv})
-            scale = 1.0 / math.sqrt(q.shape[-1])
-            # The reference kernel with q_offset=t IS the cache mask
-            # (k_pos <= t; unwritten slots masked), keeping decode-step
-            # numerics identical to prefill/lm_apply.
-            attn = dot_product_attention(q, ck, cv, causal=True,
-                                         scale=scale, q_offset=t)
-            x = _attn_out_residual(layer, attn, x, tp)
-            x = _ffn_residual(layer, x, tp)
-        logits = _logits(params, x)[:, 0]
+        new_caches, logits = lm_decode_step(params, caches, tok, t, tp)
         return (new_caches, logits, key), tok
 
     key0 = rng if temperature > 0 else None

@@ -1,0 +1,43 @@
+"""Continuous-batching LM inference serving (`horovod_tpu.serve`).
+
+The serving half of the inference story (the decode lane,
+`tools/decode_bench.py` / `models.parallel_lm.lm_decode`, is the
+single-batch baseline): an Orca-style iteration-level batching engine
+over a vLLM-style paged KV cache, TPU-native — every step executes ONE
+compiled program of fixed shape (a fixed count of decode slots plus one
+chunked-prefill lane), so requests join and leave the batch between
+steps without ever recompiling.
+
+* :mod:`~horovod_tpu.serve.kvcache` — block/paged KV cache: fixed-size
+  pages, a free-list allocator, per-request page tables, admission
+  control that rejects/queues when pages run out;
+* :mod:`~horovod_tpu.serve.engine` — the continuous-batching step loop
+  (mixed prefill+decode program, in-flight join/leave, greedy +
+  temperature/top-k sampling, token-exact with ``lm_decode`` when
+  greedy);
+* :mod:`~horovod_tpu.serve.scheduler` — request lifecycle
+  (queued → prefill → decode → finished/evicted) and the SLO-knobbed
+  scheduler (FCFS vs shortest-prompt-first, latency-vs-throughput);
+* :mod:`~horovod_tpu.serve.sampling` — vectorized per-slot sampling;
+* :mod:`~horovod_tpu.serve.metrics` — TTFT / per-token latency /
+  page-occupancy accounting for the bench lane
+  (`tools/serve_bench.py`).
+
+Architecture, page math, and the SLO tuning runbook: docs/serving.md.
+"""
+
+from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.engine import ServeEngine
+from horovod_tpu.serve.kvcache import OutOfPages, PageAllocator, PagedKVCache
+from horovod_tpu.serve.scheduler import Request, RequestState, Scheduler
+
+__all__ = [
+    "OutOfPages",
+    "PageAllocator",
+    "PagedKVCache",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "ServeConfig",
+    "ServeEngine",
+]

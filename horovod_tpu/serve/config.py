@@ -1,0 +1,93 @@
+"""Serving engine configuration: page math + scheduler SLO knobs.
+
+One frozen dataclass so every layer (cache, scheduler, engine, bench)
+reads the same validated numbers. The page math contract:
+
+* the model's position table length ``Lmax`` must divide into
+  ``page_size`` pages — each request's logical cache is ``Lmax //
+  page_size`` page slots, mapped to physical pages by its page table;
+* physical page 0 is RESERVED as the null sink: inactive lanes and
+  padded prefill rows scatter their K/V there, and short page tables
+  pad with it (reads beyond a request's length are masked, so its
+  garbage is never observed) — ``num_pages - 1`` pages are allocatable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+#: Scheduler admission policies (docs/serving.md "Scheduler knobs").
+POLICIES = ("fcfs", "sjf")
+#: The latency-vs-throughput SLO knob positions.
+SLO_MODES = ("latency", "balanced", "throughput")
+#: Page-allocation disciplines.
+ADMISSIONS = ("reserve", "lazy")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs for :class:`horovod_tpu.serve.ServeEngine`.
+
+    ``page_size``/``num_pages`` size the paged KV cache (page 0
+    reserved). ``decode_slots`` fixes the compiled program's decode
+    batch; ``prefill_chunk`` the tokens per step the prefill lane
+    processes (the chunked-prefill knob: bigger chunks reach the first
+    token faster, smaller chunks steal less of the step from decode).
+
+    ``policy`` picks the queue order (``fcfs`` arrival order /
+    ``sjf`` shortest-prompt-first). ``slo`` is the latency-vs-
+    throughput knob gating when NEW prefills start (see
+    :meth:`Scheduler.prefill_gate <horovod_tpu.serve.scheduler.
+    Scheduler>`): ``latency`` starts a prefill whenever the lane is
+    idle, ``throughput`` only once a decode slot is free to take the
+    finished request, ``balanced`` in between.
+
+    ``admission`` picks the page discipline: ``reserve`` allocates a
+    request's worst-case pages up front (admission control — a request
+    only starts when it can always finish; the default), ``lazy``
+    allocates pages as positions cross page boundaries and EVICTS on
+    exhaustion (higher occupancy, eviction-recompute risk).
+    """
+
+    page_size: int = 16
+    num_pages: int = 64
+    decode_slots: int = 4
+    prefill_chunk: int = 32
+    max_in_flight: int = 0      # 0 = decode_slots + the prefill lane
+    policy: str = "fcfs"
+    slo: str = "balanced"
+    admission: str = "reserve"
+    eos_token: Optional[int] = None
+    max_queue: int = 0          # 0 = unbounded
+    requeue_evicted: bool = True
+
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved null "
+                f"sink), got {self.num_pages}")
+        if self.decode_slots < 1:
+            raise ValueError(
+                f"decode_slots must be >= 1, got {self.decode_slots}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
+        if self.policy not in POLICIES:
+            raise ValueError(f"policy {self.policy!r} not in {POLICIES}")
+        if self.slo not in SLO_MODES:
+            raise ValueError(f"slo {self.slo!r} not in {SLO_MODES}")
+        if self.admission not in ADMISSIONS:
+            raise ValueError(
+                f"admission {self.admission!r} not in {ADMISSIONS}")
+
+    @property
+    def in_flight_limit(self) -> int:
+        """Admitted-requests cap. The default matches the step
+        program's lane count — ``decode_slots`` + the one prefill
+        lane — so saturation never silences the ``latency`` SLO gate
+        (a prefill can always start while every slot decodes)."""
+        return self.max_in_flight if self.max_in_flight > 0 \
+            else self.decode_slots + 1

@@ -1,0 +1,490 @@
+"""Continuous-batching serving engine: one fixed-shape compiled step.
+
+Orca's iteration-level batching, TPU-native. Every engine step executes
+ONE compiled program whose shapes never change — ``decode_slots``
+single-token decode lanes plus one ``prefill_chunk``-token chunked-
+prefill lane — so requests join and leave the running batch between
+steps with ZERO recompilation. Two program variants compile once each
+(mixed prefill+decode, and decode-only for steps with an idle prefill
+lane); everything else is data:
+
+* each decode slot gathers its request's logical cache
+  ``[Lmax, H, D]`` out of the paged K/V arrays through the request's
+  page-table index vector (:mod:`~horovod_tpu.serve.kvcache` — a pure
+  gather, never a reshape), inserts the step's new K/V row, attends
+  with ``q_offset = t`` (the cache mask, exactly
+  :func:`models.parallel_lm.lm_decode_step`'s spelling), and scatters
+  the new row back into the pages;
+* the prefill lane runs one chunk of the current prompt through the
+  RECTANGULAR-causal path — queries at global positions
+  ``start..start+C-1`` over the full gathered cache with
+  ``q_offset=start, k_offset=0`` (the PR-3 offset contract of
+  ``ops.attention``) — writing its K/V rows through the page table;
+  out-of-chunk (padded) rows scatter with ``mode="drop"`` so they
+  never touch a real page.
+
+Because both lanes reuse ``parallel_lm``'s layer functions verbatim and
+masked softmax terms are exactly zero, the greedy token stream is
+bit-identical to ``lm_decode`` per request (pinned in
+tests/test_serve_engine.py, and CI-gated via tools/serve_bench.py
+``--pin-exact``).
+
+The page arrays are threaded through the step FUNCTIONALLY — never
+donated: a live request's pages must stay readable under an in-flight
+step (tools/hvdverify registers ``serve.step`` with
+``forbid_donation``, the HVV104 invariant class the elastic loop
+established).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.kvcache import PagedKVCache
+from horovod_tpu.serve.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    pick_victim,
+)
+
+# --------------------------------------------------------------------------
+# The compiled step program (pure; jitted once per variant).
+
+
+def _gather_cache(pages_arr, table):
+    """pages [P, ps, H, D] x table [pps] -> the request's contiguous
+    logical cache [Lmax, H, D] (unmapped slots read the null page's
+    zeros — always masked downstream)."""
+    g = pages_arr[table]
+    return g.reshape(g.shape[0] * g.shape[1], g.shape[2], g.shape[3])
+
+
+def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
+               tp=None):
+    """One continuous-batching step.
+
+    ``dec``: ``tok``/``pos``/``active`` [S] + ``tables`` [S, pps];
+    ``pre`` (or None for the decode-only variant): ``tokens`` [C],
+    ``start``/``length`` scalars + ``table`` [pps].
+    Returns ``(new_pages, dec_logits [S, V], pre_logits [V] | None)``.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from horovod_tpu.models.parallel_lm import (
+        _attn_out_residual,
+        _ffn_residual,
+        _logits,
+        _project_qkv,
+    )
+    from horovod_tpu.ops.attention import dot_product_attention
+
+    ps = page_size
+    num_pages = pages[0]["k"].shape[0]
+    pps = dec["tables"].shape[1]
+    lmax = pps * ps
+    S = dec["tok"].shape[0]
+    new_pages = []
+
+    # ---------------------------------------------------- prefill lane
+    pre_logits = None
+    if pre is not None:
+        C = pre["tokens"].shape[0]
+        start = pre["start"]
+        rows = jnp.arange(C)
+        positions = start + rows                       # [C] global pos
+        row_valid = rows < pre["length"]
+        # OOB sentinel drops padded/inactive rows at every scatter.
+        safe_pos = jnp.clip(positions, 0, lmax - 1)
+        write_page = jnp.where(row_valid, pre["table"][safe_pos // ps],
+                               num_pages)              # OOB when invalid
+        write_off = safe_pos % ps
+        xp = params["embed"][pre["tokens"]][None] + \
+            params["pos"][safe_pos][None]              # [1, C, E]
+
+    # ----------------------------------------------------- decode lane
+    t = dec["pos"]                                      # [S]
+    write_page_d = jnp.where(dec["active"],
+                             dec["tables"][jnp.arange(S), t // ps],
+                             num_pages)                 # OOB = dropped
+    write_off_d = t % ps
+    xd = params["embed"][dec["tok"]][:, None] + \
+        params["pos"][t][:, None]                       # [S, 1, E]
+
+    insert = jax.vmap(
+        lambda c, u, tt: lax.dynamic_update_slice_in_dim(c, u, tt, 0))
+
+    for layer, page in zip(params["layers"], pages):
+        pk, pv = page["k"], page["v"]
+        scale = None
+
+        if pre is not None:
+            qp, kp, vp = _project_qkv(layer, xp, tp)    # [1, C, H, D]
+            # math.sqrt, exactly parallel_lm's spelling — the scale
+            # must be the bit-identical float for the exactness pin.
+            scale = 1.0 / math.sqrt(qp.shape[-1])
+            gk = _gather_cache(pk, pre["table"])
+            gv = _gather_cache(pv, pre["table"])
+            # The chunk's own rows enter the gathered view (scatter —
+            # row-distinct indices, padded rows dropped), then the
+            # rectangular-causal attention: queries at start+i over
+            # keys 0..start+i.
+            ck = gk.at[jnp.where(row_valid, safe_pos, lmax)].set(
+                kp[0], mode="drop")
+            cv = gv.at[jnp.where(row_valid, safe_pos, lmax)].set(
+                vp[0], mode="drop")
+            attn = dot_product_attention(qp, ck[None], cv[None],
+                                         causal=True, scale=scale,
+                                         q_offset=start, k_offset=0)
+            xp = _attn_out_residual(layer, attn, xp, tp)
+            xp = _ffn_residual(layer, xp, tp)
+            pk = pk.at[write_page, write_off].set(kp[0], mode="drop")
+            pv = pv.at[write_page, write_off].set(vp[0], mode="drop")
+
+        qd, kd, vd = _project_qkv(layer, xd, tp)        # [S, 1, H, D]
+        if scale is None:
+            scale = 1.0 / math.sqrt(qd.shape[-1])
+        gkd = jax.vmap(_gather_cache, in_axes=(None, 0))(
+            pk, dec["tables"])                          # [S, Lmax, H, D]
+        gvd = jax.vmap(_gather_cache, in_axes=(None, 0))(
+            pv, dec["tables"])
+        ckd = insert(gkd, kd, t)
+        cvd = insert(gvd, vd, t)
+        attn = jax.vmap(
+            lambda q, k, v, tt: dot_product_attention(
+                q, k, v, causal=True, scale=scale, q_offset=tt)
+        )(qd, ckd, cvd, t)                              # [S, 1, H, D]
+        xd = _attn_out_residual(layer, attn, xd, tp)
+        xd = _ffn_residual(layer, xd, tp)
+        pk = pk.at[write_page_d, write_off_d].set(kd[:, 0], mode="drop")
+        pv = pv.at[write_page_d, write_off_d].set(vd[:, 0], mode="drop")
+
+        new_pages.append({"k": pk, "v": pv})
+
+    dec_logits = _logits(params, xd)[:, 0]              # [S, V]
+    if pre is not None:
+        last = jnp.clip(pre["length"] - 1, 0, C - 1)
+        row = lax.dynamic_slice_in_dim(xp[0], last, 1, 0)   # [1, E]
+        pre_logits = _logits(params, row[None])[0, 0]       # [V]
+    return new_pages, dec_logits, pre_logits
+
+
+# --------------------------------------------------------------------------
+# The host-side engine.
+
+
+class ServeEngine:
+    """Continuous-batching LM serving over a paged KV cache.
+
+    ``params`` is :func:`models.parallel_lm.init_lm_params`' pytree.
+    The engine owns the device page arrays, the scheduler, and the
+    request lifecycle; :meth:`submit` queues work, :meth:`step` runs
+    one compiled step (returns False when fully idle), :meth:`run`
+    drains to idle. ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, params: Dict, config: ServeConfig, *,
+                 chips: int = 1, clock=time.perf_counter):
+        self.params = params
+        self.config = config
+        self.chips = chips
+        self.clock = clock
+        self.cache = PagedKVCache(params, config)
+        self.scheduler = Scheduler(self.cache, config)
+        self.slots: List[Optional[Request]] = [None] * config.decode_slots
+        self.ready: List[Request] = []      # prefilled, awaiting a slot
+        self.prefilling: Optional[Request] = None
+        self.finished: List[Request] = []
+        self.evicted: List[Request] = []    # terminal (requeue off)
+        self.occupancy_samples: List[float] = []
+        self.steps = 0
+        self._t_start = clock()
+        step = functools.partial(serve_step,
+                                 page_size=config.page_size)
+        import jax
+
+        # Two fixed-shape variants, compiled once each; NO donation —
+        # live requests hold pages under the step (hvdverify
+        # serve.step forbid_donation).
+        self._step_mixed = jax.jit(step)
+        self._step_decode = jax.jit(
+            lambda params, pages, dec: step(params, pages, dec, None))
+
+    # ------------------------------------------------------ submission
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token: Optional[int] = None, seed: int = 0,
+               arrival: Optional[float] = None) -> Request:
+        """Queue one generation request; returns it (check ``state`` —
+        ``rejected`` means it can never run or the queue is full)."""
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      eos_token=eos_token
+                      if eos_token is not None else self.config.eos_token,
+                      seed=seed,
+                      arrival=arrival if arrival is not None
+                      else self.clock())
+        self.scheduler.submit(req)
+        return req
+
+    # ------------------------------------------------------- lifecycle
+
+    @property
+    def in_flight(self) -> int:
+        return (sum(1 for s in self.slots if s is not None)
+                + len(self.ready) + (1 if self.prefilling else 0))
+
+    @property
+    def idle(self) -> bool:
+        return (self.in_flight == 0 and not self.scheduler.queue)
+
+    def _free_slots(self) -> int:
+        return sum(1 for s in self.slots if s is None)
+
+    def _finish(self, req: Request) -> None:
+        req.state = RequestState.FINISHED
+        req.t_finish = self.clock()
+        self.scheduler.release(req)
+        self.finished.append(req)
+
+    def _do_evict(self, victim: Request) -> None:
+        """Release a victim's pages and remove it from service; requeue
+        (recompute path) or terminate per config."""
+        self.scheduler.release(victim)
+        victim.evictions += 1
+        for i, s in enumerate(self.slots):
+            if s is victim:
+                self.slots[i] = None
+        self.ready = [r for r in self.ready if r is not victim]
+        if self.prefilling is victim:
+            self.prefilling = None
+        victim.state = RequestState.EVICTED
+        if self.config.requeue_evicted:
+            if not self.scheduler.requeue(victim):
+                self._finish(victim)
+        else:
+            self.evicted.append(victim)
+
+    def _evict_for(self, requester: Request) -> bool:
+        """Lazy-mode page pressure: evict the newest-admitted request
+        that is not the requester (and not mid-prefill-chunk). False =
+        nothing else to evict; the caller evicts the requester."""
+        candidates = [s for s in self.slots if s is not None] + \
+            list(self.ready)
+        victim = pick_victim(candidates, requester)
+        if victim is None:
+            return False
+        self._do_evict(victim)
+        return True
+
+    # ------------------------------------------------------------ step
+
+    def _promote_ready(self) -> None:
+        for i in range(len(self.slots)):
+            if self.slots[i] is None and self.ready:
+                req = self.ready.pop(0)
+                req.state = RequestState.DECODE
+                self.slots[i] = req
+
+    def _ensure_capacity(self) -> None:
+        """Lazy admission: map pages for every position this step
+        writes, evicting under pressure (reserve mode pre-granted the
+        worst case — nothing to do)."""
+        if self.config.admission != "lazy":
+            return
+        for req in list(self.slots):
+            if req is None or req not in self.slots:
+                continue
+            if not self.scheduler.ensure_pages(req, req.next_pos,
+                                               self._evict_for):
+                self._do_evict(req)
+        if self.prefilling is not None:
+            req = self.prefilling
+            chunk = min(self.config.prefill_chunk,
+                        req.prompt_len - req.prefill_pos)
+            last = req.prefill_pos + chunk - 1
+            if not self.scheduler.ensure_pages(req, last,
+                                               self._evict_for):
+                self._do_evict(req)
+
+    def _build_dec(self):
+        S = self.config.decode_slots
+        pps = self.cache.pages_per_seq
+        tok = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        tables = np.zeros((S, pps), np.int32)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            tok[i] = req.generated[-1]
+            pos[i] = req.next_pos
+            active[i] = True
+            tables[i] = req.page_table
+        return {"tok": tok, "pos": pos, "active": active,
+                "tables": tables}
+
+    def _build_pre(self):
+        if self.prefilling is None:
+            return None, 0
+        req = self.prefilling
+        C = self.config.prefill_chunk
+        chunk = min(C, req.prompt_len - req.prefill_pos)
+        tokens = np.zeros((C,), np.int32)
+        tokens[:chunk] = req.prompt[req.prefill_pos:
+                                    req.prefill_pos + chunk]
+        # page_table is never None here: Scheduler._admit assigns it
+        # before pick_prefill returns the request.
+        return {
+            "tokens": tokens,
+            "start": np.int32(req.prefill_pos),
+            "length": np.int32(chunk),
+            "table": np.asarray(req.page_table, np.int32),
+        }, chunk
+
+    def step(self) -> bool:
+        """Run one compiled step; False when there was nothing to do
+        (no active requests and nothing admissible in the queue)."""
+        from horovod_tpu.serve.sampling import sample_tokens
+
+        self._promote_ready()
+        if self.prefilling is None:
+            self.prefilling = self.scheduler.pick_prefill(
+                self._free_slots(), self.in_flight)
+            if self.prefilling is not None:
+                # (Re-)admission stamp — pick_victim's newest-admitted-
+                # first eviction order keys on this.
+                self.prefilling.t_admit = self.clock()
+        self._ensure_capacity()
+        # Eviction may have freed slots: promote, then re-map pages for
+        # the newly promoted rows. A promoted request whose next write
+        # starts a fresh page slot must not reach the compiled step
+        # with an unmapped (0) table entry — that row would write into
+        # the reserved null page and silently corrupt its KV stream.
+        # Terminates: each pass pops at least one request off `ready`
+        # (evictions requeue to the scheduler, never back onto ready).
+        while self.ready and any(s is None for s in self.slots):
+            self._promote_ready()
+            self._ensure_capacity()
+        if self.prefilling is None and \
+                all(s is None for s in self.slots):
+            return False
+
+        dec = self._build_dec()
+        pre, chunk = self._build_pre()
+        if pre is None:
+            pages, dec_logits, _ = self._step_decode(
+                self.params, self.cache.pages, dec)
+            pre_logits = None
+        else:
+            pages, dec_logits, pre_logits = self._step_mixed(
+                self.params, self.cache.pages, dec, pre)
+        self.cache.pages = pages
+
+        # One sampler call covers the decode slots + the prefill lane.
+        import jax.numpy as jnp
+
+        S = self.config.decode_slots
+        rows = list(self.slots)
+        logits = dec_logits
+        pre_done = (self.prefilling is not None and
+                    self.prefilling.prefill_pos + chunk
+                    >= self.prefilling.prompt_len)
+        if pre_logits is not None:
+            rows = rows + [self.prefilling if pre_done else None]
+            logits = jnp.concatenate([dec_logits, pre_logits[None]], 0)
+        n = len(rows)
+        temp = np.zeros((n,), np.float32)
+        topk = np.zeros((n,), np.int32)
+        seeds = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        for i, req in enumerate(rows):
+            if req is None:
+                continue
+            temp[i] = req.temperature
+            topk[i] = req.top_k
+            seeds[i] = req.seed
+            positions[i] = req.sample_index
+        tokens = np.asarray(sample_tokens(logits, temp, topk, seeds,
+                                          positions))
+        now = self.clock()          # after the d2h pull: a real sync
+
+        # Decode slots: one new token each.
+        for i in range(S):
+            req = self.slots[i]
+            if req is None:
+                continue
+            self._accept_token(req, int(tokens[i]), now)
+            if req.state == RequestState.FINISHED:
+                self.slots[i] = None
+
+        # Prefill lane: advance; on completion emit the FIRST token.
+        if self.prefilling is not None and pre is not None:
+            req = self.prefilling
+            req.prefill_pos += chunk
+            if pre_done:
+                self._accept_token(req, int(tokens[S]), now)
+                self.prefilling = None
+                if req.state != RequestState.FINISHED:
+                    req.state = RequestState.DECODE
+                    self.ready.append(req)
+
+        self.occupancy_samples.append(self.cache.occupancy())
+        self.steps += 1
+        return True
+
+    def _accept_token(self, req: Request, token: int, now: float
+                      ) -> None:
+        req.generated.append(token)
+        req.output.append(token)
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.token_times.append(now)
+        if req.done_generating or req.hit_eos(self.config.eos_token):
+            self._finish(req)
+
+    # ------------------------------------------------------------- run
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drain to idle (or ``max_steps``); returns requests finished
+        so far."""
+        while not self.idle:
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            if not self.step():
+                break   # queue non-empty but nothing admissible
+        return self.finished
+
+    def reset_metrics(self) -> None:
+        """Drop completed-work bookkeeping (the bench warmup
+        discipline: compile+warm through a dummy request, then measure
+        from a clean slate). Only valid when idle."""
+        if not self.idle:
+            raise RuntimeError("reset_metrics with requests in flight")
+        self.finished = []
+        self.evicted = []
+        self.scheduler.rejected = []
+        self.occupancy_samples = []
+        self.steps = 0
+        self._t_start = self.clock()
+
+    def stats(self) -> Dict:
+        """Aggregate SLO metrics over every request seen so far."""
+        from horovod_tpu.serve.metrics import summarize
+
+        everything = (self.finished + self.evicted + self.ready
+                      + [s for s in self.slots if s is not None]
+                      + ([self.prefilling] if self.prefilling else [])
+                      + self.scheduler.queue + self.scheduler.rejected)
+        return summarize(everything, self.clock() - self._t_start,
+                         self.chips, self.occupancy_samples)

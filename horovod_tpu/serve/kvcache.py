@@ -1,0 +1,170 @@
+"""Block/paged KV cache: fixed-size pages over the static cache layout.
+
+vLLM's PagedAttention idea, restated for the TPU compilation model: the
+compiled step program only ever sees FIXED-shape page arrays
+(``[num_pages, page_size, H, D]`` per layer per K/V) plus per-request
+page-table index vectors — so paging is pure data (gather/scatter
+indices), never a reshape, and the program compiles once. A request's
+logical cache positions ``0..Lmax-1`` map through its page table to
+physical pages; the gather of a full table reconstructs exactly the
+``[Lmax, H, D]`` contiguous cache :func:`models.parallel_lm.lm_decode`
+uses, which is what keeps the engine token-exact with the decode lane.
+
+Host side, this module is bookkeeping only (the hot path is inside the
+engine's compiled program): a free-list :class:`PageAllocator` with
+all-or-nothing grants, and :class:`PagedKVCache` tying the allocator to
+the device arrays + the admission-control page math. Fixed-size pages
+never fragment externally — exhaustion, not fragmentation, is the
+failure mode, and admission control (reserve worst case up front) or
+eviction (lazy mode) handles it; tests/test_serve_kvcache.py property-
+tests the invariants.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence
+
+
+class OutOfPages(Exception):
+    """Raised by :meth:`PageAllocator.alloc` when the free list cannot
+    satisfy the request (all-or-nothing; nothing was allocated)."""
+
+
+class PageAllocator:
+    """Free-list allocator over physical page ids.
+
+    Page ids ``reserved..num_pages-1`` are allocatable; ids below
+    ``reserved`` (the null sink page 0, by default) are never handed
+    out. Frees push onto the list tail and allocations pop from it
+    (LIFO — recently-freed pages are re-used first, which keeps the
+    working set of physical pages small). ``alloc`` is all-or-nothing:
+    either the full grant or :class:`OutOfPages` with no state change.
+    """
+
+    def __init__(self, num_pages: int, reserved: int = 1):
+        if num_pages <= reserved:
+            raise ValueError(
+                f"num_pages ({num_pages}) must exceed reserved "
+                f"({reserved})")
+        self.num_pages = num_pages
+        self.reserved = reserved
+        self._free: List[int] = list(range(num_pages - 1, reserved - 1, -1))
+        self._held: set = set()
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - self.reserved
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._held)
+
+    def alloc(self, n: int) -> List[int]:
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} pages, {len(self._free)} free "
+                f"(capacity {self.capacity})")
+        grant = [self._free.pop() for _ in range(n)]
+        self._held.update(grant)
+        return grant
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"free of page {p} which is not allocated (double "
+                    "free, or a reserved/null page id)")
+            self._held.discard(p)
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """The device-side page arrays + the allocator + the page math.
+
+    ``params`` may be concrete arrays or ``ShapeDtypeStruct``s (the
+    hvdverify registry traces the abstract twin): layer count, heads,
+    head_dim, Lmax, and dtype are read off the
+    :func:`models.parallel_lm.init_lm_params` pytree. The model's
+    position-table length must divide into whole pages — the engine's
+    gathered per-request cache is then EXACTLY ``[Lmax, H, D]``, the
+    decode lane's shape.
+    """
+
+    def __init__(self, params: Dict, config, *, abstract: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        self.max_len = int(params["pos"].shape[0])
+        if self.max_len % config.page_size:
+            raise ValueError(
+                f"position table length {self.max_len} must be a "
+                f"multiple of page_size {config.page_size} (whole-page "
+                "logical caches keep the gathered layout identical to "
+                "the decode lane's)")
+        self.pages_per_seq = self.max_len // config.page_size
+        wqkv = params["layers"][0]["wqkv"]
+        self.num_heads = int(wqkv.shape[2])
+        self.head_dim = int(wqkv.shape[3])
+        self.dtype = wqkv.dtype
+        self.num_layers = len(params["layers"])
+        shape = (config.num_pages, config.page_size, self.num_heads,
+                 self.head_dim)
+        if abstract:
+            mk = lambda: jax.ShapeDtypeStruct(shape, self.dtype)  # noqa: E731
+        else:
+            mk = lambda: jnp.zeros(shape, self.dtype)  # noqa: E731
+        #: Per-layer ``{"k", "v"}`` page arrays — the engine's step
+        #: program threads these through WITHOUT donation (a live
+        #: request's pages must never be overwritten under it;
+        #: tools/hvdverify registers the invariant as forbid_donation).
+        self.pages = [{"k": mk(), "v": mk()}
+                      for _ in range(self.num_layers)]
+        self.allocator = PageAllocator(config.num_pages, reserved=1)
+
+    # ------------------------------------------------------- page math
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case pages for a request: cache positions
+        ``0..prompt_len + max_new_tokens - 2`` are written (the final
+        sampled token is never fed back), so the last page slot touched
+        is ``(prompt_len + max_new_tokens - 2) // page_size``."""
+        positions = prompt_len + max_new_tokens - 1
+        return max(1, math.ceil(positions / self.config.page_size))
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether the request can EVER run: position bound (the decode
+        lane's ``prompt + steps <= Lmax`` contract) and total-capacity
+        bound. Failing this is a hard reject, not a queue."""
+        return (prompt_len >= 1 and max_new_tokens >= 1
+                and prompt_len + max_new_tokens <= self.max_len
+                and self.pages_needed(prompt_len, max_new_tokens)
+                <= self.allocator.capacity)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Admission control (reserve discipline): admit only when the
+        worst case is allocatable RIGHT NOW, so an admitted request can
+        always run to completion without eviction."""
+        return (self.pages_needed(prompt_len, max_new_tokens)
+                <= self.allocator.available)
+
+    # ---------------------------------------------------------- stats
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently held (0..1)."""
+        return self.allocator.in_use / max(1, self.allocator.capacity)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "pages_total": self.allocator.capacity,
+            "pages_in_use": self.allocator.in_use,
+            "pages_free": self.allocator.available,
+            "occupancy": self.occupancy(),
+        }

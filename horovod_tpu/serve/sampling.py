@@ -1,0 +1,61 @@
+"""Vectorized per-slot sampling for the serving engine.
+
+One jitted [N, V] sampler covers every lane of a step (decode slots +
+the prefill lane's first token) with PER-SLOT knobs, so requests with
+different temperatures/top-k share the one compiled program:
+
+* ``temperature == 0`` — greedy: ``argmax(logits.astype(float32))``,
+  the EXACT spelling ``models.parallel_lm.lm_decode`` uses, which is
+  what makes the engine's greedy stream token-identical to the decode
+  lane (pinned in tests/test_serve_engine.py);
+* ``temperature > 0`` — categorical over ``logits / temperature``,
+  optionally top-k-masked (``top_k <= 0`` = full vocab; ties at the
+  k-th logit are all kept — the mask is a >= threshold, standard
+  top-k-with-ties semantics).
+
+Keys are **position-folded**: token i of request r draws from
+``fold_in(PRNGKey(seed_r), i)`` where i indexes the request's FULL
+generation stream. No sampler state exists between steps, so a request
+evicted and recomputed (scheduler lazy mode) re-draws the identical
+tokens — sampling is a pure function of (seed, position, logits).
+This intentionally differs from ``lm_decode``'s single split-chain key
+(which is batch-coupled: one key drives all B rows); only the greedy
+path is pinned token-exact against the decode lane.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample_one(logits, temperature, top_k, seed, position):
+    """One slot: logits [V] f32 -> token (int32 scalar)."""
+    v = logits.shape[0]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    k = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    # Descending sort once; the k-th value is the keep threshold.
+    thresh = jnp.sort(logits)[::-1][k - 1]
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    masked = jnp.where(logits >= thresh, logits / safe_t, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled,
+                     greedy).astype(jnp.int32)
+
+
+@jax.jit
+def sample_tokens(logits, temperature, top_k, seeds, positions):
+    """Per-slot sampling: logits [N, V] (any float dtype), temperature
+    [N] f32, top_k [N] i32, seeds [N] i32/u32, positions [N] i32 ->
+    tokens [N] i32. Rows are independent — inactive lanes sample
+    garbage that the host discards."""
+    # f32 BEFORE any arithmetic: the greedy path must argmax the exact
+    # tensor lm_decode argmaxes.
+    logits = logits.astype(jnp.float32)
+    return jax.vmap(_sample_one)(logits,
+                                 temperature.astype(jnp.float32),
+                                 top_k.astype(jnp.int32),
+                                 seeds.astype(jnp.uint32),
+                                 positions.astype(jnp.uint32))

@@ -1,0 +1,306 @@
+"""Request lifecycle + the SLO-knobbed scheduler.
+
+The scheduler is pure host bookkeeping between compiled steps — it
+never touches device arrays. It owns three decisions per step, each
+behind one :class:`~horovod_tpu.serve.config.ServeConfig` knob:
+
+* **queue order** (``policy``): ``fcfs`` arrival order, or ``sjf``
+  shortest-prompt-first (minimizes mean TTFT under backlog at the cost
+  of long-prompt starvation — the classic SJF trade);
+* **prefill gate** (``slo``): when a NEW prefill may start.
+  ``latency`` starts one whenever the lane is idle and a request is
+  waiting (best TTFT — the chunk steals step time from decode);
+  ``throughput`` only once a decode slot is free to take the finished
+  request (decode slots never share the step with a prefill whose
+  output would just wait); ``balanced`` relaxes to "a slot is free OR
+  a backlog is building";
+* **admission** (``admission``): ``reserve`` grants a request its
+  worst-case pages up front — admitted implies it can always finish —
+  while ``lazy`` grants pages as positions cross page boundaries and
+  evicts (newest-admitted-first) on exhaustion.
+
+Lifecycle (:class:`RequestState`)::
+
+    QUEUED -> PREFILL -> DECODE -> FINISHED
+        \\-> REJECTED      \\-> EVICTED (-> QUEUED again when
+                                         ``requeue_evicted``)
+
+A request that is evicted and requeued carries its generated tokens as
+prompt extension (vLLM's recompute path); greedy decoding makes the
+recomputation bit-identical, and the position-folded sampling keys
+(:mod:`~horovod_tpu.serve.sampling`) make even temperature>0 requests
+resume their exact token stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.kvcache import OutOfPages, PagedKVCache
+
+
+class RequestState:
+    """Lifecycle states (plain str constants — they stamp into JSON)."""
+
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    EVICTED = "evicted"
+    REJECTED = "rejected"
+
+
+_rid_counter = itertools.count()
+
+
+@dataclasses.dataclass(eq=False)   # identity semantics: requests are
+class Request:                     # tracked by `is` in slot lists
+    """One in-flight generation request + its measurement trail.
+
+    ``prompt`` is the CURRENT prompt (original prompt plus any
+    pre-eviction generated tokens on a requeue); ``output`` accumulates
+    every generated token across evictions, so callers always read the
+    full generation off ``output`` regardless of recompute history."""
+
+    prompt: np.ndarray                   # int32 [Lp]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_token: Optional[int] = None
+    seed: int = 0
+    arrival: float = 0.0
+    rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
+
+    state: str = RequestState.QUEUED
+    #: prompt tokens already prefilled (chunk progress).
+    prefill_pos: int = 0
+    #: tokens generated since the last (re)admission.
+    generated: List[int] = dataclasses.field(default_factory=list)
+    #: all tokens generated across evictions — the user-visible output.
+    output: List[int] = dataclasses.field(default_factory=list)
+    #: logical->physical page table, length cache.pages_per_seq,
+    #: 0 (the null page) = unmapped.
+    page_table: Optional[np.ndarray] = None
+    #: physical pages held (the allocator's grant).
+    pages: List[int] = dataclasses.field(default_factory=list)
+    evictions: int = 0
+    #: set by Scheduler.requeue — keeps the head-of-queue priority of
+    #: an evicted request visible to the sjf sort.
+    requeued: bool = False
+    #: original request sizes (requeues mutate prompt/max_new_tokens).
+    orig_prompt_len: int = 0
+    orig_max_new: int = 0
+
+    # -- measurement trail (clock() stamps, engine-filled) ------------
+    t_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if not self.orig_prompt_len:
+            self.orig_prompt_len = int(self.prompt.size)
+        if not self.orig_max_new:
+            self.orig_max_new = int(self.max_new_tokens)
+
+    # ------------------------------------------------------ positions
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.size)
+
+    @property
+    def next_pos(self) -> int:
+        """Absolute cache position the next decode step writes (the
+        position of the token being fed back)."""
+        return self.prompt_len + len(self.generated) - 1
+
+    @property
+    def sample_index(self) -> int:
+        """0-based index (within the FULL generation) of the token the
+        next sample produces — the sampling key's fold position, stable
+        across evictions/recomputes."""
+        return self.orig_prompt_len + len(self.output)
+
+    @property
+    def done_generating(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens
+
+    def hit_eos(self, default_eos: Optional[int]) -> bool:
+        eos = self.eos_token if self.eos_token is not None else default_eos
+        return bool(self.generated) and eos is not None \
+            and self.generated[-1] == eos
+
+
+class Scheduler:
+    """Queue + admission + the prefill gate over one
+    :class:`~horovod_tpu.serve.kvcache.PagedKVCache`."""
+
+    def __init__(self, cache: PagedKVCache, config: ServeConfig):
+        self.cache = cache
+        self.config = config
+        self.queue: List[Request] = []
+        self.rejected: List[Request] = []
+
+    # ------------------------------------------------------ submission
+
+    def submit(self, req: Request) -> bool:
+        """Queue a request; False = hard-rejected (can never run, or
+        the bounded queue is full). Rejection is terminal."""
+        c = self.config
+        if not self.cache.fits(req.prompt_len, req.max_new_tokens) or \
+                (c.max_queue and len(self.queue) >= c.max_queue):
+            req.state = RequestState.REJECTED
+            self.rejected.append(req)
+            return False
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return True
+
+    def requeue(self, req: Request) -> bool:
+        """Re-admit an evicted request: its generated tokens extend the
+        prompt (recompute path) and its budget shrinks accordingly."""
+        if req.generated:
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            req.max_new_tokens -= len(req.generated)
+            req.generated = []
+        req.prefill_pos = 0
+        if req.max_new_tokens < 1:
+            # Nothing left to generate — it was evicted on its last
+            # token; treat as finished (engine stamps the clock).
+            req.state = RequestState.FINISHED
+            return False
+        # Head of the queue, not the tail: an evicted request already
+        # consumed service and holds its requester's latency budget.
+        req.state = RequestState.QUEUED
+        req.requeued = True
+        self.queue.insert(0, req)
+        return True
+
+    # ------------------------------------------------------- ordering
+
+    def _order(self):
+        if self.config.policy == "sjf":
+            # Stable sort: equal keys keep arrival order. Evicted
+            # requeues rank FIRST regardless of prompt length —
+            # their prompt grew by the generated prefix, so a plain
+            # length sort would push them behind every shorter new
+            # arrival and starve them out of the head-of-queue
+            # priority requeue() granted.
+            self.queue.sort(
+                key=lambda r: (0 if r.requeued else 1, r.prompt_len))
+
+    def queued(self) -> int:
+        return len(self.queue)
+
+    # --------------------------------------------------------- gating
+
+    def prefill_gate(self, free_slots: int) -> bool:
+        """May a NEW prefill start this step? (The SLO knob; the lane
+        being idle and the in-flight limit are the caller's checks.)"""
+        slo = self.config.slo
+        if slo == "latency":
+            return True
+        if slo == "throughput":
+            return free_slots > 0
+        return free_slots > 0 or len(self.queue) >= 2   # balanced
+
+    def pick_prefill(self, free_slots: int, in_flight: int) -> \
+            Optional[Request]:
+        """Pop the next request to start prefilling, or None. Applies
+        the in-flight limit, the SLO gate, queue policy, and admission
+        control (reserve mode: the worst case must be allocatable NOW —
+        the queue head WAITS rather than being skipped, preserving the
+        policy order; lazy mode: one page is enough to start)."""
+        if not self.queue or in_flight >= self.config.in_flight_limit \
+                or not self.prefill_gate(free_slots):
+            return None
+        self._order()
+        req = self.queue[0]
+        if not self._admit(req):
+            return None
+        self.queue.pop(0)
+        req.state = RequestState.PREFILL
+        return req
+
+    # ------------------------------------------------------ admission
+
+    def _admit(self, req: Request) -> bool:
+        c = self.config
+        if req.page_table is None:
+            req.page_table = np.zeros(self.cache.pages_per_seq, np.int32)
+        if c.admission == "reserve":
+            need = self.cache.pages_needed(req.prompt_len,
+                                           req.max_new_tokens)
+            if need > self.cache.allocator.available:
+                return False
+            grant = self.cache.allocator.alloc(need)
+            req.pages.extend(grant)
+            req.page_table[:need] = np.asarray(grant, np.int32)
+            return True
+        # lazy: start with the first page only; grow via ensure_pages.
+        if self.cache.allocator.available < 1:
+            return False
+        grant = self.cache.allocator.alloc(1)
+        req.pages.extend(grant)
+        req.page_table[0] = grant[0]
+        return True
+
+    def ensure_pages(self, req: Request, last_pos: int,
+                     evict: Callable[[Request], bool]) -> bool:
+        """Lazy-mode growth: map every page slot up to ``last_pos``.
+        On exhaustion, calls ``evict(requester)`` (the engine frees a
+        victim's pages) until satisfied or evict() gives up. Returns
+        False when the REQUESTER itself must be evicted (evict() chose
+        it / nothing else to evict). Reserve mode: no-op by
+        construction (the table was fully granted at admission)."""
+        need_slot = last_pos // self.cache.config.page_size
+        for slot in range(need_slot + 1):
+            if req.page_table[slot] != 0:
+                continue
+            while True:
+                try:
+                    req.page_table[slot] = page = \
+                        self.cache.allocator.alloc(1)[0]
+                    req.pages.append(page)
+                    break
+                except OutOfPages:
+                    if not evict(req):
+                        return False
+        return True
+
+    # -------------------------------------------------------- release
+
+    def release(self, req: Request) -> None:
+        """Free every page the request holds (finish OR evict)."""
+        if req.pages:
+            self.cache.allocator.free(req.pages)
+            req.pages = []
+        if req.page_table is not None:
+            req.page_table[:] = 0
+
+
+def pick_victim(candidates: Sequence[Request],
+                requester: Request) -> Optional[Request]:
+    """Lazy-mode eviction policy: newest-admitted-first (LIFO over
+    ``t_admit``), never the requester if any other candidate exists —
+    the oldest requests are closest to finishing and have consumed the
+    most recompute-able service, so evicting the newest minimizes
+    wasted work. Returns None when the requester is the only
+    candidate (the engine then evicts the requester itself)."""
+    others = [r for r in candidates if r is not requester]
+    if not others:
+        return None
+    return max(others, key=lambda r: (r.t_admit or 0.0, r.rid))

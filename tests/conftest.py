@@ -69,6 +69,14 @@ _SLOW_TESTS = {
     "test_launcher.py::TestCLI::test_restarts_relaunches_until_success",
     "test_launcher.py::TestCLI::test_restarts_exhausted_returns_failure",
     "test_examples_models.py::TestExamples::test_jax_word2vec_smoke",
+    # Whole-program serving bench wrappers (subprocess, ~15-20s each);
+    # stand-ins: tests/test_serve_engine.py exactness/lifecycle pins
+    # (fast) + the tools/check.sh serve smoke lane runs the contract.
+    "test_serve_bench.py::TestServeBenchContract::test_continuous_record_contract",
+    "test_serve_bench.py::TestServeBenchContract::test_ab_record_carries_both_sides",
+    # ~10s, same subprocess shape; stand-in: the in-process
+    # test_serve_engine.py::TestLifecycle::test_hard_reject_when_never_fits
+    "test_serve_bench.py::TestServeBenchContract::test_require_finished_fails_loudly",
     # Round-4 re-budget (fast lane had crept to 17.9 min): whole-model
     # composition pins whose per-op internals have fast stand-ins.
     # 57s; stand-ins: test_parallel.py TestMoE per-token closed forms

@@ -110,6 +110,9 @@ def test_registry_shape():
         "parallel.ulysses", "parallel.ring_attention", "parallel.moe"}
     elastic = by_group["elastic"]
     assert len(elastic) == 1 and elastic[0].forbid_donation
+    serve = by_group["serve"]
+    assert len(serve) == 1 and serve[0].name == "serve.step"
+    assert serve[0].forbid_donation
     assert all(p.reconcile is not None for p in by_group["optimizer"])
 
 
@@ -211,6 +214,37 @@ def test_elastic_donating_variant_is_flagged(hvd):
                      forbid_donation_why=_ELASTIC_WHY)
     assert [f.rule for f in flagged.findings] == ["HVV104"]
     assert "snapshot" in flagged.findings[0].message
+
+
+def test_serve_step_verifies_and_donating_variant_is_flagged(hvd):
+    """The PR-7 serving invariant: the REAL mixed prefill+decode step
+    (traced exactly as ServeEngine jits it) verifies clean under
+    forbid_donation, and a donate-the-pages variant is an HVV104
+    finding — the KV cache must never be donated while a request
+    holds pages."""
+    import functools
+
+    import jax
+
+    from tools.hvdverify.registry import _SERVE_WHY, _build_serve_step
+
+    fn, args = _build_serve_step()
+    clean = verify(fn, args, name="serve.step", forbid_donation=True,
+                   forbid_donation_why=_SERVE_WHY)
+    assert not clean.findings
+    # Zero collectives today — the schedule is honestly empty, and the
+    # verified property is the donation rule alone.
+    assert clean.summary["count"] == 0
+
+    from horovod_tpu.serve.engine import serve_step
+
+    donating = jax.jit(functools.partial(serve_step, page_size=8),
+                       donate_argnums=(1,))    # donate the pages
+    flagged = verify(lambda p, pages, d, pr: donating(p, pages, d, pr),
+                     args, name="serve-donating", forbid_donation=True,
+                     forbid_donation_why=_SERVE_WHY)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+    assert "pages" in flagged.findings[0].message
 
 
 def test_while_condition_findings_are_merged(hvd):

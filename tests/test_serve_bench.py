@@ -1,0 +1,112 @@
+"""Contract tests for the inference bench lanes: tools/serve_bench.py
+(record shape, --ab, --require-finished) and the decode_bench
+satellite fixes (shared model construction, --steps validation)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = ["--layers", "2", "--d-model", "64", "--heads", "2",
+        "--vocab", "128", "--requests", "6", "--rate", "50",
+        "--prompt-min", "4", "--prompt-max", "12",
+        "--new-min", "2", "--new-max", "6", "--decode-slots", "2",
+        "--prefill-chunk", "4", "--page-size", "8"]
+
+
+def _run(script, *argv, check=True):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *argv],
+        capture_output=True, text=True, env=env, timeout=600)
+    if check:
+        assert p.returncode == 0, p.stderr[-2000:]
+    return p
+
+
+class TestServeBenchContract:
+    def test_continuous_record_contract(self):
+        """The CI smoke lane's contract (tools/check.sh): one JSON
+        line with tokens/s/chip, p50/p99 TTFT, p50/p99 per-token
+        latency, page occupancy — all requests finished and the greedy
+        streams pinned against lm_decode."""
+        p = _run("serve_bench.py", *TINY, "--pin-exact",
+                 "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_continuous_tokens_per_sec_per_chip"
+        assert rec["unit"] == "tokens/sec/chip"
+        assert rec["value"] > 0
+        s = rec["serve"]
+        assert s["by_state"] == {"finished": 6}
+        for key in ("p50", "p99"):
+            assert s["ttft_ms"][key] is not None
+            assert s["tbt_ms"][key] is not None
+        assert 0 < s["pages"]["occupancy_max"] <= 1
+        assert rec["config"]["policy"] == "fcfs"
+
+    def test_ab_record_carries_both_sides(self):
+        p = _run("serve_bench.py", *TINY, "--ab")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_ab_tokens_per_sec_per_chip"
+        ab = rec["serve"]["ab"]
+        assert ab["static"]["tokens_per_sec_per_chip"] > 0
+        assert ab["continuous_over_static"] is not None
+
+    def test_require_finished_fails_loudly(self):
+        # capacity of ONE page (8 positions): several drawn requests
+        # can never fit and hard-reject -> --require-finished exits 1
+        p = _run("serve_bench.py", *TINY, "--num-pages", "2",
+                 "--require-finished", check=False)
+        assert p.returncode != 0
+        assert "not all requests finished" in (p.stderr + p.stdout)
+
+    def test_bad_args_are_argparse_errors(self):
+        for bad in (["--rate", "0"], ["--requests", "0"],
+                    ["--prompt-min", "9", "--prompt-max", "4"]):
+            p = _run("serve_bench.py", *TINY[:-2], *bad, check=False)
+            assert p.returncode == 2, (bad, p.stderr[-300:])
+
+
+class TestDecodeBenchSatellites:
+    def test_steps_zero_is_an_argparse_error(self):
+        """The satellite fix: --steps 0 must die in argparse, not as a
+        downstream scan/shape failure."""
+        p = _run("decode_bench.py", "--steps", "0", check=False)
+        assert p.returncode == 2
+        assert "--steps must be >= 1" in p.stderr
+
+    def test_negative_iters_rejected(self):
+        p = _run("decode_bench.py", "--iters", "0", check=False)
+        assert p.returncode == 2
+
+    def test_shared_builder_shapes(self):
+        """decode_bench and serve_bench build the SAME model through
+        tools.lm_common (the A/B precondition)."""
+        import argparse
+
+        from tools.lm_common import (add_model_args, build_params,
+                                     validate_model_args)
+
+        ap = argparse.ArgumentParser()
+        add_model_args(ap)
+        args = ap.parse_args(["--layers", "2", "--d-model", "64",
+                              "--heads", "2", "--vocab", "128"])
+        validate_model_args(ap, args)
+        params = build_params(args, max_len=32)
+        assert len(params["layers"]) == 2
+        assert params["embed"].shape == (128, 64)
+        assert params["pos"].shape == (32, 64)
+        assert params["layers"][0]["wqkv"].shape == (64, 3, 2, 32)
+        assert params["layers"][0]["wup"].shape == (64, 256)
+
+    def test_d_model_heads_divisibility_error(self):
+        p = _run("decode_bench.py", "--d-model", "100", "--heads", "12",
+                 check=False)
+        assert p.returncode == 2
+        assert "divisible" in p.stderr

@@ -1,0 +1,285 @@
+"""Continuous-batching engine exactness + lifecycle
+(horovod_tpu/serve/engine.py).
+
+The acceptance pin: N requests through the continuous-batching engine
+produce BIT-IDENTICAL greedy tokens to N independent ``lm_decode``
+calls — across staggered joins, chunked prefill at awkward sizes,
+page-pressure evictions (recompute path), and EOS early exit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import parallel_lm as plm
+from horovod_tpu.serve import ServeConfig, ServeEngine
+
+V, LMAX, LAYERS, H, DH, FFN = 64, 64, 2, 2, 8, 32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX, LAYERS, H,
+                              DH, FFN)
+
+
+def _prompt(i, lp):
+    key = jax.random.fold_in(jax.random.PRNGKey(100), i)
+    return np.asarray(jax.random.randint(key, (lp,), 0, V), np.int32)
+
+
+def _ref(params, prompt, steps):
+    """The decode lane's greedy stream — the engine's ground truth."""
+    return list(np.asarray(
+        plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
+
+
+class TestGreedyExactness:
+    def test_single_request_matches_lm_decode(self, params):
+        prompt = _prompt(0, 7)
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4))
+        req = eng.submit(prompt, 9)
+        eng.run()
+        assert req.state == "finished"
+        assert req.output == _ref(params, prompt, 9)
+
+    @pytest.mark.parametrize("chunk", [1, 3, 4, 16])
+    def test_chunked_prefill_is_chunk_invariant(self, params, chunk):
+        """Any prefill chunking (1-token, non-divisible, whole-prompt)
+        yields the identical stream — the rectangular-causal chunk
+        rows reproduce lm_prefill's rows exactly."""
+        prompt = _prompt(1, 11)
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=1,
+            prefill_chunk=chunk))
+        req = eng.submit(prompt, 5)
+        eng.run()
+        assert req.output == _ref(params, prompt, 5)
+
+    def test_staggered_joins_bit_identical(self, params):
+        """The acceptance pin: requests join the running batch at
+        different steps; every greedy stream must equal its own
+        independent lm_decode call."""
+        spec = [(5, 6), (9, 4), (3, 12), (13, 3), (7, 1), (4, 8)]
+        prompts = [_prompt(10 + i, lp) for i, (lp, _) in enumerate(spec)]
+        refs = [_ref(params, p, n)
+                for p, (_, n) in zip(prompts, spec)]
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=40, decode_slots=2, prefill_chunk=4))
+        reqs = [eng.submit(prompts[0], spec[0][1]),
+                eng.submit(prompts[1], spec[1][1])]
+        for _ in range(3):
+            eng.step()
+        reqs += [eng.submit(prompts[2], spec[2][1]),
+                 eng.submit(prompts[3], spec[3][1])]
+        for _ in range(2):
+            eng.step()
+        reqs += [eng.submit(prompts[4], spec[4][1]),
+                 eng.submit(prompts[5], spec[5][1])]
+        eng.run()
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+
+    def test_eviction_recompute_stays_exact(self, params):
+        """Lazy admission under page pressure: requests get evicted,
+        requeued with their generated prefix, re-prefilled — and the
+        final streams are still bit-identical to lm_decode."""
+        spec = [(9, 10), (11, 8), (10, 9)]
+        prompts = [_prompt(30 + i, lp) for i, (lp, _) in enumerate(spec)]
+        refs = [_ref(params, p, n) for p, (_, n) in zip(prompts, spec)]
+        eng = ServeEngine(params, ServeConfig(
+            page_size=4, num_pages=8, decode_slots=2, prefill_chunk=4,
+            admission="lazy"))
+        reqs = [eng.submit(p, n) for p, (_, n) in zip(prompts, spec)]
+        eng.run(max_steps=500)
+        assert sum(r.evictions for r in reqs) > 0, \
+            "test must exercise the eviction path"
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+
+    def test_max_new_tokens_one_finishes_at_prefill(self, params):
+        prompt = _prompt(2, 6)
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8))
+        req = eng.submit(prompt, 1)
+        eng.run()
+        assert req.state == "finished"
+        assert req.output == _ref(params, prompt, 1)
+        assert req.t_first_token is not None
+
+
+class TestLifecycle:
+    def test_eos_stops_early(self, params):
+        prompt = _prompt(3, 6)
+        full = _ref(params, prompt, 8)
+        eos = full[2]   # declare a mid-stream greedy token the EOS
+        stop = full.index(eos) + 1           # first occurrence wins
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8))
+        req = eng.submit(prompt, 8, eos_token=eos)
+        eng.run()
+        assert req.state == "finished"
+        assert req.output == full[:stop]     # EOS token included
+
+    def test_hard_reject_when_never_fits(self, params):
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=4, decode_slots=1, prefill_chunk=4))
+        req = eng.submit(np.arange(40, dtype=np.int32) % V, 30)
+        assert req.state == "rejected"
+        assert not eng.step()
+
+    def test_bounded_queue_rejects_overflow(self, params):
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=1, prefill_chunk=4,
+            max_queue=2))
+        reqs = [eng.submit(_prompt(4, 5), 2) for _ in range(3)]
+        assert [r.state for r in reqs] == ["queued", "queued",
+                                          "rejected"]
+
+    def test_no_donation_pages_stay_valid(self, params):
+        """The HVV104-class invariant: the step must not donate the
+        page arrays — the PRE-step pages object stays readable after
+        the step ran (a donated buffer would raise on use)."""
+        prompt = _prompt(5, 6)
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=4))
+        eng.submit(prompt, 3)
+        before = eng.cache.pages
+        eng.step()
+        # touching the old buffers must not raise (nothing was donated)
+        _ = [np.asarray(p["k"]).sum() for p in before]
+
+    def test_late_promoted_request_gets_page_mapped(self, params):
+        """Lazy admission: a request promoted by the post-eviction
+        promote pass must still get its fresh page slot mapped before
+        the compiled step runs — an unmapped (0) table entry would
+        write its KV row into the reserved null page and silently
+        corrupt the stream. White-box state: slots [A, C] full, B
+        ready with its next write position starting an unmapped page,
+        pool exhausted; A's page demand evicts C (newest t_admit),
+        freeing the slot B is promoted into mid-step."""
+        from horovod_tpu.serve.scheduler import Request, RequestState
+
+        cfg = ServeConfig(page_size=4, num_pages=8, decode_slots=2,
+                          prefill_chunk=4, admission="lazy")
+        eng = ServeEngine(params, cfg)
+        alloc = eng.cache.allocator
+        pps = eng.cache.pages_per_seq
+
+        def mk(lp, t_admit, n_pages):
+            req = Request(prompt=np.arange(lp, dtype=np.int32) % V,
+                          max_new_tokens=8)
+            req.state = RequestState.DECODE
+            req.generated = [1]
+            req.output = [1]
+            req.t_admit = t_admit
+            req.page_table = np.zeros(pps, np.int32)
+            req.pages = alloc.alloc(n_pages)
+            req.page_table[:n_pages] = req.pages
+            return req
+
+        a = mk(4, 0.5, 1)   # next_pos 4 -> needs unmapped page slot 1
+        c = mk(7, 0.9, 2)   # newest-admitted: the eviction victim
+        b = mk(4, 0.8, 1)   # ready; next_pos 4 -> page slot 1 unmapped
+        b.prefill_pos = 4
+        eng.slots = [a, c]
+        eng.ready = [b]
+        hog = alloc.alloc(alloc.available)   # pool exhausted
+        assert alloc.available == 0 and hog
+
+        assert eng.step()
+        assert c.evictions == 1              # the slot B was given
+        assert b in eng.slots
+        assert b.page_table[1] != 0, \
+            "late-promoted slot reached the compiled step unmapped"
+        assert a.page_table[1] != 0
+
+    def test_engine_reports_compiled_once(self, params):
+        """Join/leave across steps never recompiles: steps with
+        different active-slot patterns reuse the two step programs."""
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=40, decode_slots=2, prefill_chunk=4))
+        for i in range(4):
+            eng.submit(_prompt(40 + i, 3 + i), 3 + i)
+        eng.run()
+        if not hasattr(eng._step_mixed, "_cache_size"):
+            pytest.skip("no jit cache introspection on this jax")
+        mixed = eng._step_mixed._cache_size()
+        decode = eng._step_decode._cache_size()
+        assert mixed <= 1 and decode <= 1 and mixed + decode >= 1
+
+
+class TestSampling:
+    def test_temperature_topk_deterministic_and_in_range(self, params):
+        prompt = _prompt(6, 5)
+        cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                          prefill_chunk=4)
+        outs = []
+        for _ in range(2):
+            eng = ServeEngine(params, cfg)
+            req = eng.submit(prompt, 6, temperature=0.8, top_k=8,
+                             seed=42)
+            eng.run()
+            assert req.state == "finished"
+            outs.append(req.output)
+        assert outs[0] == outs[1]
+        assert all(0 <= t < V for t in outs[0])
+
+    def test_greedy_rows_unaffected_by_sampling_neighbors(self, params):
+        """A greedy request sharing steps with a temperature request
+        stays bit-identical to lm_decode (per-slot sampling knobs)."""
+        pg, ps = _prompt(7, 6), _prompt(8, 6)
+        ref = _ref(params, pg, 6)
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4))
+        rg = eng.submit(pg, 6)
+        rs = eng.submit(ps, 6, temperature=1.2, top_k=4, seed=9)
+        eng.run()
+        assert rg.output == ref
+        assert all(0 <= t < V for t in rs.output)
+
+    def test_sampler_unit(self):
+        from horovod_tpu.serve.sampling import sample_tokens
+
+        logits = np.zeros((3, 8), np.float32)
+        logits[0, 5] = 3.0          # greedy row
+        logits[1] = np.arange(8)    # top-k row
+        logits[2, 2] = 9.0
+        toks = np.asarray(sample_tokens(
+            jnp.asarray(logits),
+            np.asarray([0.0, 0.7, 0.0], np.float32),
+            np.asarray([0, 2, 0], np.int32),
+            np.asarray([1, 1, 1], np.int32),
+            np.asarray([0, 0, 0], np.int32)))
+        assert toks[0] == 5 and toks[2] == 2
+        assert toks[1] in (6, 7)    # top-2 of the ramp
+
+
+class TestStats:
+    def test_stats_shape_and_monotone_clock(self, params):
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.25
+            return t[0]
+
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4),
+            clock=clock)
+        reqs = [eng.submit(_prompt(50 + i, 4 + i), 4) for i in range(3)]
+        eng.run()
+        s = eng.stats()
+        assert s["by_state"] == {"finished": 3}
+        assert s["generated_tokens"] == 12
+        assert s["ttft_ms"]["p50"] is not None
+        assert s["ttft_ms"]["p99"] >= s["ttft_ms"]["p50"]
+        assert s["tbt_ms"]["p50"] is not None
+        assert 0 < s["pages"]["occupancy_max"] <= 1
+        for r in reqs:
+            assert r.t_first_token is not None
+            assert r.t_admit is not None      # eviction order keys on it
+            assert r.t_finish >= r.t_first_token >= r.arrival
+            assert len(r.token_times) == len(r.output)

@@ -1,0 +1,199 @@
+"""Paged KV cache property tests (horovod_tpu/serve/kvcache.py):
+free-list allocator invariants under randomized alloc/free churn,
+page-math contracts, admission control, and ServeConfig validation."""
+
+import random
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serve import OutOfPages, PageAllocator, ServeConfig
+from horovod_tpu.serve.config import ADMISSIONS, POLICIES, SLO_MODES
+
+
+class TestAllocator:
+    def test_capacity_excludes_reserved(self):
+        a = PageAllocator(16, reserved=1)
+        assert a.capacity == 15
+        assert a.available == 15
+        assert a.in_use == 0
+
+    def test_alloc_free_roundtrip(self):
+        a = PageAllocator(8)
+        grant = a.alloc(5)
+        assert len(grant) == len(set(grant)) == 5
+        assert all(1 <= p < 8 for p in grant)   # never the null page
+        assert a.in_use == 5 and a.available == 2
+        a.free(grant)
+        assert a.in_use == 0 and a.available == 7
+
+    def test_all_or_nothing_exhaustion(self):
+        a = PageAllocator(8)
+        a.alloc(4)
+        with pytest.raises(OutOfPages):
+            a.alloc(4)      # only 3 free
+        # nothing was taken by the failed grant
+        assert a.available == 3
+        assert len(a.alloc(3)) == 3
+
+    def test_double_free_rejected(self):
+        a = PageAllocator(8)
+        g = a.alloc(2)
+        a.free(g)
+        with pytest.raises(ValueError):
+            a.free([g[0]])
+
+    def test_null_page_free_rejected(self):
+        a = PageAllocator(8)
+        with pytest.raises(ValueError):
+            a.free([0])
+
+    def test_lifo_reuse_keeps_working_set_small(self):
+        a = PageAllocator(16)
+        g1 = a.alloc(3)
+        a.free(g1)
+        g2 = a.alloc(3)
+        # recently-freed pages come back first
+        assert set(g2) == set(g1)
+
+    def test_churn_property(self):
+        """Randomized alloc/free interleaving: conservation (in_use +
+        available == capacity), uniqueness of live pages, and zero
+        external fragmentation (any n <= available always succeeds —
+        fixed-size pages cannot fragment)."""
+        rng = random.Random(7)
+        a = PageAllocator(64)
+        live = []
+        for _ in range(500):
+            if live and rng.random() < 0.45:
+                grant = live.pop(rng.randrange(len(live)))
+                a.free(grant)
+            else:
+                n = rng.randint(1, 6)
+                if n > a.available:
+                    with pytest.raises(OutOfPages):
+                        a.alloc(n)
+                else:
+                    live.append(a.alloc(n))
+            flat = [p for g in live for p in g]
+            assert len(flat) == len(set(flat))
+            assert a.in_use == len(flat)
+            assert a.in_use + a.available == a.capacity
+        # drain: everything comes back
+        for g in live:
+            a.free(g)
+        assert a.available == a.capacity
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PageAllocator(1)            # nothing allocatable
+        a = PageAllocator(4)
+        with pytest.raises(ValueError):
+            a.alloc(-1)
+
+
+@pytest.fixture(scope="module")
+def cache():
+    import jax
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache
+
+    params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1, 2, 4, 8)
+    cfg = ServeConfig(page_size=8, num_pages=9)   # capacity 8 pages
+    return PagedKVCache(params, cfg)
+
+
+class TestPagedKVCache:
+    def test_layout_off_the_params(self, cache):
+        assert cache.max_len == 32
+        assert cache.pages_per_seq == 4
+        assert cache.num_layers == 1
+        assert cache.num_heads == 2 and cache.head_dim == 4
+        assert cache.pages[0]["k"].shape == (9, 8, 2, 4)
+
+    def test_page_size_must_divide_lmax(self):
+        import jax
+
+        from horovod_tpu.models import parallel_lm as plm
+        from horovod_tpu.serve import PagedKVCache
+
+        params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 30, 1, 2,
+                                    4, 8)
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            PagedKVCache(params, ServeConfig(page_size=8, num_pages=9))
+
+    def test_pages_needed_math(self, cache):
+        # positions written: 0..prompt+new-2 -> ceil((p+n-1)/ps)
+        assert cache.pages_needed(1, 1) == 1
+        assert cache.pages_needed(8, 1) == 1     # last pos 7, one page
+        assert cache.pages_needed(8, 2) == 2     # last pos 8 crosses
+        assert cache.pages_needed(16, 9) == 3
+
+    def test_fits_is_the_hard_bound(self, cache):
+        assert cache.fits(16, 16)                # == Lmax
+        assert not cache.fits(16, 17)            # position bound
+        assert not cache.fits(0, 4)
+        assert not cache.fits(4, 0)
+
+    def test_admission_tracks_free_pages(self, cache):
+        assert cache.can_admit(16, 9)            # 3 pages, 8 free
+        held = cache.allocator.alloc(6)
+        assert not cache.can_admit(16, 9)        # 3 needed, 2 free
+        assert cache.can_admit(8, 1)
+        cache.allocator.free(held)
+
+    def test_occupancy_stats(self, cache):
+        assert cache.occupancy() == 0.0
+        held = cache.allocator.alloc(4)
+        s = cache.stats()
+        assert s["pages_in_use"] == 4 and s["pages_free"] == 4
+        assert s["occupancy"] == 0.5
+        cache.allocator.free(held)
+
+    def test_abstract_twin(self):
+        """abstract=True builds ShapeDtypeStruct pages — what the
+        hvdverify registry traces (no allocation)."""
+        import jax
+
+        from horovod_tpu.models import parallel_lm as plm
+        from horovod_tpu.serve import PagedKVCache
+
+        params = jax.eval_shape(
+            lambda: plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1,
+                                       2, 4, 8))
+        c = PagedKVCache(params, ServeConfig(page_size=8, num_pages=9),
+                         abstract=True)
+        assert isinstance(c.pages[0]["k"], jax.ShapeDtypeStruct)
+
+
+class TestServeConfig:
+    def test_defaults_validate(self):
+        c = ServeConfig()
+        assert c.in_flight_limit == c.decode_slots + 1
+
+    def test_max_in_flight_override(self):
+        assert ServeConfig(max_in_flight=3).in_flight_limit == 3
+
+    @pytest.mark.parametrize("kw", [
+        {"page_size": 0}, {"num_pages": 1}, {"decode_slots": 0},
+        {"prefill_chunk": 0}, {"policy": "lifo"}, {"slo": "fastest"},
+        {"admission": "eager"},
+    ])
+    def test_rejects_bad_knobs(self, kw):
+        with pytest.raises(ValueError):
+            ServeConfig(**kw)
+
+    def test_knob_tables_are_closed(self):
+        assert POLICIES == ("fcfs", "sjf")
+        assert SLO_MODES == ("latency", "balanced", "throughput")
+        assert ADMISSIONS == ("reserve", "lazy")
+
+
+def test_request_validation():
+    from horovod_tpu.serve import Request
+
+    with pytest.raises(ValueError):
+        Request(prompt=np.zeros((0,), np.int32), max_new_tokens=1)
+    with pytest.raises(ValueError):
+        Request(prompt=np.zeros((3,), np.int32), max_new_tokens=0)

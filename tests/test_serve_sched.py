@@ -1,0 +1,218 @@
+"""Scheduler policy/gate/eviction unit tests + metrics aggregation
+(horovod_tpu/serve/{scheduler,metrics}.py) — host bookkeeping only, no
+model in the loop (tests/test_serve_engine.py covers the composed
+paths)."""
+
+import jax
+import numpy as np
+import pytest
+
+from horovod_tpu.models import parallel_lm as plm
+from horovod_tpu.serve import (
+    PagedKVCache,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    Scheduler,
+)
+from horovod_tpu.serve.metrics import percentile, summarize
+from horovod_tpu.serve.scheduler import pick_victim
+
+
+def _cache(cfg):
+    params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1, 2, 4,
+                                8)
+    return PagedKVCache(params, cfg)
+
+
+def _req(lp=4, n=4, **kw):
+    return Request(prompt=np.zeros((lp,), np.int32), max_new_tokens=n,
+                   **kw)
+
+
+class TestQueuePolicy:
+    def test_fcfs_keeps_arrival_order(self):
+        cfg = ServeConfig(page_size=8, num_pages=16, policy="fcfs")
+        s = Scheduler(_cache(cfg), cfg)
+        a, b = _req(lp=12), _req(lp=2)
+        s.submit(a), s.submit(b)
+        assert s.pick_prefill(free_slots=1, in_flight=0) is a
+
+    def test_sjf_prefers_short_prompts(self):
+        cfg = ServeConfig(page_size=8, num_pages=16, policy="sjf")
+        s = Scheduler(_cache(cfg), cfg)
+        a, b, c = _req(lp=12), _req(lp=2), _req(lp=2)
+        s.submit(a), s.submit(b), s.submit(c)
+        assert s.pick_prefill(1, 0) is b    # stable: b before c
+        assert s.pick_prefill(1, 0) is c
+        assert s.pick_prefill(1, 0) is a
+
+    def test_sjf_never_starves_evicted_requeues(self):
+        """requeue()'s head-of-queue priority must survive the sjf
+        sort: the evicted request's prompt GREW by its generated
+        prefix, so a plain length sort would push it behind every
+        shorter new arrival forever."""
+        cfg = ServeConfig(page_size=8, num_pages=32, policy="sjf")
+        s = Scheduler(_cache(cfg), cfg)
+        evicted = _req(lp=10, n=6)
+        evicted.generated = [1, 2]
+        evicted.output = [1, 2]
+        s.requeue(evicted)                  # now 12 tokens of prompt
+        short = _req(lp=2)
+        s.submit(short)
+        assert s.pick_prefill(1, 0) is evicted
+        assert s.pick_prefill(1, 0) is short
+
+
+class TestGates:
+    @pytest.mark.parametrize("slo,free,queued,want", [
+        ("latency", 0, 1, True),
+        ("throughput", 0, 1, False),
+        ("throughput", 1, 1, True),
+        ("balanced", 0, 1, False),
+        ("balanced", 0, 2, True),     # backlog overrides
+        ("balanced", 1, 1, True),
+    ])
+    def test_slo_gate_truth_table(self, slo, free, queued, want):
+        cfg = ServeConfig(page_size=8, num_pages=32, slo=slo)
+        s = Scheduler(_cache(cfg), cfg)
+        for _ in range(queued):
+            s.submit(_req())
+        assert s.prefill_gate(free) is want
+        got = s.pick_prefill(free, in_flight=0)
+        assert (got is not None) is want
+
+    def test_in_flight_limit_blocks_admission(self):
+        cfg = ServeConfig(page_size=8, num_pages=32, decode_slots=2)
+        s = Scheduler(_cache(cfg), cfg)
+        s.submit(_req())
+        assert s.pick_prefill(1, in_flight=cfg.in_flight_limit) is None
+        assert s.pick_prefill(1, in_flight=0) is not None
+
+
+class TestAdmission:
+    def test_reserve_grants_worst_case_up_front(self):
+        cfg = ServeConfig(page_size=8, num_pages=16)   # capacity 15
+        c = _cache(cfg)
+        s = Scheduler(c, cfg)
+        r = _req(lp=8, n=9)                 # positions 16 -> 2 pages
+        s.submit(r)
+        assert s.pick_prefill(1, 0) is r
+        assert c.allocator.in_use == 2
+        assert np.count_nonzero(r.page_table) == 2
+
+    def test_reserve_head_waits_rather_than_skips(self):
+        """Admission failure keeps the queue head in place (no
+        starvation-by-skip): nothing is admitted until pages free."""
+        cfg = ServeConfig(page_size=8, num_pages=4)    # capacity 3
+        c = _cache(cfg)
+        s = Scheduler(c, cfg)
+        held = c.allocator.alloc(2)
+        big, small = _req(lp=8, n=9), _req(lp=2, n=2)
+        s.submit(big), s.submit(small)
+        assert s.pick_prefill(1, 0) is None     # big needs 2, 1 free
+        c.allocator.free(held)
+        assert s.pick_prefill(1, 0) is big
+
+    def test_lazy_starts_with_one_page_and_grows(self):
+        cfg = ServeConfig(page_size=8, num_pages=16, admission="lazy")
+        c = _cache(cfg)
+        s = Scheduler(c, cfg)
+        r = _req(lp=8, n=17)                # would need 4 pages reserved
+        s.submit(r)
+        assert s.pick_prefill(1, 0) is r
+        assert c.allocator.in_use == 1
+        assert s.ensure_pages(r, last_pos=23, evict=lambda _: False)
+        assert c.allocator.in_use == 3
+
+    def test_release_returns_everything(self):
+        cfg = ServeConfig(page_size=8, num_pages=16)
+        c = _cache(cfg)
+        s = Scheduler(c, cfg)
+        r = _req(lp=8, n=9)
+        s.submit(r)
+        s.pick_prefill(1, 0)
+        s.release(r)
+        assert c.allocator.in_use == 0
+        assert not r.pages and np.count_nonzero(r.page_table) == 0
+
+
+class TestEviction:
+    def test_victim_is_newest_never_requester(self):
+        a, b, c = (_req(), _req(), _req())
+        a.t_admit, b.t_admit, c.t_admit = 1.0, 3.0, 2.0
+        assert pick_victim([a, b, c], requester=a) is b
+        assert pick_victim([a, b, c], requester=b) is c
+        assert pick_victim([a], requester=a) is None
+
+    def test_requeue_extends_prompt_and_shrinks_budget(self):
+        cfg = ServeConfig(page_size=8, num_pages=16)
+        s = Scheduler(_cache(cfg), cfg)
+        r = _req(lp=4, n=6)
+        r.generated = [7, 9]
+        r.output = [7, 9]
+        assert s.requeue(r)
+        assert r.prompt_len == 6 and list(r.prompt[-2:]) == [7, 9]
+        assert r.max_new_tokens == 4 and r.generated == []
+        assert r.state == "queued" and s.queue[0] is r
+        # sample_index keeps counting the FULL stream
+        assert r.sample_index == 4 + 2
+
+    def test_requeue_with_nothing_left_reports_finished(self):
+        cfg = ServeConfig(page_size=8, num_pages=16)
+        s = Scheduler(_cache(cfg), cfg)
+        r = _req(lp=4, n=2)
+        r.generated = [1, 2]
+        assert not s.requeue(r)
+        assert r.state == "finished"
+
+    def test_requeue_off_is_terminal(self):
+        params = plm.init_lm_params(jax.random.PRNGKey(0), 64, 64, 1, 2,
+                                    8, 32)
+        cfg = ServeConfig(page_size=4, num_pages=8, decode_slots=2,
+                          prefill_chunk=4, admission="lazy",
+                          requeue_evicted=False)
+        eng = ServeEngine(params, cfg)
+        key = jax.random.PRNGKey(5)
+        reqs = [eng.submit(
+            np.asarray(jax.random.randint(
+                jax.random.fold_in(key, i), (9,), 0, 64)), 10)
+            for i in range(3)]
+        eng.run(max_steps=300)
+        states = {r.state for r in reqs}
+        assert "evicted" in states
+        assert eng.evicted and all(r.pages == [] for r in eng.evicted)
+
+
+class TestMetrics:
+    def test_percentile_nearest_rank(self):
+        xs = [10.0, 20.0, 30.0, 40.0]
+        assert percentile(xs, 50) == 20.0
+        assert percentile(xs, 99) == 40.0
+        assert percentile(xs, 100) == 40.0
+        assert percentile([], 50) is None
+        assert percentile([5.0], 99) == 5.0      # always a real sample
+
+    def test_summarize_contract(self):
+        r = _req(lp=4, n=3)
+        r.arrival = 1.0
+        r.t_first_token = 1.5
+        r.token_times = [1.5, 1.7, 2.0]
+        r.output = [1, 2, 3]
+        r.state = "finished"
+        s = summarize([r], wall_s=2.0, chips=2,
+                      occupancy_samples=[0.25, 0.75])
+        assert s["generated_tokens"] == 3
+        assert s["tokens_per_sec_per_chip"] == 0.8      # 3/2.0/2
+        assert s["ttft_ms"]["p50"] == 500.0
+        # gaps: 200ms, 300ms
+        assert s["tbt_ms"]["p50"] == 200.0
+        assert s["tbt_ms"]["p99"] == 300.0
+        assert s["pages"]["occupancy_mean"] == 0.5
+        assert s["pages"]["occupancy_max"] == 0.75
+
+    def test_summarize_empty(self):
+        s = summarize([], wall_s=1.0)
+        assert s["requests"] == 0
+        assert s["ttft_ms"]["p50"] is None
+        assert s["pages"]["occupancy_mean"] is None

@@ -13,11 +13,17 @@
 #                               `hvdrun --elastic` job loses rank 1 to a
 #                               HOROVOD_FAULT_PLAN SIGKILL mid-run and
 #                               must finish bit-exact after the relaunch)
+#                               + the serving smoke (tools/serve_bench.py:
+#                               8 Poisson requests through the
+#                               continuous-batching engine on CPU — all
+#                               must finish, TTFT stats must stamp, and
+#                               greedy output must equal lm_decode)
 #   tools/check.sh --verify     additionally run the FULL hvdverify sweep
 #                               (`python -m tools.hvdverify --sweep`): all
 #                               registry programs incl. the 9 driver gate
 #                               lanes traced at zero unsuppressed findings
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
+#   tools/check.sh --no-serve   skip the serving smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
 #                               the self-building loader) and run the
@@ -29,13 +35,15 @@ cd "$(dirname "$0")/.."
 
 SANITIZE=0
 ELASTIC=1
+SERVE=1
 VERIFY=0
 for arg in "$@"; do
   case "$arg" in
     --sanitize) SANITIZE=1 ;;
     --no-elastic) ELASTIC=0 ;;
+    --no-serve) SERVE=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -57,6 +65,27 @@ if [[ "$ELASTIC" == "1" ]]; then
   echo "== elastic fault-injection smoke (kill rank 1, relaunch, bit-exact) =="
   python -m pytest tests/test_elastic.py::TestEndToEnd -q \
     -p no:cacheprovider -m 'not slow'
+fi
+
+if [[ "$SERVE" == "1" ]]; then
+  echo "== serving smoke (8 Poisson requests, CPU: all finish, TTFT stamped, greedy == lm_decode) =="
+  SERVE_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --pin-exact --require-finished)
+  echo "$SERVE_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+assert s["ttft_ms"]["p50"] is not None and s["ttft_ms"]["p99"] is not None
+assert s["tbt_ms"]["p50"] is not None
+assert s["pages"]["occupancy_max"] is not None
+t = s["ttft_ms"]
+print("serve smoke: all 8 finished, TTFT p50/p99 = %s/%s ms"
+      % (t["p50"], t["p99"]))
+'
 fi
 
 if [[ "$SANITIZE" == "1" ]]; then

@@ -10,8 +10,13 @@ record shape; obeys the axon sync trap (utils/devsync.py).
 """
 import argparse
 import json
+import os
 import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:   # `python tools/decode_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
 
 import jax
 import jax.numpy as jnp
@@ -19,10 +24,10 @@ import jax.numpy as jnp
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--layers", type=int, default=12)
-    ap.add_argument("--d-model", type=int, default=768)
-    ap.add_argument("--heads", type=int, default=12)
-    ap.add_argument("--vocab", type=int, default=32000)
+    from tools.lm_common import (add_model_args, build_params,
+                                 validate_model_args)
+
+    add_model_args(ap)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--steps", type=int, default=256)
@@ -32,15 +37,16 @@ def main() -> int:
     from horovod_tpu.models import parallel_lm as plm
     from horovod_tpu.utils.devsync import force_device_sync
 
-    if args.d_model % args.heads:
-        ap.error(f"--d-model {args.d_model} must be divisible by "
-                 f"--heads {args.heads}")
-    head_dim = args.d_model // args.heads
+    validate_model_args(ap, args)
+    if args.steps < 1:
+        ap.error(f"--steps must be >= 1, got {args.steps} (0 would "
+                 "surface later as a scan/position-table shape error)")
+    if args.prompt_len < 1 or args.batch < 1 or args.iters < 1:
+        ap.error("--prompt-len, --batch and --iters must be >= 1")
     lmax = args.prompt_len + args.steps
-    rng = jax.random.PRNGKey(0)
-    params = plm.init_lm_params(rng, args.vocab, lmax, args.layers,
-                                args.heads, head_dim, 4 * args.d_model)
-    prompt = jax.random.randint(jax.random.fold_in(rng, 1),
+    params = build_params(args, lmax)
+    prompt = jax.random.randint(jax.random.fold_in(jax.random.PRNGKey(0),
+                                                   1),
                                 (args.batch, args.prompt_len), 0,
                                 args.vocab)
 

@@ -22,6 +22,10 @@ structure). Groups:
 * ``elastic``  — the PR-5 windowed loop program with the
                  no-donation-while-snapshot-in-flight invariant
                  enforced (``forbid_donation``).
+* ``serve``    — the serving engine's mixed prefill+decode step
+                 (horovod_tpu/serve/engine.py) with the
+                 pages-never-donated-while-held invariant enforced
+                 (``forbid_donation`` — the HVV104 class again).
 
 Abstract state comes from ``jax.eval_shape`` over the real init
 functions — zero FLOPs, no devices, runs on CPU anywhere (the same
@@ -510,6 +514,55 @@ def _build_elastic_windowed_loop():
     return (lambda s, b: window_fn(s, b)), (state, batch)
 
 
+# ---------------------------------------------------------------- serve
+
+
+_SERVE_WHY = ("the paged KV cache must never be donated while a request "
+              "holds pages — an in-flight step reads every live "
+              "request's pages, and the host keeps the pre-step arrays "
+              "referenced (the elastic HVV104 invariant class, serving "
+              "edition)")
+
+
+def _build_serve_step():
+    """The serving engine's MIXED prefill+decode step program exactly
+    as ServeEngine jits it (horovod_tpu/serve/engine.py::serve_step):
+    decode slots + the chunked-prefill lane over the paged KV arrays,
+    traced on PagedKVCache's abstract twin. No collectives today (the
+    single-chip engine; LogicalMesh sharding is ROADMAP item 2) — the
+    verified property is the donation rule."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step
+
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), 64, 32, 2, 2,
+                                   8, 32))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S, C = cfg.decode_slots, cfg.prefill_chunk
+    sds = jax.ShapeDtypeStruct
+    dec = {"tok": sds((S,), jnp.int32), "pos": sds((S,), jnp.int32),
+           "active": sds((S,), jnp.bool_),
+           "tables": sds((S, pps), jnp.int32)}
+    pre = {"tokens": sds((C,), jnp.int32), "start": sds((), jnp.int32),
+           "length": sds((), jnp.int32),
+           "table": sds((pps,), jnp.int32)}
+    # jax.jit WITHOUT donation — ServeEngine's exact spelling; a
+    # donate_argnums variant is the HVV104 regression test's job.
+    fn = jax.jit(functools.partial(serve_step,
+                                   page_size=cfg.page_size))
+    return (lambda p, pages, d, pr: fn(p, pages, d, pr)), \
+        (params, cache.pages, dec, pre)
+
+
 # -------------------------------------------------------------- registry
 
 
@@ -566,6 +619,13 @@ def _make_registry() -> List[Program]:
         forbid_donation=True,
         forbid_donation_why=_ELASTIC_WHY))
 
+    # The serving engine's compiled step + its page-donation invariant.
+    progs.append(Program(
+        "serve.step", "serve",
+        lambda: _build_serve_step(),
+        forbid_donation=True,
+        forbid_donation_why=_SERVE_WHY))
+
     return progs
 
 
@@ -574,7 +634,7 @@ REGISTRY: List[Program] = _make_registry()
 #: Programs cheap enough for the fast (tier-1) sweep pin: everything
 #: except the big-model gate lanes, whose tracing cost belongs to the
 #: full-suite / check.sh --verify gate.
-FAST_GROUPS = ("optimizer", "parallel", "elastic")
+FAST_GROUPS = ("optimizer", "parallel", "elastic", "serve")
 
 
 def programs(groups=None, names=None) -> List[Program]:

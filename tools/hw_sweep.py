@@ -64,6 +64,22 @@ LANES = [
     # Inference lane (beyond the reference, docs/inference.md): greedy
     # KV-cache decode throughput of the packaged LM.
     ("transformer_lm_decode", ["tools/decode_bench.py"]),
+    # Serving lanes (round-8 tentpole, horovod_tpu/serve/ +
+    # docs/serving.md), adjacent to the decode lane so the single-batch
+    # baseline and the engine share chip condition. serve_poisson:
+    # the continuous-batching engine under open-loop Poisson load
+    # (tokens/s/chip + p50/p99 TTFT + p50/p99 per-token latency +
+    # page occupancy in one record). serve_static_ab: continuous vs
+    # static batching on the IDENTICAL workload (same seed) — the
+    # record's serve.ab.continuous_over_static carries the A/B verdict;
+    # heterogeneous generation lengths (16..256) are the regime where
+    # static batching's drain barrier holds slots hostage.
+    ("serve_poisson", ["tools/serve_bench.py", "--requests", "64",
+                       "--rate", "8", "--new-min", "16",
+                       "--new-max", "256"]),
+    ("serve_static_ab", ["tools/serve_bench.py", "--requests", "64",
+                         "--rate", "8", "--new-min", "16",
+                         "--new-max", "256", "--ab"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

@@ -1,0 +1,38 @@
+"""Shared LM construction for the inference bench lanes.
+
+`tools/decode_bench.py` (single-batch decode baseline) and
+`tools/serve_bench.py` (continuous-batching serving engine) must price
+the SAME model for the A/B to mean anything — both build through this
+helper instead of inlining the construction twice."""
+
+import argparse
+
+
+def add_model_args(ap: argparse.ArgumentParser) -> None:
+    """The GPT-2-small-class model knobs both inference lanes share."""
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+
+
+def validate_model_args(ap: argparse.ArgumentParser, args) -> None:
+    if args.layers < 1:
+        ap.error(f"--layers must be >= 1, got {args.layers}")
+    if args.d_model % args.heads:
+        ap.error(f"--d-model {args.d_model} must be divisible by "
+                 f"--heads {args.heads}")
+
+
+def build_params(args, max_len: int, seed: int = 0):
+    """Dense LM parameter pytree (models.parallel_lm.init_lm_params)
+    at the argparse'd sizes with a ``max_len``-entry position table
+    (the KV cache bound both lanes size against). FFN is the standard
+    4x d_model."""
+    import jax
+
+    from horovod_tpu.models import parallel_lm as plm
+
+    return plm.init_lm_params(
+        jax.random.PRNGKey(seed), args.vocab, max_len, args.layers,
+        args.heads, args.d_model // args.heads, 4 * args.d_model)

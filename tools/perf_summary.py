@@ -115,6 +115,26 @@ def snapshot_cell(rec):
     return cell
 
 
+def serve_cell(rec):
+    """Compact render of the record's serving stamps (tools/
+    serve_bench.py; horovod_tpu/serve): "ttft 42/180ms occ 0.61" =
+    p50/p99 time-to-first-token + mean page occupancy, and A/B records
+    append "c/s 1.23" (continuous-over-static throughput ratio).
+    Non-serving records render as em-dash."""
+    s = rec.get("serve")
+    if not isinstance(s, dict):
+        return "—"
+    ttft = s.get("ttft_ms") or {}
+    cell = f"ttft {ttft.get('p50', '?')}/{ttft.get('p99', '?')}ms"
+    occ = (s.get("pages") or {}).get("occupancy_mean")
+    if occ is not None:
+        cell += f" occ {occ:g}"
+    ab = s.get("ab") or {}
+    if ab.get("continuous_over_static") is not None:
+        cell += f" c/s {ab['continuous_over_static']:g}"
+    return cell
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
@@ -122,8 +142,9 @@ def main():
     args = ap.parse_args()
     ok, err = load(args.today)
     print("| lane | value | unit | window | overlap | collectives "
-          "| flash grid | snapshot | peak | probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|")
+          "| flash grid | snapshot | serve | peak | probe TF "
+          "| stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -137,6 +158,7 @@ def main():
               f"| {collectives_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
               f"| {snapshot_cell(rec)} "
+              f"| {serve_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Serving-engine benchmark: open-loop Poisson load over the
+continuous-batching engine (horovod_tpu/serve/), printing ONE
+bench-record JSON line with tokens/s/chip, p50/p99 time-to-first-token,
+p50/p99 per-token latency, and page-occupancy stats.
+
+Open-loop honesty: arrivals are drawn up front from a Poisson process
+(exponential gaps at ``--rate``) and requests enter the engine when the
+WALL CLOCK passes their arrival time — a saturated engine pays queueing
+delay in TTFT instead of silently back-pressuring the generator.
+
+Modes:
+  (default)   continuous batching (iteration-level join/leave)
+  --static    static batching baseline: the same engine and compiled
+              step, but batches of up to ``--decode-slots`` requests
+              join together and the batch DRAINS COMPLETELY before the
+              next one starts (what serving without continuous
+              batching looks like)
+  --ab        run continuous then static on the IDENTICAL workload
+              (same seed -> same prompts and arrival times) and stamp
+              both plus the throughput ratio — the continuous-vs-static
+              A/B as one self-contained record
+
+``--pin-exact`` re-decodes every finished request through
+``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
+tokens — the engine/decode-lane exactness gate CI runs on a tiny model
+(tools/check.sh serve smoke lane).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:   # `python tools/serve_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def make_workload(args):
+    """Pre-drawn open-loop workload: (arrival_offset_s, prompt,
+    max_new) triples, arrivals cumsum'd exponential gaps."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    out = []
+    for i in range(args.requests):
+        lp = int(rng.integers(args.prompt_min, args.prompt_max + 1))
+        n = int(rng.integers(args.new_min, args.new_max + 1))
+        prompt = rng.integers(0, args.vocab, size=lp).astype(np.int32)
+        out.append((float(arrivals[i]), prompt, n))
+    return out
+
+
+def _drain_arrivals(eng, pending, t0, now):
+    while pending and pending[0][0] <= now - t0:
+        arrival, prompt, n = pending.pop(0)
+        eng.submit(prompt, n, arrival=t0 + arrival)
+
+
+def _warm(eng, workload):
+    """Compile+warm the step programs through a dummy request so the
+    measured window starts warm (the decode lane's compile-first
+    discipline) — shared by BOTH runners so the --ab sides warm
+    identically. Two tokens of prompt: admissible under ANY page
+    budget the workload itself fits."""
+    eng.submit(workload[0][1][:2], 2)
+    eng.run()
+    eng.reset_metrics()
+
+
+def run_continuous(params, cfg, workload, warm=True):
+    """Continuous batching under the open-loop clock; returns the
+    engine (drained)."""
+    from horovod_tpu.serve import ServeEngine
+
+    eng = ServeEngine(params, cfg)
+    if warm:
+        _warm(eng, workload)
+    pending = sorted(workload, key=lambda w: w[0])
+    t0 = eng.clock()
+    eng._t_start = t0
+    while pending or not eng.idle:
+        _drain_arrivals(eng, pending, t0, eng.clock())
+        if not eng.step() and pending:
+            # idle until the next arrival is due
+            time.sleep(min(0.001, max(0.0, pending[0][0]
+                                      - (eng.clock() - t0))))
+    return eng
+
+
+def run_static(params, cfg, workload, warm=True):
+    """Static batching baseline: same engine/step program, but requests
+    are admitted in barrier batches of up to ``decode_slots`` and each
+    batch drains fully before the next is admitted."""
+    from horovod_tpu.serve import ServeEngine
+
+    eng = ServeEngine(params, cfg)
+    if warm:
+        _warm(eng, workload)
+    pending = sorted(workload, key=lambda w: w[0])
+    arrived = []
+    t0 = eng.clock()
+    eng._t_start = t0
+    while pending or arrived or not eng.idle:
+        while pending and pending[0][0] <= eng.clock() - t0:
+            arrived.append(pending.pop(0))
+        if eng.idle and arrived:
+            batch, arrived = (arrived[:cfg.decode_slots],
+                              arrived[cfg.decode_slots:])
+            for arrival, prompt, n in batch:
+                eng.submit(prompt, n, arrival=t0 + arrival)
+            eng.run()        # the barrier: drain the whole batch
+        elif pending:
+            time.sleep(min(0.001, max(0.0, pending[0][0]
+                                      - (eng.clock() - t0))))
+        else:
+            eng.run()
+    return eng
+
+
+def pin_exact(params, eng):
+    """Every finished greedy request must match its own lm_decode."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import parallel_lm as plm
+
+    for req in eng.finished:
+        if req.temperature > 0 or not req.output:
+            continue
+        prompt = np.concatenate(
+            [req.prompt[:req.orig_prompt_len]]).astype(np.int32)
+        ref = list(np.asarray(plm.lm_decode(
+            params, jnp.asarray(prompt)[None], len(req.output)))[0])
+        if req.output != ref:
+            raise SystemExit(
+                f"EXACTNESS PIN FAILED: request {req.rid} engine="
+                f"{req.output} lm_decode={ref}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    from tools.lm_common import (add_model_args, build_params,
+                                 validate_model_args)
+
+    add_model_args(ap)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--rate", type=float, default=2.0,
+                    help="Poisson arrival rate (requests/s)")
+    ap.add_argument("--prompt-min", type=int, default=64)
+    ap.add_argument("--prompt-max", type=int, default=256)
+    ap.add_argument("--new-min", type=int, default=32)
+    ap.add_argument("--new-max", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="0 = auto: worst case for the in-flight limit")
+    ap.add_argument("--decode-slots", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--policy", choices=("fcfs", "sjf"), default="fcfs")
+    ap.add_argument("--slo", choices=("latency", "balanced",
+                                      "throughput"), default="balanced")
+    ap.add_argument("--admission", choices=("reserve", "lazy"),
+                    default="reserve")
+    ap.add_argument("--static", action="store_true",
+                    help="static-batching baseline instead of "
+                         "continuous")
+    ap.add_argument("--ab", action="store_true",
+                    help="continuous AND static on the same workload; "
+                         "stamp both + the ratio")
+    ap.add_argument("--pin-exact", action="store_true",
+                    help="assert greedy engine output == lm_decode "
+                         "for every finished request")
+    ap.add_argument("--require-finished", action="store_true",
+                    help="exit nonzero unless every request finished")
+    args = ap.parse_args()
+    validate_model_args(ap, args)
+    if args.requests < 1 or args.rate <= 0:
+        ap.error("--requests must be >= 1 and --rate > 0")
+    if args.prompt_min < 1 or args.prompt_max < args.prompt_min or \
+            args.new_min < 1 or args.new_max < args.new_min:
+        ap.error("need 1 <= prompt-min <= prompt-max and "
+                 "1 <= new-min <= new-max")
+
+    from horovod_tpu.serve import ServeConfig
+
+    # Lmax covers the worst request, rounded up to whole pages.
+    ps = args.page_size
+    lmax = -(-(args.prompt_max + args.new_max) // ps) * ps
+    pages_per_seq = lmax // ps
+    num_pages = args.num_pages
+    if num_pages <= 0:
+        num_pages = (args.decode_slots + 1) * pages_per_seq + 1
+    cfg = ServeConfig(
+        page_size=ps, num_pages=num_pages,
+        decode_slots=args.decode_slots,
+        prefill_chunk=args.prefill_chunk, policy=args.policy,
+        slo=args.slo, admission=args.admission)
+
+    params = build_params(args, lmax)
+    workload = make_workload(args)
+
+    def lane(runner, tag):
+        eng = runner(params, cfg, workload)
+        stats = eng.stats()
+        print(f"[serve_bench] {tag}: "
+              f"{stats['tokens_per_sec_per_chip']} tok/s/chip, "
+              f"ttft p50/p99 {stats['ttft_ms']['p50']}/"
+              f"{stats['ttft_ms']['p99']} ms, "
+              f"tbt p50/p99 {stats['tbt_ms']['p50']}/"
+              f"{stats['tbt_ms']['p99']} ms, "
+              f"{stats['by_state']}", file=sys.stderr, flush=True)
+        if args.pin_exact:
+            pin_exact(params, eng)
+        if args.require_finished and \
+                stats["by_state"].get("finished") != args.requests:
+            raise SystemExit(
+                f"not all requests finished: {stats['by_state']}")
+        return stats
+
+    serve: dict
+    if args.ab:
+        cont = lane(run_continuous, "continuous")
+        stat = lane(run_static, "static")
+        ratio = None
+        if cont["tokens_per_sec_per_chip"] and \
+                stat["tokens_per_sec_per_chip"]:
+            ratio = round(cont["tokens_per_sec_per_chip"]
+                          / stat["tokens_per_sec_per_chip"], 3)
+        mode, headline = "ab", cont
+        serve = dict(cont, mode="ab",
+                     ab={"static": stat, "continuous_over_static": ratio})
+    elif args.static:
+        mode = "static"
+        headline = serve = dict(lane(run_static, "static"),
+                                mode="static")
+    else:
+        mode = "continuous"
+        headline = serve = dict(lane(run_continuous, "continuous"),
+                                mode="continuous")
+
+    print(json.dumps({
+        "metric": f"serve_{mode}_tokens_per_sec_per_chip",
+        "value": headline["tokens_per_sec_per_chip"],
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "serve": serve,
+        "config": {
+            "page_size": ps, "num_pages": num_pages,
+            "decode_slots": args.decode_slots,
+            "prefill_chunk": args.prefill_chunk,
+            "policy": args.policy, "slo": args.slo,
+            "admission": args.admission, "rate": args.rate,
+            "requests": args.requests,
+        },
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
