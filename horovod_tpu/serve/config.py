@@ -23,6 +23,11 @@ POLICIES = ("fcfs", "sjf")
 SLO_MODES = ("latency", "balanced", "throughput")
 #: Page-allocation disciplines.
 ADMISSIONS = ("reserve", "lazy")
+#: Decode-attention implementations: ``gather`` reconstructs the dense
+#: ``[S, Lmax, H, D]`` logical cache per layer per step (the exactness
+#: reference); ``paged`` streams only each slot's live pages through
+#: the fused Pallas kernel (:mod:`horovod_tpu.ops.paged_attention`).
+ATTENTIONS = ("gather", "paged")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,6 +53,16 @@ class ServeConfig:
     only starts when it can always finish; the default), ``lazy``
     allocates pages as positions cross page boundaries and EVICTS on
     exhaustion (higher occupancy, eviction-recompute risk).
+
+    ``attention`` picks the decode-attention path: ``gather`` (the
+    default and the exactness reference) reconstructs each slot's
+    dense ``[Lmax, H, D]`` cache per layer per step — O(Lmax) HBM
+    traffic regardless of position — while ``paged`` streams only the
+    ``ceil((t+1)/page_size)`` live pages through the fused Pallas
+    kernel (:func:`horovod_tpu.ops.paged_attention.
+    paged_attention_decode`; docs/serving.md "The paged-attention
+    decode kernel"). Greedy token streams are bit-identical either
+    way; the prefill lane keeps the full gather in both modes.
     """
 
     page_size: int = 16
@@ -58,6 +73,7 @@ class ServeConfig:
     policy: str = "fcfs"
     slo: str = "balanced"
     admission: str = "reserve"
+    attention: str = "gather"
     eos_token: Optional[int] = None
     max_queue: int = 0          # 0 = unbounded
     requeue_evicted: bool = True
@@ -82,6 +98,9 @@ class ServeConfig:
         if self.admission not in ADMISSIONS:
             raise ValueError(
                 f"admission {self.admission!r} not in {ADMISSIONS}")
+        if self.attention not in ATTENTIONS:
+            raise ValueError(
+                f"attention {self.attention!r} not in {ATTENTIONS}")
 
     @property
     def in_flight_limit(self) -> int:

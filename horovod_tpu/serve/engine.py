@@ -8,13 +8,20 @@ steps with ZERO recompilation. Two program variants compile once each
 (mixed prefill+decode, and decode-only for steps with an idle prefill
 lane); everything else is data:
 
-* each decode slot gathers its request's logical cache
+* each decode slot attends its single query against its paged cache.
+  Two selectable paths (``ServeConfig.attention``): ``gather`` (the
+  default and exactness reference) reconstructs the logical cache
   ``[Lmax, H, D]`` out of the paged K/V arrays through the request's
   page-table index vector (:mod:`~horovod_tpu.serve.kvcache` — a pure
-  gather, never a reshape), inserts the step's new K/V row, attends
-  with ``q_offset = t`` (the cache mask, exactly
+  gather, never a reshape; K and V share ONE index computation per
+  lane), inserts the step's new K/V row, attends with ``q_offset = t``
+  (the cache mask, exactly
   :func:`models.parallel_lm.lm_decode_step`'s spelling), and scatters
-  the new row back into the pages;
+  the new row back into the pages; ``paged`` runs the same scatter
+  FIRST and then streams only the slot's ``ceil((t+1)/page_size)``
+  live pages through the fused Pallas kernel
+  (:func:`~horovod_tpu.ops.paged_attention.paged_attention_decode`) —
+  the dense intermediate never exists;
 * the prefill lane runs one chunk of the current prompt through the
   RECTANGULAR-causal path — queries at global positions
   ``start..start+C-1`` over the full gathered cache with
@@ -60,19 +67,47 @@ from horovod_tpu.serve.scheduler import (
 def _gather_cache(pages_arr, table):
     """pages [P, ps, H, D] x table [pps] -> the request's contiguous
     logical cache [Lmax, H, D] (unmapped slots read the null page's
-    zeros — always masked downstream)."""
+    zeros — always masked downstream). Single-array form, kept as the
+    paged kernel's exactness reference; the hot path shares one index
+    computation for K and V via :func:`_gather_cache_kv`."""
     g = pages_arr[table]
     return g.reshape(g.shape[0] * g.shape[1], g.shape[2], g.shape[3])
 
 
+def _gather_cache_kv(pk, pv, table):
+    """The K AND V gathers of one lane through ONE shared index
+    computation: the page table expands to flat row indices once, and
+    both page arrays gather through the same vector (the old path
+    rebuilt the expansion four times per layer — K/V x decode/prefill;
+    tables are the only index input, so K and V always shared it
+    logically). Returns ``(k [Lmax, H, D], v [Lmax, H, D])``."""
+    import jax.numpy as jnp
+
+    P, ps = pk.shape[0], pk.shape[1]
+    rows = (table[:, None] * ps
+            + jnp.arange(ps, dtype=table.dtype)[None, :]).reshape(-1)
+    return (pk.reshape(P * ps, pk.shape[2], pk.shape[3])[rows],
+            pv.reshape(P * ps, pv.shape[2], pv.shape[3])[rows])
+
+
 def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
-               tp=None):
+               attention: str = "gather", tp=None):
     """One continuous-batching step.
 
     ``dec``: ``tok``/``pos``/``active`` [S] + ``tables`` [S, pps];
     ``pre`` (or None for the decode-only variant): ``tokens`` [C],
     ``start``/``length`` scalars + ``table`` [pps].
     Returns ``(new_pages, dec_logits [S, V], pre_logits [V] | None)``.
+
+    ``attention`` (static) picks the decode lane's cache path:
+    ``gather`` reconstructs the dense per-slot cache and inserts the
+    new row into the gathered copy (the exactness reference);
+    ``paged`` scatters the new row into its page FIRST (the identical
+    scatter — so the kernel stays READ-ONLY over pages and the
+    no-donation invariant is untouched) and then streams only the live
+    pages through :func:`~horovod_tpu.ops.paged_attention.
+    paged_attention_decode`. The prefill lane keeps the full gather in
+    both modes (rectangular-causal over the whole cache).
     """
     import math
 
@@ -87,7 +122,11 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
         _project_qkv,
     )
     from horovod_tpu.ops.attention import dot_product_attention
+    from horovod_tpu.ops.paged_attention import paged_attention_decode
 
+    if attention not in ("gather", "paged"):
+        raise ValueError(
+            f"attention must be 'gather' or 'paged', got {attention!r}")
     ps = page_size
     num_pages = pages[0]["k"].shape[0]
     pps = dec["tables"].shape[1]
@@ -117,6 +156,8 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
                              dec["tables"][jnp.arange(S), t // ps],
                              num_pages)                 # OOB = dropped
     write_off_d = t % ps
+    # Live keys per slot for the paged kernel (t+1; 0 = idle lane).
+    lens = jnp.where(dec["active"], t + 1, 0).astype(jnp.int32)
     xd = params["embed"][dec["tok"]][:, None] + \
         params["pos"][t][:, None]                       # [S, 1, E]
 
@@ -132,8 +173,7 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
             # math.sqrt, exactly parallel_lm's spelling — the scale
             # must be the bit-identical float for the exactness pin.
             scale = 1.0 / math.sqrt(qp.shape[-1])
-            gk = _gather_cache(pk, pre["table"])
-            gv = _gather_cache(pv, pre["table"])
+            gk, gv = _gather_cache_kv(pk, pv, pre["table"])
             # The chunk's own rows enter the gathered view (scatter —
             # row-distinct indices, padded rows dropped), then the
             # rectangular-causal attention: queries at start+i over
@@ -153,20 +193,38 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
         qd, kd, vd = _project_qkv(layer, xd, tp)        # [S, 1, H, D]
         if scale is None:
             scale = 1.0 / math.sqrt(qd.shape[-1])
-        gkd = jax.vmap(_gather_cache, in_axes=(None, 0))(
-            pk, dec["tables"])                          # [S, Lmax, H, D]
-        gvd = jax.vmap(_gather_cache, in_axes=(None, 0))(
-            pv, dec["tables"])
-        ckd = insert(gkd, kd, t)
-        cvd = insert(gvd, vd, t)
-        attn = jax.vmap(
-            lambda q, k, v, tt: dot_product_attention(
-                q, k, v, causal=True, scale=scale, q_offset=tt)
-        )(qd, ckd, cvd, t)                              # [S, 1, H, D]
+        if attention == "paged":
+            # Scatter the new row FIRST (the gather path's identical
+            # scatter, just hoisted above the attention), then stream
+            # only the live pages — the kernel reads position t back
+            # from its page, so the dense [S, Lmax, H, D] intermediate
+            # never exists and per-step K/V bytes are O(t), not
+            # O(Lmax). Read-only kernel over pages: the no-donation
+            # invariant is exactly the gather path's.
+            pk = pk.at[write_page_d, write_off_d].set(kd[:, 0],
+                                                      mode="drop")
+            pv = pv.at[write_page_d, write_off_d].set(vd[:, 0],
+                                                      mode="drop")
+            attn = paged_attention_decode(
+                qd[:, 0], pk, pv, dec["tables"], lens,
+                scale=scale)[:, None]                   # [S, 1, H, D]
+        else:
+            gkd, gvd = jax.vmap(
+                _gather_cache_kv, in_axes=(None, None, 0))(
+                pk, pv, dec["tables"])                  # [S, Lmax, H, D]
+            ckd = insert(gkd, kd, t)
+            cvd = insert(gvd, vd, t)
+            attn = jax.vmap(
+                lambda q, k, v, tt: dot_product_attention(
+                    q, k, v, causal=True, scale=scale, q_offset=tt)
+            )(qd, ckd, cvd, t)                          # [S, 1, H, D]
         xd = _attn_out_residual(layer, attn, xd, tp)
         xd = _ffn_residual(layer, xd, tp)
-        pk = pk.at[write_page_d, write_off_d].set(kd[:, 0], mode="drop")
-        pv = pv.at[write_page_d, write_off_d].set(vd[:, 0], mode="drop")
+        if attention != "paged":
+            pk = pk.at[write_page_d, write_off_d].set(kd[:, 0],
+                                                      mode="drop")
+            pv = pv.at[write_page_d, write_off_d].set(vd[:, 0],
+                                                      mode="drop")
 
         new_pages.append({"k": pk, "v": pv})
 
@@ -206,10 +264,21 @@ class ServeEngine:
         self.finished: List[Request] = []
         self.evicted: List[Request] = []    # terminal (requeue off)
         self.occupancy_samples: List[float] = []
+        #: Per-step decode-lane live-key counts (t+1 per slot, 0 =
+        #: idle lane) — the raw input :func:`ops.paged_attention.
+        #: paged_grid_info` aggregates into stats()["attention"], so
+        #: serve_bench records carry the gather-vs-paged byte evidence
+        #: on BOTH sides of the A/B (one accounting model, owned by
+        #: paged_grid_info). Kept per-step (not pre-summed) so tests
+        #: can pin the exact page walk; stats() aggregation is
+        #: O(steps) — bench runs call it once at the end, and
+        #: reset_metrics() bounds a long-lived engine.
+        self.attn_len_samples: List[List[int]] = []
         self.steps = 0
         self._t_start = clock()
         step = functools.partial(serve_step,
-                                 page_size=config.page_size)
+                                 page_size=config.page_size,
+                                 attention=config.attention)
         import jax
 
         # Two fixed-shape variants, compiled once each; NO donation —
@@ -382,6 +451,10 @@ class ServeEngine:
 
         dec = self._build_dec()
         pre, chunk = self._build_pre()
+        # Static traffic accounting for this step's decode lane (live
+        # keys per slot = t+1) — pure host data, no device sync.
+        self.attn_len_samples.append(
+            [0 if r is None else r.next_pos + 1 for r in self.slots])
         if pre is None:
             pages, dec_logits, _ = self._step_decode(
                 self.params, self.cache.pages, dec)
@@ -475,6 +548,7 @@ class ServeEngine:
         self.evicted = []
         self.scheduler.rejected = []
         self.occupancy_samples = []
+        self.attn_len_samples = []
         self.steps = 0
         self._t_start = self.clock()
 
@@ -486,5 +560,54 @@ class ServeEngine:
                       + [s for s in self.slots if s is not None]
                       + ([self.prefilling] if self.prefilling else [])
                       + self.scheduler.queue + self.scheduler.rejected)
-        return summarize(everything, self.clock() - self._t_start,
-                         self.chips, self.occupancy_samples)
+        out = summarize(everything, self.clock() - self._t_start,
+                        self.chips, self.occupancy_samples)
+        out["attention"] = self.attention_stats()
+        return out
+
+    def step_grid_info(self, lengths: List[int]) -> Dict:
+        """One step's static decode-traffic accounting — exactly
+        :func:`ops.paged_attention.paged_grid_info` over this engine's
+        cache geometry (the single owner of the byte model)."""
+        import numpy as np
+
+        from horovod_tpu.ops.paged_attention import paged_grid_info
+
+        c = self.cache
+        return paged_grid_info(
+            lengths, page_size=self.config.page_size,
+            pages_per_seq=c.pages_per_seq, num_heads=c.num_heads,
+            head_dim=c.head_dim,
+            dtype_bytes=np.dtype(c.dtype).itemsize,
+            num_layers=c.num_layers)
+
+    def attention_stats(self) -> Dict:
+        """Decode-lane K/V traffic accounting over the run: what the
+        paged kernel streams (live pages, ``ceil((t+1)/page_size)``
+        per slot) vs what the gather path reconstructs (``Lmax/
+        page_size`` pages per slot, every slot every step) — the
+        per-step :func:`ops.paged_attention.paged_grid_info` results
+        aggregated. Stamped on BOTH modes so the gather/paged A/B is
+        honest on both sides; the prefill lane (full gather in both
+        modes) is excluded by construction."""
+        infos = [self.step_grid_info(s) for s in self.attn_len_samples]
+        n = len(infos)
+        total_live = sum(i["pages_live_total"] for i in infos)
+        total_paged = sum(i["kv_bytes"] for i in infos)
+        total_gather = sum(i["kv_bytes_gather"] for i in infos)
+        return {
+            "mode": self.config.attention,
+            "decode_steps": n,
+            "page_size": self.config.page_size,
+            "pages_per_seq": self.cache.pages_per_seq,
+            "pages_live_per_step_mean":
+                round(total_live / n, 2) if n else None,
+            "pages_full_per_step":
+                self.config.decode_slots * self.cache.pages_per_seq,
+            "kv_bytes_per_step_paged":
+                round(total_paged / n, 1) if n else None,
+            "kv_bytes_per_step_gather":
+                total_gather // n if n else None,
+            "kv_fetch_frac":
+                round(total_paged / total_gather, 4) if n else None,
+        }

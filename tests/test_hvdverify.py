@@ -111,8 +111,8 @@ def test_registry_shape():
     elastic = by_group["elastic"]
     assert len(elastic) == 1 and elastic[0].forbid_donation
     serve = by_group["serve"]
-    assert len(serve) == 1 and serve[0].name == "serve.step"
-    assert serve[0].forbid_donation
+    assert {p.name for p in serve} == {"serve.step", "serve.step_paged"}
+    assert all(p.forbid_donation for p in serve)
     assert all(p.reconcile is not None for p in by_group["optimizer"])
 
 
@@ -245,6 +245,36 @@ def test_serve_step_verifies_and_donating_variant_is_flagged(hvd):
                      forbid_donation_why=_SERVE_WHY)
     assert "HVV104" in [f.rule for f in flagged.findings]
     assert "pages" in flagged.findings[0].message
+
+
+def test_serve_step_paged_verifies_and_donating_variant_is_flagged(hvd):
+    """The PR-8 edition of the same invariant: the fused
+    paged-attention step (the Pallas kernel streams pages READ-ONLY;
+    the new-row insert stays the scatter outside it) verifies clean
+    under forbid_donation, and donating the pages is flagged exactly
+    like the gather step — requests hold pages under an in-flight
+    step in both modes."""
+    import functools
+
+    import jax
+
+    from tools.hvdverify.registry import _SERVE_WHY, _build_serve_step
+
+    fn, args = _build_serve_step(attention="paged")
+    clean = verify(fn, args, name="serve.step_paged",
+                   forbid_donation=True, forbid_donation_why=_SERVE_WHY)
+    assert not clean.findings
+    assert clean.summary["count"] == 0
+
+    from horovod_tpu.serve.engine import serve_step
+
+    donating = jax.jit(functools.partial(serve_step, page_size=8,
+                                         attention="paged"),
+                       donate_argnums=(1,))    # donate the pages
+    flagged = verify(lambda p, pages, d, pr: donating(p, pages, d, pr),
+                     args, name="serve-paged-donating",
+                     forbid_donation=True, forbid_donation_why=_SERVE_WHY)
+    assert "HVV104" in [f.rule for f in flagged.findings]
 
 
 def test_while_condition_findings_are_merged(hvd):

@@ -1,0 +1,193 @@
+"""Fused paged-attention decode kernel exactness + accounting
+(horovod_tpu/ops/paged_attention.py).
+
+The kernel-level half of the PR-8 acceptance matrix: interpret-mode
+execution against the serving engine's own gather reference
+(``_gather_cache`` + ``dot_product_attention(q_offset=t)``) across
+ragged lengths, page-boundary edges, single-page requests, idle lanes,
+and physically-shuffled page tables — with the reserved null page 0
+POISONED with NaN, so any read of its contents into an attention sum
+fails loudly instead of averaging in silently. The engine-level token
+pins live in tests/test_serve_engine.py (attention-parametrized).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.attention import dot_product_attention
+from horovod_tpu.ops.paged_attention import (
+    paged_attention_decode,
+    paged_grid_info,
+)
+from horovod_tpu.serve.engine import _gather_cache
+
+H, D = 2, 8
+
+
+def _case(lengths, ps, pps, seed=0, shuffle=False):
+    """Pages + tables for the given per-slot live-key counts. The null
+    page 0 is NaN-poisoned; each live slot's first ceil(len/ps) table
+    entries map distinct real pages (the engine's ensure_pages
+    invariant), the tail stays 0 (unmapped -> null)."""
+    rng = np.random.default_rng(seed)
+    S = len(lengths)
+    need = [-(-int(x) // ps) for x in lengths]
+    P = 1 + sum(need) + 2                      # a couple of free pages
+    k_pages = rng.normal(size=(P, ps, H, D)).astype(np.float32)
+    v_pages = rng.normal(size=(P, ps, H, D)).astype(np.float32)
+    k_pages[0] = np.nan
+    v_pages[0] = np.nan
+    ids = list(range(1, P))
+    if shuffle:
+        rng.shuffle(ids)
+    tables = np.zeros((S, pps), np.int32)
+    nxt = 0
+    for s, n in enumerate(need):
+        for j in range(n):
+            tables[s, j] = ids[nxt]
+            nxt += 1
+    q = rng.normal(size=(S, H, D)).astype(np.float32)
+    return q, k_pages, v_pages, tables, np.asarray(lengths, np.int32)
+
+
+def _reference(q, k_pages, v_pages, tables, lengths):
+    """The engine's gather path, slot by slot: reconstruct the dense
+    logical cache through the page table, attend with q_offset = t
+    (the cache mask — unwritten and null-page rows masked)."""
+    S = q.shape[0]
+    scale = 1.0 / math.sqrt(D)
+    outs = []
+    for s in range(S):
+        ln = int(lengths[s])
+        if ln == 0:
+            outs.append(np.zeros((H, D), np.float32))
+            continue
+        gk = _gather_cache(jnp.asarray(k_pages), jnp.asarray(tables[s]))
+        gv = _gather_cache(jnp.asarray(v_pages), jnp.asarray(tables[s]))
+        # Slice to the live keys (in the engine the masked tail is
+        # zeros and the causal mask makes it weightless; here it is
+        # NaN-poisoned, and the reference einsum's 0 * NaN would
+        # poison the row the kernel correctly never reads).
+        out = dot_product_attention(
+            jnp.asarray(q[s])[None], gk[:ln], gv[:ln], causal=True,
+            scale=scale, q_offset=ln - 1)
+        outs.append(np.asarray(out)[0])
+    return np.stack(outs)
+
+
+def _run(q, k_pages, v_pages, tables, lengths):
+    return np.asarray(paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(tables), jnp.asarray(lengths)))
+
+
+def _check(lengths, ps, pps, **kw):
+    q, kp, vp, tab, lens = _case(lengths, ps, pps, **kw)
+    out = _run(q, kp, vp, tab, lens)
+    ref = _reference(q, kp, vp, tab, lens)
+    assert np.isfinite(out).all(), "null-page NaN leaked into a sum"
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    return out
+
+
+class TestKernelExactness:
+    def test_ragged_lengths(self):
+        """Lengths straddling every page-fill state, null page NaN:
+        mid-page, full page, page+1, single row, idle lane."""
+        _check([7, 8, 9, 1, 0, 3], ps=4, pps=4)
+
+    def test_length_exactly_on_page_boundary(self):
+        _check([4, 8, 12], ps=4, pps=3)
+
+    def test_single_page_requests(self):
+        """pps == 1: the whole logical cache is one page."""
+        _check([1, 2, 4], ps=4, pps=1)
+
+    def test_table_tail_never_touched(self):
+        """A table far longer than any request (the 'Lmax >> t' regime
+        the kernel exists for): the unmapped null tail is never
+        streamed — proven by the NaN poison."""
+        _check([3, 5], ps=4, pps=16)
+
+    def test_physically_shuffled_pages(self):
+        """Physical discontiguity is invisible: pages allocated in
+        shuffled order give the identical result."""
+        q, kp, vp, tab, lens = _case([7, 9, 2], ps=4, pps=4,
+                                     shuffle=True)
+        out = _run(q, kp, vp, tab, lens)
+        np.testing.assert_allclose(
+            out, _reference(q, kp, vp, tab, lens), rtol=1e-5, atol=1e-5)
+
+    def test_idle_lane_outputs_zeros(self):
+        q, kp, vp, tab, lens = _case([5, 0, 0], ps=4, pps=2)
+        out = _run(q, kp, vp, tab, lens)
+        assert np.all(out[1:] == 0.0)
+
+    def test_garbage_rows_past_t_in_last_page_ignored(self):
+        """Rows of the last live page beyond position t are allocated
+        but unwritten — after LIFO page reuse they hold STALE finite
+        values from an evicted request. Poison them huge and pin that
+        their weight is exactly zero (the mask runs BEFORE the running
+        max, so a 1e30 garbage score can never shift the softmax
+        statistics either)."""
+        q, kp, vp, tab, lens = _case([6], ps=4, pps=2)
+        ref = _reference(q, kp, vp, tab, lens)
+        kp[tab[0, 1], 2:] = 1e30           # rows 6..7 of page slot 1
+        vp[tab[0, 1], 2:] = 1e30
+        out = _run(q, kp, vp, tab, lens)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_shape_mismatches_raise(self):
+        q, kp, vp, tab, lens = _case([4], ps=4, pps=2)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            paged_attention_decode(jnp.asarray(q),
+                                   jnp.asarray(kp[:, :, :, :4]),
+                                   jnp.asarray(vp), jnp.asarray(tab),
+                                   jnp.asarray(lens))
+        with pytest.raises(ValueError, match="slots"):
+            paged_attention_decode(jnp.asarray(q), jnp.asarray(kp),
+                                   jnp.asarray(vp), jnp.asarray(tab),
+                                   jnp.asarray(np.zeros(3, np.int32)))
+
+
+class TestPagedGridInfo:
+    def test_pages_live_is_ceil(self):
+        info = paged_grid_info([7, 8, 9, 1, 0], page_size=4,
+                               pages_per_seq=4, num_heads=H, head_dim=D)
+        assert info["pages_live"] == [2, 2, 3, 1, 0]
+        assert info["pages_live_total"] == 8
+        assert info["pages_full_total"] == 20
+        assert info["kv_fetch_frac"] == 0.4
+
+    def test_bytes_accounting(self):
+        info = paged_grid_info([4], page_size=4, pages_per_seq=8,
+                               num_heads=H, head_dim=D, dtype_bytes=4,
+                               num_layers=3)
+        tile = 2 * 4 * H * D * 4 * 3
+        assert info["kv_bytes"] == tile
+        assert info["kv_bytes_gather"] == 8 * tile
+        assert info["kv_fetch_frac"] == round(1 / 8, 4)
+
+    def test_visited_pages_exclude_null(self):
+        """The 'null page never read' pin: the physical pages the
+        kernel's index map streams for LIVE slots never include the
+        reserved page 0, and idle lanes visit nothing."""
+        _, _, _, tab, lens = _case([7, 4, 0], ps=4, pps=4)
+        info = paged_grid_info(lens, page_size=4, pages_per_seq=4,
+                               num_heads=H, head_dim=D, tables=tab)
+        assert info["pages_visited"][0] == list(tab[0, :2])
+        assert info["pages_visited"][2] == []
+        assert all(0 not in v for v in info["pages_visited"])
+
+    def test_overflow_and_negative_raise(self):
+        with pytest.raises(ValueError, match="exceeds the page table"):
+            paged_grid_info([17], page_size=4, pages_per_seq=4,
+                            num_heads=H, head_dim=D)
+        with pytest.raises(ValueError, match="negative"):
+            paged_grid_info([-1], page_size=4, pages_per_seq=4,
+                            num_heads=H, head_dim=D)

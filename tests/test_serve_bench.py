@@ -58,6 +58,47 @@ class TestServeBenchContract:
         assert ab["static"]["tokens_per_sec_per_chip"] > 0
         assert ab["continuous_over_static"] is not None
 
+    def test_attention_paged_record_contract(self):
+        """--attention paged: same record contract, all greedy streams
+        still pinned against lm_decode, plus the kernel's traffic
+        accounting stamped (live-page bytes strictly below the gather
+        path's)."""
+        p = _run("serve_bench.py", *TINY, "--attention", "paged",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["config"]["attention"] == "paged"
+        a = rec["serve"]["attention"]
+        assert a["mode"] == "paged"
+        assert 0 < a["kv_fetch_frac"] < 1
+        assert a["kv_bytes_per_step_paged"] < \
+            a["kv_bytes_per_step_gather"]
+
+    def test_ab_attention_record_carries_both_sides(self):
+        """--ab-attention: one record with the paged side as headline,
+        the gather side + the paged_over_gather throughput ratio under
+        serve.ab_attention, and the static byte accounting on BOTH
+        sides."""
+        p = _run("serve_bench.py", *TINY, "--requests", "4",
+                 "--ab-attention")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == \
+            "serve_ab_attention_tokens_per_sec_per_chip"
+        assert rec["config"]["attention"] == "ab"
+        s = rec["serve"]
+        assert s["attention"]["mode"] == "paged"
+        ab = s["ab_attention"]
+        assert ab["gather"]["attention"]["mode"] == "gather"
+        assert ab["gather"]["tokens_per_sec_per_chip"] > 0
+        assert ab["paged_over_gather"] is not None
+        for side in (s, ab["gather"]):
+            assert 0 < side["attention"]["kv_fetch_frac"] < 1
+
+    def test_ab_attention_is_exclusive_with_other_modes(self):
+        for extra in (["--ab"], ["--static"]):
+            p = _run("serve_bench.py", *TINY, "--ab-attention", *extra,
+                     check=False)
+            assert p.returncode == 2, (extra, p.stderr[-300:])
+
     def test_require_finished_fails_loudly(self):
         # capacity of ONE page (8 positions): several drawn requests
         # can never fit and hard-reject -> --require-finished exits 1

@@ -4,7 +4,13 @@
 The acceptance pin: N requests through the continuous-batching engine
 produce BIT-IDENTICAL greedy tokens to N independent ``lm_decode``
 calls — across staggered joins, chunked prefill at awkward sizes,
-page-pressure evictions (recompute path), and EOS early exit."""
+page-pressure evictions (recompute path), and EOS early exit — under
+BOTH decode-attention paths (``ServeConfig.attention``): the dense
+gather reference AND the fused paged-attention kernel
+(horovod_tpu/ops/paged_attention.py, interpret mode on CPU). The
+whole exactness matrix is attention-parametrized; the paged path
+additionally pins its static traffic accounting (pages streamed per
+step = ``ceil((t+1)/page_size)`` per slot)."""
 
 import jax
 import jax.numpy as jnp
@@ -34,30 +40,33 @@ def _ref(params, prompt, steps):
         plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
 
 
+@pytest.mark.parametrize("attention", ["gather", "paged"])
 class TestGreedyExactness:
-    def test_single_request_matches_lm_decode(self, params):
+    def test_single_request_matches_lm_decode(self, params, attention):
         prompt = _prompt(0, 7)
         eng = ServeEngine(params, ServeConfig(
-            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4))
+            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4,
+            attention=attention))
         req = eng.submit(prompt, 9)
         eng.run()
         assert req.state == "finished"
         assert req.output == _ref(params, prompt, 9)
 
     @pytest.mark.parametrize("chunk", [1, 3, 4, 16])
-    def test_chunked_prefill_is_chunk_invariant(self, params, chunk):
+    def test_chunked_prefill_is_chunk_invariant(self, params, chunk,
+                                                attention):
         """Any prefill chunking (1-token, non-divisible, whole-prompt)
         yields the identical stream — the rectangular-causal chunk
         rows reproduce lm_prefill's rows exactly."""
         prompt = _prompt(1, 11)
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=32, decode_slots=1,
-            prefill_chunk=chunk))
+            prefill_chunk=chunk, attention=attention))
         req = eng.submit(prompt, 5)
         eng.run()
         assert req.output == _ref(params, prompt, 5)
 
-    def test_staggered_joins_bit_identical(self, params):
+    def test_staggered_joins_bit_identical(self, params, attention):
         """The acceptance pin: requests join the running batch at
         different steps; every greedy stream must equal its own
         independent lm_decode call."""
@@ -66,7 +75,8 @@ class TestGreedyExactness:
         refs = [_ref(params, p, n)
                 for p, (_, n) in zip(prompts, spec)]
         eng = ServeEngine(params, ServeConfig(
-            page_size=8, num_pages=40, decode_slots=2, prefill_chunk=4))
+            page_size=8, num_pages=40, decode_slots=2, prefill_chunk=4,
+            attention=attention))
         reqs = [eng.submit(prompts[0], spec[0][1]),
                 eng.submit(prompts[1], spec[1][1])]
         for _ in range(3):
@@ -82,7 +92,7 @@ class TestGreedyExactness:
             assert req.state == "finished"
             assert req.output == ref
 
-    def test_eviction_recompute_stays_exact(self, params):
+    def test_eviction_recompute_stays_exact(self, params, attention):
         """Lazy admission under page pressure: requests get evicted,
         requeued with their generated prefix, re-prefilled — and the
         final streams are still bit-identical to lm_decode."""
@@ -91,7 +101,7 @@ class TestGreedyExactness:
         refs = [_ref(params, p, n) for p, (_, n) in zip(prompts, spec)]
         eng = ServeEngine(params, ServeConfig(
             page_size=4, num_pages=8, decode_slots=2, prefill_chunk=4,
-            admission="lazy"))
+            admission="lazy", attention=attention))
         reqs = [eng.submit(p, n) for p, (_, n) in zip(prompts, spec)]
         eng.run(max_steps=500)
         assert sum(r.evictions for r in reqs) > 0, \
@@ -100,10 +110,12 @@ class TestGreedyExactness:
             assert req.state == "finished"
             assert req.output == ref
 
-    def test_max_new_tokens_one_finishes_at_prefill(self, params):
+    def test_max_new_tokens_one_finishes_at_prefill(self, params,
+                                                    attention):
         prompt = _prompt(2, 6)
         eng = ServeEngine(params, ServeConfig(
-            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8))
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8,
+            attention=attention))
         req = eng.submit(prompt, 1)
         eng.run()
         assert req.state == "finished"
@@ -139,13 +151,19 @@ class TestLifecycle:
         assert [r.state for r in reqs] == ["queued", "queued",
                                           "rejected"]
 
-    def test_no_donation_pages_stay_valid(self, params):
+    @pytest.mark.parametrize("attention", ["gather", "paged"])
+    def test_no_donation_pages_stay_valid(self, params, attention):
         """The HVV104-class invariant: the step must not donate the
         page arrays — the PRE-step pages object stays readable after
-        the step ran (a donated buffer would raise on use)."""
+        the step ran (a donated buffer would raise on use). The paged
+        kernel is additionally READ-ONLY over pages (the new-row
+        insert stays the scatter outside it), so the invariant is
+        identical in both modes (hvdverify: serve.step +
+        serve.step_paged under forbid_donation)."""
         prompt = _prompt(5, 6)
         eng = ServeEngine(params, ServeConfig(
-            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=4))
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=4,
+            attention=attention))
         eng.submit(prompt, 3)
         before = eng.cache.pages
         eng.step()
@@ -228,13 +246,17 @@ class TestSampling:
         assert outs[0] == outs[1]
         assert all(0 <= t < V for t in outs[0])
 
-    def test_greedy_rows_unaffected_by_sampling_neighbors(self, params):
+    @pytest.mark.parametrize("attention", ["gather", "paged"])
+    def test_greedy_rows_unaffected_by_sampling_neighbors(self, params,
+                                                          attention):
         """A greedy request sharing steps with a temperature request
-        stays bit-identical to lm_decode (per-slot sampling knobs)."""
+        stays bit-identical to lm_decode (per-slot sampling knobs) —
+        the mixed greedy+sampling cell of the attention matrix."""
         pg, ps = _prompt(7, 6), _prompt(8, 6)
         ref = _ref(params, pg, 6)
         eng = ServeEngine(params, ServeConfig(
-            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4))
+            page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4,
+            attention=attention))
         rg = eng.submit(pg, 6)
         rs = eng.submit(ps, 6, temperature=1.2, top_k=4, seed=9)
         eng.run()
@@ -256,6 +278,77 @@ class TestSampling:
             np.asarray([0, 0, 0], np.int32)))
         assert toks[0] == 5 and toks[2] == 2
         assert toks[1] in (6, 7)    # top-2 of the ramp
+
+
+class TestPagedAccounting:
+    def test_pages_streamed_per_step_is_ceil_t_plus_one(self, params):
+        """The traffic-win pin: every decode step streams exactly
+        ``ceil((t+1)/page_size)`` pages per live slot (vs the gather
+        path's constant ``Lmax/page_size``), and none of them is the
+        reserved null page 0."""
+        from horovod_tpu.ops.paged_attention import paged_grid_info
+
+        ps = 4
+        eng = ServeEngine(params, ServeConfig(
+            page_size=ps, num_pages=32, decode_slots=1,
+            prefill_chunk=64, attention="paged"))
+        req = eng.submit(_prompt(60, 6), 6)
+        # Step by hand so the page table can be snapshotted while the
+        # request still holds its pages (release() zeroes it).
+        mid = None
+        while not eng.idle:
+            eng.step()
+            if req.state == "decode" and mid is None and req.generated:
+                mid = (req.next_pos + 1, np.array(req.page_table))
+        assert req.state == "finished" and mid is not None
+        # One whole-prompt prefill step (slot empty), then 5 decode
+        # steps writing positions t = 6..10 -> live t+1 = 7..11 keys.
+        assert eng.attn_len_samples == \
+            [[0]] + [[t + 1] for t in range(6, 11)]
+        pages = [eng.step_grid_info(s)["pages_live"]
+                 for s in eng.attn_len_samples]
+        assert pages == [[0]] + [[-(-(t + 1) // ps)]
+                                 for t in range(6, 11)]
+        # The visited PHYSICAL pages never include the null page.
+        live, table = mid
+        info = paged_grid_info(
+            [live], page_size=ps,
+            pages_per_seq=eng.cache.pages_per_seq,
+            num_heads=eng.cache.num_heads,
+            head_dim=eng.cache.head_dim,
+            tables=table[None])
+        assert info["pages_visited"][0] and \
+            0 not in info["pages_visited"][0]
+
+    def test_stats_attention_block_both_modes(self, params):
+        """Both modes stamp the SAME static accounting (the A/B is
+        honest on both sides): live pages, the gather path's constant
+        bytes, and the fetch fraction."""
+        for mode in ("gather", "paged"):
+            eng = ServeEngine(params, ServeConfig(
+                page_size=8, num_pages=32, decode_slots=2,
+                prefill_chunk=4, attention=mode))
+            eng.submit(_prompt(61, 5), 4)
+            eng.run()
+            a = eng.stats()["attention"]
+            assert a["mode"] == mode
+            assert a["decode_steps"] == eng.steps
+            assert a["pages_full_per_step"] == \
+                2 * eng.cache.pages_per_seq
+            assert a["kv_bytes_per_step_gather"] > \
+                a["kv_bytes_per_step_paged"] > 0
+            assert 0 < a["kv_fetch_frac"] < 1
+
+    def test_reset_metrics_clears_traffic_samples(self, params):
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=1,
+            prefill_chunk=8, attention="paged"))
+        eng.submit(_prompt(62, 4), 2)
+        eng.run()
+        assert eng.attn_len_samples
+        eng.reset_metrics()
+        assert eng.attn_len_samples == []
+        assert eng.stats()["attention"]["kv_fetch_frac"] is None
 
 
 class TestStats:

@@ -17,7 +17,10 @@
 #                               8 Poisson requests through the
 #                               continuous-batching engine on CPU — all
 #                               must finish, TTFT stats must stamp, and
-#                               greedy output must equal lm_decode)
+#                               greedy output must equal lm_decode; runs
+#                               TWICE, once per decode-attention path —
+#                               the gather reference and the fused paged
+#                               kernel in interpret mode)
 #   tools/check.sh --verify     additionally run the FULL hvdverify sweep
 #                               (`python -m tools.hvdverify --sweep`): all
 #                               registry programs incl. the 9 driver gate
@@ -68,24 +71,30 @@ if [[ "$ELASTIC" == "1" ]]; then
 fi
 
 if [[ "$SERVE" == "1" ]]; then
-  echo "== serving smoke (8 Poisson requests, CPU: all finish, TTFT stamped, greedy == lm_decode) =="
-  SERVE_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
-    --layers 2 --d-model 64 --heads 2 --vocab 128 \
-    --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
-    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
-    --page-size 8 --pin-exact --require-finished)
-  echo "$SERVE_OUT" | python -c '
-import json, sys
+  echo "== serving smoke (8 Poisson requests, CPU: all finish, TTFT stamped, greedy == lm_decode; gather + paged) =="
+  for ATTN in gather paged; do
+    SERVE_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+      --layers 2 --d-model 64 --heads 2 --vocab 128 \
+      --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
+      --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+      --page-size 8 --attention "$ATTN" --pin-exact --require-finished)
+    echo "$SERVE_OUT" | ATTN="$ATTN" python -c '
+import json, os, sys
 rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
 s = rec["serve"]
 assert s["by_state"] == {"finished": 8}, s["by_state"]
 assert s["ttft_ms"]["p50"] is not None and s["ttft_ms"]["p99"] is not None
 assert s["tbt_ms"]["p50"] is not None
 assert s["pages"]["occupancy_max"] is not None
+a = s["attention"]
+assert a["mode"] == os.environ["ATTN"], a
+assert a["kv_fetch_frac"] is not None and a["kv_fetch_frac"] < 1.0, a
 t = s["ttft_ms"]
-print("serve smoke: all 8 finished, TTFT p50/p99 = %s/%s ms"
-      % (t["p50"], t["p99"]))
+print("serve smoke [%s]: all 8 finished, TTFT p50/p99 = %s/%s ms, "
+      "decode K/V frac %s" % (a["mode"], t["p50"], t["p99"],
+                              a["kv_fetch_frac"]))
 '
+  done
 fi
 
 if [[ "$SANITIZE" == "1" ]]; then

@@ -23,7 +23,9 @@ structure). Groups:
                  no-donation-while-snapshot-in-flight invariant
                  enforced (``forbid_donation``).
 * ``serve``    — the serving engine's mixed prefill+decode step
-                 (horovod_tpu/serve/engine.py) with the
+                 (horovod_tpu/serve/engine.py) in BOTH decode-attention
+                 modes (the dense gather reference and the fused
+                 paged-attention kernel), each with the
                  pages-never-donated-while-held invariant enforced
                  (``forbid_donation`` — the HVV104 class again).
 
@@ -524,13 +526,16 @@ _SERVE_WHY = ("the paged KV cache must never be donated while a request "
               "edition)")
 
 
-def _build_serve_step():
+def _build_serve_step(attention: str = "gather"):
     """The serving engine's MIXED prefill+decode step program exactly
     as ServeEngine jits it (horovod_tpu/serve/engine.py::serve_step):
     decode slots + the chunked-prefill lane over the paged KV arrays,
     traced on PagedKVCache's abstract twin. No collectives today (the
     single-chip engine; LogicalMesh sharding is ROADMAP item 2) — the
-    verified property is the donation rule."""
+    verified property is the donation rule, in BOTH decode-attention
+    modes: pages must never be donated while requests hold them,
+    whether the step gathers the dense cache or the fused Pallas
+    kernel streams pages read-only (``attention="paged"``)."""
     import functools
 
     import jax
@@ -541,7 +546,7 @@ def _build_serve_step():
     from horovod_tpu.serve.engine import serve_step
 
     cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
-                      prefill_chunk=4)
+                      prefill_chunk=4, attention=attention)
     params = jax.eval_shape(
         lambda: plm.init_lm_params(jax.random.PRNGKey(0), 64, 32, 2, 2,
                                    8, 32))
@@ -558,7 +563,8 @@ def _build_serve_step():
     # jax.jit WITHOUT donation — ServeEngine's exact spelling; a
     # donate_argnums variant is the HVV104 regression test's job.
     fn = jax.jit(functools.partial(serve_step,
-                                   page_size=cfg.page_size))
+                                   page_size=cfg.page_size,
+                                   attention=cfg.attention))
     return (lambda p, pages, d, pr: fn(p, pages, d, pr)), \
         (params, cache.pages, dec, pre)
 
@@ -619,10 +625,18 @@ def _make_registry() -> List[Program]:
         forbid_donation=True,
         forbid_donation_why=_ELASTIC_WHY))
 
-    # The serving engine's compiled step + its page-donation invariant.
+    # The serving engine's compiled step + its page-donation invariant,
+    # in both decode-attention modes (the paged variant streams pages
+    # through the fused kernel READ-ONLY — same invariant class, paged
+    # edition).
     progs.append(Program(
         "serve.step", "serve",
         lambda: _build_serve_step(),
+        forbid_donation=True,
+        forbid_donation_why=_SERVE_WHY))
+    progs.append(Program(
+        "serve.step_paged", "serve",
+        lambda: _build_serve_step(attention="paged"),
         forbid_donation=True,
         forbid_donation_why=_SERVE_WHY))
 

@@ -80,6 +80,18 @@ LANES = [
     ("serve_static_ab", ["tools/serve_bench.py", "--requests", "64",
                          "--rate", "8", "--new-min", "16",
                          "--new-max", "256", "--ab"]),
+    # Gather-vs-paged decode attention A/B (round-9 tentpole,
+    # horovod_tpu/ops/paged_attention.py): the SAME continuous engine
+    # and workload, decode attention flipped between the dense
+    # [S, Lmax, H, D] gather (reference) and the fused page-streaming
+    # Pallas kernel. Long generations against a large Lmax are the
+    # win regime (per-step K/V bytes O(t) vs O(Lmax)); the record's
+    # serve.ab_attention.paged_over_gather carries the throughput
+    # verdict and serve.attention the static byte accounting for both
+    # policies.
+    ("serve_paged_ab", ["tools/serve_bench.py", "--requests", "64",
+                        "--rate", "8", "--new-min", "16",
+                        "--new-max", "256", "--ab-attention"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

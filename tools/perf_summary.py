@@ -118,9 +118,12 @@ def snapshot_cell(rec):
 def serve_cell(rec):
     """Compact render of the record's serving stamps (tools/
     serve_bench.py; horovod_tpu/serve): "ttft 42/180ms occ 0.61" =
-    p50/p99 time-to-first-token + mean page occupancy, and A/B records
-    append "c/s 1.23" (continuous-over-static throughput ratio).
-    Non-serving records render as em-dash."""
+    p50/p99 time-to-first-token + mean page occupancy; A/B records
+    append "c/s 1.23" (continuous-over-static throughput ratio);
+    paged-attention records append "kv 0.13x" (live-pages/gather
+    decode K/V byte fraction — ops/paged_attention.paged_grid_info)
+    and attention-A/B records "p/g 1.15" (paged-over-gather
+    throughput). Non-serving records render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -132,6 +135,13 @@ def serve_cell(rec):
     ab = s.get("ab") or {}
     if ab.get("continuous_over_static") is not None:
         cell += f" c/s {ab['continuous_over_static']:g}"
+    attn = s.get("attention") or {}
+    if attn.get("mode") == "paged" and \
+            attn.get("kv_fetch_frac") is not None:
+        cell += f" kv {attn['kv_fetch_frac']:g}x"
+    abat = s.get("ab_attention") or {}
+    if abat.get("paged_over_gather") is not None:
+        cell += f" p/g {abat['paged_over_gather']:g}"
     return cell
 
 

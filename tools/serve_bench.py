@@ -20,6 +20,19 @@ Modes:
               (same seed -> same prompts and arrival times) and stamp
               both plus the throughput ratio — the continuous-vs-static
               A/B as one self-contained record
+  --attention gather|paged: the decode-attention path
+              (``ServeConfig.attention`` — gather reconstructs the
+              dense per-slot cache, paged streams live pages through
+              the fused Pallas kernel); every record stamps the
+              per-step page/byte accounting for BOTH policies
+              (``serve.attention``) so the traffic win is on record
+              regardless of mode
+  --ab-attention
+              run the continuous engine with BOTH attention paths on
+              the IDENTICAL workload and stamp both plus the
+              ``paged_over_gather`` throughput ratio (the
+              gather-vs-paged A/B as one record; exclusive with
+              --ab/--static)
 
 ``--pin-exact`` re-decodes every finished request through
 ``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
@@ -166,6 +179,15 @@ def main() -> int:
                                       "throughput"), default="balanced")
     ap.add_argument("--admission", choices=("reserve", "lazy"),
                     default="reserve")
+    ap.add_argument("--attention", choices=("gather", "paged"),
+                    default="gather",
+                    help="decode-attention path: gather = dense "
+                         "per-slot cache reconstruction (reference); "
+                         "paged = fused Pallas page-streaming kernel")
+    ap.add_argument("--ab-attention", action="store_true",
+                    help="continuous engine with BOTH attention paths "
+                         "on the same workload; stamp both + the "
+                         "paged_over_gather ratio")
     ap.add_argument("--static", action="store_true",
                     help="static-batching baseline instead of "
                          "continuous")
@@ -185,6 +207,9 @@ def main() -> int:
             args.new_min < 1 or args.new_max < args.new_min:
         ap.error("need 1 <= prompt-min <= prompt-max and "
                  "1 <= new-min <= new-max")
+    if args.ab_attention and (args.ab or args.static):
+        ap.error("--ab-attention is exclusive with --ab/--static (one "
+                 "A/B per record)")
 
     from horovod_tpu.serve import ServeConfig
 
@@ -199,13 +224,14 @@ def main() -> int:
         page_size=ps, num_pages=num_pages,
         decode_slots=args.decode_slots,
         prefill_chunk=args.prefill_chunk, policy=args.policy,
-        slo=args.slo, admission=args.admission)
+        slo=args.slo, admission=args.admission,
+        attention=args.attention)
 
     params = build_params(args, lmax)
     workload = make_workload(args)
 
-    def lane(runner, tag):
-        eng = runner(params, cfg, workload)
+    def lane(runner, tag, lane_cfg=cfg):
+        eng = runner(params, lane_cfg, workload)
         stats = eng.stats()
         print(f"[serve_bench] {tag}: "
               f"{stats['tokens_per_sec_per_chip']} tok/s/chip, "
@@ -223,7 +249,23 @@ def main() -> int:
         return stats
 
     serve: dict
-    if args.ab:
+    if args.ab_attention:
+        import dataclasses
+
+        gat = lane(run_continuous, "attention=gather",
+                   dataclasses.replace(cfg, attention="gather"))
+        pag = lane(run_continuous, "attention=paged",
+                   dataclasses.replace(cfg, attention="paged"))
+        ratio = None
+        if gat["tokens_per_sec_per_chip"] and \
+                pag["tokens_per_sec_per_chip"]:
+            ratio = round(pag["tokens_per_sec_per_chip"]
+                          / gat["tokens_per_sec_per_chip"], 3)
+        mode, headline = "ab_attention", pag
+        serve = dict(pag, mode="ab_attention",
+                     ab_attention={"gather": gat,
+                                   "paged_over_gather": ratio})
+    elif args.ab:
         cont = lane(run_continuous, "continuous")
         stat = lane(run_static, "static")
         ratio = None
@@ -254,7 +296,10 @@ def main() -> int:
             "decode_slots": args.decode_slots,
             "prefill_chunk": args.prefill_chunk,
             "policy": args.policy, "slo": args.slo,
-            "admission": args.admission, "rate": args.rate,
+            "admission": args.admission,
+            "attention": ("ab" if args.ab_attention
+                          else args.attention),
+            "rate": args.rate,
             "requests": args.requests,
         },
     }), flush=True)
