@@ -42,6 +42,15 @@ DEFAULT_NEGOTIATION_TIMEOUT_SECS = 0.0
 # 2% overhead budget at the default; docs/elastic.md has the cadence
 # math (HOROVOD_SNAPSHOT_EVERY).
 DEFAULT_SNAPSHOT_EVERY = 100
+# Supervisor health-watchdog deadline (HOROVOD_WATCHDOG_TIMEOUT,
+# seconds): a rank whose per-window-boundary heartbeat goes stale past
+# this is killed, classified "stalled" and the job relaunched from the
+# last snapshot. FINITE by default — unlike HOROVOD_NEGOTIATION_TIMEOUT
+# (0 = wait forever, the reference's semantics), a silent stall under
+# --elastic must terminate. Must exceed the slowest window-boundary
+# interval; 300 s covers real training windows with wide margin.
+# 0 disables the watchdog.
+DEFAULT_WATCHDOG_TIMEOUT_SECS = 300.0
 
 
 def _env_bool(name: str) -> bool:
@@ -107,6 +116,10 @@ class Config:
     negotiation_timeout_secs: float = DEFAULT_NEGOTIATION_TIMEOUT_SECS
     # Elastic snapshot cadence (HOROVOD_SNAPSHOT_EVERY, steps).
     snapshot_every: int = DEFAULT_SNAPSHOT_EVERY
+    # Supervisor health-watchdog deadline (HOROVOD_WATCHDOG_TIMEOUT,
+    # seconds; 0 disables). Stale-heartbeat workers are killed and the
+    # incident classified "stalled".
+    watchdog_timeout_secs: float = DEFAULT_WATCHDOG_TIMEOUT_SECS
     # Hierarchical collectives: on TPU this selects the explicit two-level
     # ladder (reduce-scatter in the fast domain, cross-reduce, all-gather)
     # rather than NCCL+MPI staging (reference semantics:
@@ -149,6 +162,9 @@ class Config:
             ),
             snapshot_every=_env_int(
                 "HOROVOD_SNAPSHOT_EVERY", DEFAULT_SNAPSHOT_EVERY
+            ),
+            watchdog_timeout_secs=_env_float(
+                "HOROVOD_WATCHDOG_TIMEOUT", DEFAULT_WATCHDOG_TIMEOUT_SECS
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),

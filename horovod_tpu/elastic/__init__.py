@@ -40,15 +40,18 @@ grammar and the preemption runbook.
 """
 
 from horovod_tpu.elastic.faults import (FaultAction, FaultInjector,
-                                        FaultPlanError, parse_fault_plan)
+                                        FaultPlanError, parse_fault_plan,
+                                        resize_requests)
 from horovod_tpu.elastic.loop import ShardedBatchSource, run_elastic
-from horovod_tpu.elastic.signals import EXIT_PREEMPTED, PreemptionHandler
+from horovod_tpu.elastic.signals import (EXIT_PREEMPTED, Heartbeat,
+                                         PreemptionHandler)
 from horovod_tpu.elastic.snapshot import (ResumeManifest, Snapshotter,
                                           latest_manifest, manifest_steps,
                                           read_manifest, write_manifest)
-from horovod_tpu.elastic.supervisor import supervise
-from horovod_tpu.run.driver import (EXIT_CLEAN, EXIT_USAGE, WorkerExit,
-                                    classify_exit)
+from horovod_tpu.elastic.supervisor import (HealthWatchdog,
+                                            slots_file_capacity, supervise)
+from horovod_tpu.run.driver import (EXIT_CLEAN, EXIT_RESIZED, EXIT_USAGE,
+                                    WorkerExit, classify_exit)
 
 __all__ = [
     "run_elastic",
@@ -60,14 +63,19 @@ __all__ = [
     "latest_manifest",
     "manifest_steps",
     "PreemptionHandler",
+    "Heartbeat",
+    "HealthWatchdog",
     "FaultInjector",
     "FaultAction",
     "FaultPlanError",
     "parse_fault_plan",
+    "resize_requests",
     "supervise",
+    "slots_file_capacity",
     "classify_exit",
     "WorkerExit",
     "EXIT_CLEAN",
     "EXIT_PREEMPTED",
+    "EXIT_RESIZED",
     "EXIT_USAGE",
 ]

@@ -17,8 +17,15 @@ Grammar (semicolon-separated actions)::
             stall    | stop making progress for `secs` (default: forever)
                      | — exercises the bounded-deadline path
                      | (HOROVOD_NEGOTIATION_TIMEOUT -> HorovodTimeoutError)
+                     | and the supervisor's heartbeat watchdog
             exit     | plain sys.exit(`code`) (default 1)
-    rank    which global rank fires the action (required)
+            resize   | drain -> final snapshot -> exit EXIT_RESIZED (76);
+                     | the elastic supervisor relaunches the world at
+                     | `n` ranks (the deterministic shrink/grow lane —
+                     | the supervisor reads the same plan, so no side
+                     | channel carries the requested size)
+    rank    which global rank fires the action (required, except
+            resize: defaults to 0, the resume-authority rank)
     step    the training step BOUNDARY at or after which it fires
             (required; window loops hit the first boundary >= step)
     attempt which elastic launch attempt it fires on (default 0: the
@@ -26,6 +33,7 @@ Grammar (semicolon-separated actions)::
             supervisor exports HOROVOD_ELASTIC_RESTART)
     secs    stall duration (stall only)
     code    exit code (exit only)
+    n       requested world size (resize only; required, >= 1)
 
 The plan is parsed (and validated fail-fast) by the launcher
 (``hvdrun --fault-plan``), threaded to workers through the environment,
@@ -44,9 +52,9 @@ import sys
 import time
 from typing import List, Optional
 
-KINDS = ("kill", "preempt", "stall", "exit")
+KINDS = ("kill", "preempt", "stall", "exit", "resize")
 
-_INT_KEYS = ("rank", "step", "attempt", "code")
+_INT_KEYS = ("rank", "step", "attempt", "code", "n")
 _FLOAT_KEYS = ("secs",)
 
 
@@ -63,6 +71,7 @@ class FaultAction:
     attempt: int = 0
     secs: Optional[float] = None   # stall duration; None = forever
     code: int = 1                  # exit code (kind="exit")
+    n: Optional[int] = None        # requested world size (kind="resize")
 
     def __str__(self) -> str:
         extra = ""
@@ -70,6 +79,8 @@ class FaultAction:
             extra = f",secs={self.secs:g}"
         if self.kind == "exit":
             extra = f",code={self.code}"
+        if self.kind == "resize":
+            extra = f",n={self.n}"
         return (f"{self.kind}:rank={self.rank},step={self.step}"
                 f",attempt={self.attempt}{extra}")
 
@@ -100,7 +111,7 @@ def parse_fault_plan(plan: str) -> List[FaultAction]:
                 raise FaultPlanError(
                     f"fault plan clause {clause!r}: bad key/value "
                     f"{pair.strip()!r} (keys: rank, step, attempt, "
-                    "secs, code)")
+                    "secs, code, n)")
             try:
                 kv[key] = (float(value) if key in _FLOAT_KEYS
                            else int(value))
@@ -108,15 +119,55 @@ def parse_fault_plan(plan: str) -> List[FaultAction]:
                 raise FaultPlanError(
                     f"fault plan clause {clause!r}: {key}={value!r} is "
                     "not a number") from None
-        if "rank" not in kv or "step" not in kv:
+        if "step" not in kv or ("rank" not in kv and kind != "resize"):
             raise FaultPlanError(
                 f"fault plan clause {clause!r}: rank= and step= are "
                 "required")
+        if kind == "resize":
+            # rank defaults to 0: a resize is world-orchestration, and
+            # rank 0 (the resume authority) is the natural drainer.
+            kv.setdefault("rank", 0)
+            if "n" not in kv:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: resize requires n= "
+                    "(the world size to relaunch at)")
+            if kv["n"] < 1:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: n={kv['n']} — the "
+                    "resized world must keep at least one rank")
+        elif "n" in kv:
+            raise FaultPlanError(
+                f"fault plan clause {clause!r}: n= only applies to "
+                "resize actions")
         actions.append(FaultAction(
             kind=kind, rank=kv["rank"], step=kv["step"],
             attempt=kv.get("attempt", 0), secs=kv.get("secs"),
-            code=kv.get("code", 1)))
+            code=kv.get("code", 1), n=kv.get("n")))
+    _check_resize_unambiguous(actions)
     return actions
+
+
+def _check_resize_unambiguous(actions: List[FaultAction]) -> None:
+    """At most one resize per attempt: the supervisor maps an
+    EXIT_RESIZED incident on attempt A back to THE resize clause armed
+    for A — two clauses would make the requested size ambiguous."""
+    seen = {}
+    for a in actions:
+        if a.kind != "resize":
+            continue
+        if a.attempt in seen:
+            raise FaultPlanError(
+                f"fault plan: two resize actions on attempt {a.attempt} "
+                f"({seen[a.attempt]} and {a}) — the relaunch size would "
+                "be ambiguous; scope each resize to its own attempt")
+        seen[a.attempt] = a
+
+
+def resize_requests(actions: List[FaultAction]) -> dict:
+    """``{attempt: n}`` for every resize clause — the supervisor-side
+    read of the plan (both sides parse HOROVOD_FAULT_PLAN, so the
+    requested size needs no worker->supervisor side channel)."""
+    return {a.attempt: a.n for a in actions if a.kind == "resize"}
 
 
 class FaultInjector:
@@ -158,8 +209,10 @@ class FaultInjector:
 
         ``preemption``: an optional
         :class:`horovod_tpu.elastic.signals.PreemptionHandler`; when
-        given, ``preempt`` actions trigger it directly (deterministic,
-        no signal-delivery race) instead of signalling the process.
+        given, ``preempt`` and ``resize`` actions trigger it directly
+        (deterministic, no signal-delivery race) instead of signalling
+        the process — resize with the EXIT_RESIZED status, so the
+        boundary drain + final snapshot happen before the exit.
         """
         if not self._armed:
             return
@@ -184,3 +237,14 @@ class FaultInjector:
             time.sleep(action.secs if action.secs is not None else 10**9)
         elif action.kind == "exit":
             sys.exit(action.code)
+        elif action.kind == "resize":
+            # Same deferred discipline as preempt — the loop drains and
+            # snapshots at this very boundary before exiting — but with
+            # the EXIT_RESIZED status, so the supervisor relaunches at
+            # the plan's requested world size instead of the old one.
+            from horovod_tpu.run.driver import EXIT_RESIZED
+
+            if preemption is not None:
+                preemption.trigger(exit_code=EXIT_RESIZED)
+            else:
+                sys.exit(EXIT_RESIZED)

@@ -17,7 +17,15 @@ step function with the full preemption-tolerance stack:
   ``EXIT_PREEMPTED`` (:mod:`horovod_tpu.elastic.signals`);
 * **fault injection** — ``HOROVOD_FAULT_PLAN`` actions fire at their
   step boundaries (:mod:`horovod_tpu.elastic.faults`), so every one of
-  these paths is CPU-testable.
+  these paths is CPU-testable;
+* **resizing** — a manifest written at a different world size resumes
+  through the watermark remap (:meth:`ShardedBatchSource.resume_step`)
+  with an ``on_resize`` rescale hook, instead of failing — see
+  docs/elastic.md "Resizing the world";
+* **liveness** — a per-rank heartbeat touched at every boundary
+  (:class:`~horovod_tpu.elastic.signals.Heartbeat`) feeds the
+  supervisor's health watchdog, so a silent stall becomes a bounded
+  kill+classify+relaunch instead of an eternal hang.
 
 Windows: ``steps_per_dispatch=K`` compiles K steps into one
 ``lax.scan`` program (:mod:`horovod_tpu.jax.window`); boundaries —
@@ -28,12 +36,13 @@ still be copying a buffer the next dispatch would otherwise reuse.
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
 from horovod_tpu.elastic.faults import FaultInjector
-from horovod_tpu.elastic.signals import PreemptionHandler
+from horovod_tpu.elastic.signals import Heartbeat, PreemptionHandler
 from horovod_tpu.elastic.snapshot import Snapshotter
 
 
@@ -46,6 +55,17 @@ class ShardedBatchSource:
     one integer instead of an iterator pickle. ``cursor(step)`` reports
     the classic ``{"epoch": e, "offset": o}`` per-rank shard position
     for the manifest.
+
+    **The coverage contract.** Within an epoch, rank ``r``'s step ``s``
+    batch occupies positions ``{r + size*(o + j) : j < B}`` of the
+    seeded epoch permutation (``o`` = per-rank offset, ``B`` =
+    ``batch_size``) — so the union over ranks of one global step is the
+    CONTIGUOUS permutation block ``[size*o, size*(o + B))``, and the
+    global stream is a prefix of the permutation consumed ``size*B``
+    samples per step regardless of how it is cut into ranks. That is
+    what makes world resizing well-defined: a resume at a different
+    world size continues the same prefix from the same watermark
+    (:meth:`resume_step`), dropping nothing and repeating nothing.
     """
 
     def __init__(self, arrays: dict, batch_size: int,
@@ -62,24 +82,131 @@ class ShardedBatchSource:
         self.rank, self.size = _resolve(rank, size)
         self.shuffle = shuffle
         self.seed = seed
-        per_rank = -(-self.n // self.size)  # ceil: padded shard length
-        self.steps_per_epoch = max(1, per_rank // self.batch_size)
+        self.steps_per_epoch = self._steps_per_epoch(self.size)
+
+    def _steps_per_epoch(self, size: int) -> int:
+        per_rank = -(-self.n // size)  # ceil: padded shard length
+        return max(1, per_rank // self.batch_size)
+
+    @property
+    def global_batch_size(self) -> int:
+        """Samples the whole world consumes per step (``size * B``)."""
+        return self.size * self.batch_size
 
     def cursor(self, step: int) -> dict:
         return {"epoch": step // self.steps_per_epoch,
                 "offset": (step % self.steps_per_epoch) * self.batch_size,
                 "rank": self.rank, "size": self.size}
 
-    def batch_at(self, step: int) -> dict:
+    def indices_at(self, step: int) -> np.ndarray:
+        """The dataset indices this rank's ``step`` batch selects."""
         from horovod_tpu.data.sharding import shard_indices
 
         cur = self.cursor(step)
         idx = shard_indices(self.n, cur["epoch"], self.rank, self.size,
                             self.shuffle, self.seed)
-        sel = idx[cur["offset"]:cur["offset"] + self.batch_size]
+        return idx[cur["offset"]:cur["offset"] + self.batch_size]
+
+    def batch_at(self, step: int) -> dict:
+        sel = self.indices_at(step)
         return {k: v[sel] for k, v in self.arrays.items()}
 
     __call__ = batch_at
+
+    # --------------------------------------------------- resize support
+
+    def consumed_samples(self, step: int) -> int:
+        """Global-stream watermark: samples the WORLD has consumed after
+        ``step`` completed steps (``step * size * B`` — each epoch
+        consumes ``steps_per_epoch`` such blocks). Invariant under
+        resizing: :meth:`resume_step` maps a manifest written at another
+        world size to the step with the identical watermark."""
+        return step * self.global_batch_size
+
+    def global_positions(self, step: int) -> np.ndarray:
+        """Absolute global-stream positions this rank's ``step`` batch
+        consumes: ``epoch_base + r + size*(o + j)``. The union over
+        ranks of one step is a contiguous watermark interval — the
+        resize e2e tests assert exactly-once coverage over these."""
+        cur = self.cursor(step)
+        epoch_base = cur["epoch"] * self.steps_per_epoch \
+            * self.global_batch_size
+        off = cur["offset"]
+        return (epoch_base + self.rank
+                + self.size * (off + np.arange(self.batch_size)))
+
+    def resume_step(self, manifest_or_cursor) -> int:
+        """Map a cursor written at ANOTHER world size onto this source's
+        step counter — the reshard-resume remap.
+
+        The mapping preserves the global-stream watermark: the old
+        world consumed ``g = offset * size_old`` samples into epoch
+        ``e``; the new world resumes at the step whose watermark is the
+        same point. Exactness requires the watermark to sit on a
+        new-world global-batch boundary (``size_new * B`` must divide
+        ``g``): snapshots land on multiples of the cadence, so choosing
+        ``snapshot_every`` such that ``size_new | cadence * size_old``
+        (e.g. any cadence for a 2→1 shrink; an even cadence for a 2→4
+        grow) makes every snapshot a legal resize point. Off-boundary
+        manifests raise rather than silently dropping or repeating the
+        fractional batch — the no-drop/no-duplicate contract is strict.
+        """
+        cur = getattr(manifest_or_cursor, "cursor", manifest_or_cursor)
+        if not isinstance(cur, dict) or "offset" not in cur:
+            raise ValueError(
+                "resume_step needs the manifest's {epoch, offset, size} "
+                f"cursor (got {cur!r}); write manifests through a "
+                "ShardedBatchSource cursor_fn so resized resumes can "
+                "remap the data stream")
+        epoch, offset = int(cur["epoch"]), int(cur["offset"])
+        old_size = int(cur["size"])
+        B = self.batch_size
+        g = offset * old_size                  # within-epoch watermark
+        if g % self.global_batch_size:
+            raise ValueError(
+                f"cannot reshard-resume: the manifest's within-epoch "
+                f"watermark ({g} samples = offset {offset} x world "
+                f"{old_size}) is not a multiple of the new global batch "
+                f"({self.size} x {B} = {self.global_batch_size}); "
+                "resizing only at snapshot steps where "
+                "new_world*batch divides consumed samples keeps the "
+                "stream exactly-once (docs/elastic.md)")
+        step_in_epoch = g // self.global_batch_size
+        # Any cursor past epoch 0 (or landing exactly on an epoch
+        # boundary) relies on whole past epochs lining up between the
+        # two worlds — if per-epoch sample counts differ, the epochs
+        # before this one consumed different prefixes and NO within-
+        # epoch offset can make the streams agree.
+        epochs_must_match = (epoch > 0
+                             or step_in_epoch == self.steps_per_epoch)
+        if step_in_epoch > self.steps_per_epoch or (
+                epochs_must_match
+                and self._epoch_samples(old_size)
+                != self._epoch_samples(self.size)):
+            raise ValueError(
+                f"cannot reshard-resume: epoch {epoch} consumed {g} "
+                f"samples at world {old_size} but holds only "
+                f"{self._epoch_samples(self.size)} at world {self.size} "
+                f"({self.steps_per_epoch} steps x "
+                f"{self.global_batch_size}); pad the dataset to a "
+                "multiple of lcm(world sizes) x batch so epochs consume "
+                "the same sample count at every size (docs/elastic.md)")
+        if step_in_epoch == self.steps_per_epoch:
+            epoch, step_in_epoch = epoch + 1, 0
+        return epoch * self.steps_per_epoch + step_in_epoch
+
+    def _epoch_samples(self, size: int) -> int:
+        return self._steps_per_epoch(size) * size * self.batch_size
+
+
+def _source_of(batch_for_step) -> Optional[ShardedBatchSource]:
+    """Recover the ShardedBatchSource behind ``batch_for_step`` when the
+    caller passed the source itself or its bound ``batch_at`` — the
+    default provider of manifest cursors and the resize remap."""
+    if isinstance(batch_for_step, ShardedBatchSource):
+        return batch_for_step
+    owner = getattr(batch_for_step, "__self__", None)
+    return owner if isinstance(owner, ShardedBatchSource) else None
 
 
 def run_elastic(
@@ -100,6 +227,12 @@ def run_elastic(
     on_step: Optional[Callable[[int, Any], None]] = None,
     jit: bool = True,
     final_snapshot: bool = True,
+    world_size: Optional[int] = None,
+    rank: Optional[int] = None,
+    resume_manager=None,
+    remap_step: Optional[Callable[[Any], int]] = None,
+    on_resize: Optional[Callable[[int, int, Any], Any]] = None,
+    heartbeat: Optional[Heartbeat] = None,
 ) -> Tuple[Any, List[Tuple[int, Any]], int]:
     """Run ``num_steps`` of ``step_fn`` with snapshots and auto-resume.
 
@@ -114,11 +247,37 @@ def run_elastic(
     invocation actually ran, and ``resumed_from`` the snapshot step the
     run restored (0 = fresh start). ``on_step`` is called with the same
     pair after each window (streaming logs that survive a kill).
+
+    **Resizing.** A manifest written at a different world size is a
+    first-class resume, not an error: ``resume_manager`` names the
+    authority checkpoint directory every rank restores from (rank 0's,
+    per the restore-then-re-broadcast discipline — new ranks of a grown
+    world have no history of their own); ``remap_step`` maps the
+    manifest onto this world's step counter (defaults to the batch
+    source's :meth:`ShardedBatchSource.resume_step` watermark remap);
+    ``on_resize(old_world, new_world, state) -> state`` is the
+    per-world-change hook — rescale the learning rate / effective batch
+    there, mirroring reference Horovod's elastic state callbacks. RNG
+    folding stays a pure function of ``(step, rank, world)``, so a
+    resized run is reproducible given the same resize schedule.
+
+    ``world_size``/``rank`` default from ``HOROVOD_SIZE``/
+    ``HOROVOD_RANK`` and stamp the manifests this loop writes.
+    ``heartbeat`` (default: from ``HOROVOD_HEARTBEAT_DIR`` when the
+    elastic supervisor set it) is touched at every window boundary so
+    the supervisor's health watchdog can tell a slow window from a
+    silent stall.
     """
+    import os as _os
+
     import jax
 
     from horovod_tpu.jax.window import stack_batches, windowed
 
+    if world_size is None:
+        world_size = int(_os.environ.get("HOROVOD_SIZE", "1"))
+    if rank is None:
+        rank = int(_os.environ.get("HOROVOD_RANK", "0"))
     k = max(1, int(steps_per_dispatch))
     if num_steps % k:
         raise ValueError(
@@ -126,34 +285,71 @@ def run_elastic(
             f"steps_per_dispatch {k}")
     if snapshotter is None:
         snapshotter = Snapshotter(manager, every=snapshot_every,
-                                  spill_every=spill_every)
+                                  spill_every=spill_every, rank=rank,
+                                  world_size=world_size)
     snapshotter.check_alignment(k)
     if injector is None:
         injector = FaultInjector.from_env()
     own_handler = preemption is None
     if own_handler:
         preemption = PreemptionHandler()
+    if heartbeat is None:
+        heartbeat = Heartbeat.from_env()
+    source = _source_of(batch_for_step)
     if cursor_fn is None:
-        cursor_fn = getattr(batch_for_step, "cursor", lambda s: s)
+        cursor_fn = (source.cursor if source is not None
+                     else getattr(batch_for_step, "cursor", lambda s: s))
+    if remap_step is None and source is not None:
+        remap_step = source.resume_step
 
     # ---- resume -----------------------------------------------------
     # Gate on the SNAPSHOTTER's manager: a caller passing a pre-built
     # Snapshotter(manager=...) must resume too, not just spill.
     # (restore itself returns None when there is no manager anywhere.)
+    # With resume_manager given, restore goes through THAT directory —
+    # the world's authority snapshot — while spills keep landing in
+    # this rank's own manager.
     resumed_from = 0
-    restored = snapshotter.restore(state)
+    restore_snap = snapshotter
+    if resume_manager is not None:
+        restore_snap = Snapshotter(resume_manager, every=snapshotter.every,
+                                   spill_every=snapshotter.spill_every,
+                                   rank=rank, world_size=world_size)
+    restored = restore_snap.restore(state)
     if restored is not None:
         state, manifest = restored
-        resumed_from = manifest.step
+        if manifest.world_size != world_size:
+            if remap_step is None:
+                raise ValueError(
+                    f"manifest was written at world size "
+                    f"{manifest.world_size} but this run has "
+                    f"{world_size} ranks; a reshard resume needs a "
+                    "remap_step (use a ShardedBatchSource — its "
+                    "resume_step remaps the data cursor — or pass "
+                    "remap_step= explicitly; docs/elastic.md "
+                    "\"Resizing the world\")")
+            resumed_from = int(remap_step(manifest))
+            print(f"[hvd elastic] reshard resume: manifest step "
+                  f"{manifest.step} @ world {manifest.world_size} -> "
+                  f"step {resumed_from} @ world {world_size}",
+                  file=sys.stderr, flush=True)
+            if on_resize is not None:
+                resized = on_resize(manifest.world_size, world_size,
+                                    state)
+                if resized is not None:
+                    state = resized
+        else:
+            resumed_from = manifest.step
         if manifest.rng_key is not None and rng_key is not None:
             rng_key = jax.numpy.asarray(
                 manifest.rng(), dtype=np.asarray(rng_key).dtype)
         if resumed_from % k:
             raise ValueError(
-                f"manifest step {resumed_from} is not a window "
-                f"boundary for steps_per_dispatch {k} — it was written "
-                "by a loop with a different window size; rerun with "
-                "the original steps_per_dispatch")
+                f"resume step {resumed_from} is not a window "
+                f"boundary for steps_per_dispatch {k} — the manifest "
+                "was written by a loop with a different window size "
+                "(or a resize remap landed off-window); rerun with a "
+                "compatible steps_per_dispatch")
 
     window_fn = windowed(step_fn, k)
     if jit:
@@ -167,6 +363,11 @@ def run_elastic(
 
     metrics_out: List[Tuple[int, Any]] = []
     step = resumed_from
+    # NOTE: deliberately no heartbeat touch before the first window —
+    # the first dispatch includes the XLA compile, which can dwarf any
+    # sane watchdog timeout; a rank becomes *watched* only once its
+    # first window completes (the Heartbeat/HealthWatchdog existence
+    # rule), so compiling is never mistaken for stalling.
     try:
         while step < num_steps:
             injector.maybe_inject(step, preemption=preemption)
@@ -182,6 +383,8 @@ def run_elastic(
             step += k
             snapshotter.maybe(step, state, **_aux(step))
             metrics_out.append((step, metrics))
+            if heartbeat is not None:
+                heartbeat.touch(step)
             if on_step is not None:
                 on_step(step, metrics)
         # One final boundary: a preemption that arrived during the last

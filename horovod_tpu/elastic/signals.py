@@ -20,13 +20,15 @@ here:
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 from typing import Iterable, Optional
 
-from horovod_tpu.run.driver import EXIT_PREEMPTED  # canonical home
+from horovod_tpu.run.driver import (EXIT_PREEMPTED,  # canonical home
+                                    EXIT_RESIZED)
 
-__all__ = ["PreemptionHandler", "EXIT_PREEMPTED"]
+__all__ = ["PreemptionHandler", "Heartbeat", "EXIT_PREEMPTED"]
 
 
 class PreemptionHandler:
@@ -50,6 +52,9 @@ class PreemptionHandler:
                  install: bool = True):
         self.triggered = False
         self.signum: Optional[int] = None
+        #: exit status finalize() uses; a resize trigger overrides it
+        #: with EXIT_RESIZED so the supervisor sees the incident class.
+        self.exit_code: int = EXIT_PREEMPTED
         self._signals = tuple(signals)
         self._previous: dict = {}
         self._installed = False
@@ -78,15 +83,21 @@ class PreemptionHandler:
         self.triggered = True
         self.signum = signum
 
-    def trigger(self) -> None:
-        """Programmatic preemption request (same deferred semantics)."""
+    def trigger(self, exit_code: Optional[int] = None) -> None:
+        """Programmatic preemption request (same deferred semantics).
+        ``exit_code`` overrides the finalize status — the resize fault
+        action passes EXIT_RESIZED so the drain + final snapshot run
+        exactly like a preemption but the supervisor relaunches at the
+        requested world size."""
         self.triggered = True
+        if exit_code is not None:
+            self.exit_code = exit_code
 
     def check(self) -> bool:
         return self.triggered
 
     def finalize(self, snapshotter, step: int, state,
-                 exit_code: int = EXIT_PREEMPTED, _exit=sys.exit,
+                 exit_code: Optional[int] = None, _exit=sys.exit,
                  **aux) -> None:
         """Boundary-time preemption epilogue; does not return.
 
@@ -99,12 +110,16 @@ class PreemptionHandler:
         """
         import jax
 
+        if exit_code is None:
+            exit_code = self.exit_code
         state = jax.block_until_ready(state)
         if snapshotter is not None:
             snapshotter.flush(step, state, **aux)
-        print(f"[hvd elastic] preemption (signal {self.signum}): drained "
+        kind = {EXIT_PREEMPTED: "preemption",
+                EXIT_RESIZED: "resize"}.get(exit_code, f"exit {exit_code}")
+        print(f"[hvd elastic] {kind} (signal {self.signum}): drained "
               f"and snapshotted at step {step}; exiting "
-              f"{exit_code} (preempted)", file=sys.stderr, flush=True)
+              f"{exit_code}", file=sys.stderr, flush=True)
         self.uninstall()
         _exit(exit_code)
 
@@ -115,3 +130,49 @@ class PreemptionHandler:
     def __exit__(self, *exc) -> bool:
         self.uninstall()
         return False
+
+
+class Heartbeat:
+    """Worker-side liveness beacon for the supervisor's health watchdog.
+
+    The elastic supervisor exports ``HOROVOD_HEARTBEAT_DIR``; each rank
+    touches its per-rank file (``hb-<rank>``) at every window boundary
+    — the same cadence snapshots, preemption checks and fault injection
+    already use. The supervisor's :class:`~horovod_tpu.elastic.
+    supervisor.HealthWatchdog` stats the mtimes: a rank whose file goes
+    stale past the watchdog timeout is killed, classified *stalled* and
+    relaunched — converting the today-unrecoverable silent hang (a
+    ``stall:`` fault, a wedged collective below
+    ``HOROVOD_NEGOTIATION_TIMEOUT``'s reach) into one bounded incident.
+
+    A rank is only *watched* once its file exists — and the elastic
+    loop takes its FIRST touch after the first window completes, so
+    processes that are importing jax, compiling the first window, or
+    never running the elastic loop at all are never killed for
+    silence (the flip side: a stall before any window completes is
+    outside the watchdog's reach). The touch is one tiny write — no
+    device sync, no collective — cheap enough for every boundary.
+    """
+
+    FILE_FMT = "hb-{rank}"
+
+    def __init__(self, directory: str, rank: Optional[int] = None):
+        if rank is None:
+            rank = int(os.environ.get("HOROVOD_RANK", "0"))
+        self.rank = rank
+        self.directory = directory
+        self.path = os.path.join(directory, self.FILE_FMT.format(rank=rank))
+        os.makedirs(directory, exist_ok=True)
+
+    @classmethod
+    def from_env(cls) -> Optional["Heartbeat"]:
+        """A heartbeat bound to ``HOROVOD_HEARTBEAT_DIR``, or None when
+        the job runs unsupervised (no watchdog, nothing to feed)."""
+        directory = os.environ.get("HOROVOD_HEARTBEAT_DIR", "")
+        return cls(directory) if directory else None
+
+    def touch(self, step: Optional[int] = None) -> None:
+        """Stamp liveness (mtime is the signal; the step content is for
+        humans debugging a stale file)."""
+        with open(self.path, "w") as f:
+            f.write(f"{self.rank} {step if step is not None else ''}\n")

@@ -50,8 +50,11 @@ class ResumeManifest:
     per-rank data-shard position (:mod:`horovod_tpu.data.sharding` is
     deterministic in ``(seed, epoch, rank, size)``, so
     ``{"epoch": e, "offset": o}`` pins every rank's stream). ``rank``
-    records the writer; ``world_size`` guards against resuming into a
-    different world shape than the shards were cut for.
+    records the writer; ``world_size`` records the world the shards
+    were cut for — a resume into a DIFFERENT world size remaps the
+    cursor through :meth:`~horovod_tpu.elastic.loop.ShardedBatchSource.
+    resume_step` (the reshard path; docs/elastic.md "Resizing the
+    world") instead of rejecting the manifest.
     """
 
     step: int
@@ -159,8 +162,9 @@ class Snapshotter:
     """
 
     def __init__(self, manager=None, every: Optional[int] = None,
-                 spill_every: int = 1, rank: int = 0,
-                 world_size: int = 1, attempt: Optional[int] = None):
+                 spill_every: int = 1, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 attempt: Optional[int] = None):
         from horovod_tpu.common.config import DEFAULT_SNAPSHOT_EVERY
 
         if every is None:
@@ -176,6 +180,13 @@ class Snapshotter:
                 f"spill_every must be >= 1, got {spill_every}")
         if attempt is None:
             attempt = int(os.environ.get("HOROVOD_ELASTIC_RESTART", "0"))
+        # Manifests must record the TRUE world shape (the reshard-resume
+        # remap runs off it), so default from the launcher environment,
+        # not a hardcoded single-rank world.
+        if rank is None:
+            rank = int(os.environ.get("HOROVOD_RANK", "0"))
+        if world_size is None:
+            world_size = int(os.environ.get("HOROVOD_SIZE", "1"))
         self.manager = manager
         self.every = int(every)
         self.spill_every = int(spill_every)

@@ -206,17 +206,28 @@ class ElasticSnapshotCallback(Callback):
     loop can restore via ``snapshotter.restore(state)`` before ``fit``.
     """
 
-    def __init__(self, snapshotter, preemption=None, step_counter=None):
+    def __init__(self, snapshotter, preemption=None, step_counter=None,
+                 heartbeat=None):
         self.snapshotter = snapshotter
         self.preemption = preemption
         self.step_counter = (step_counter
                              or (lambda state: int(state["step"])))
+        self.heartbeat = heartbeat
 
     def on_train_begin(self, logs=None):
         if self.preemption is None:
             from horovod_tpu.elastic.signals import PreemptionHandler
 
             self.preemption = PreemptionHandler()
+        if self.heartbeat is None:
+            # Feed the supervisor's health watchdog when supervised
+            # (HOROVOD_HEARTBEAT_DIR exported by hvdrun --elastic);
+            # None when unsupervised.
+            from horovod_tpu.elastic.signals import Heartbeat
+
+            self.heartbeat = Heartbeat.from_env()
+        # No touch here: the first batch includes the jit compile, and
+        # a rank only becomes watched once a real boundary passes.
 
     def on_batch_end(self, batch, logs=None):
         step = self.step_counter(self.loop.state)
@@ -226,6 +237,8 @@ class ElasticSnapshotCallback(Callback):
             self.preemption.finalize(self.snapshotter, step,
                                      self.loop.state)
         self.snapshotter.maybe(step, self.loop.state)
+        if self.heartbeat is not None:
+            self.heartbeat.touch(step)
 
     def on_train_end(self, logs=None):
         self.snapshotter.flush(self.step_counter(self.loop.state),

@@ -31,7 +31,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import dataclasses
 
 from horovod_tpu.run.driver import (Driver, WorkerExit, classify_exit,
-                                    EXIT_CLEAN, EXIT_PREEMPTED, EXIT_USAGE)
+                                    EXIT_CLEAN, EXIT_PREEMPTED,
+                                    EXIT_RESIZED, EXIT_USAGE)
 from horovod_tpu.run.network import make_secret_key
 
 
@@ -47,10 +48,21 @@ class JobResult:
     codes instead of the single collapsed code the kill-all used to
     return. ``trigger`` is the first worker observed failing (the one
     whose death caused the kill-all); the other ranks' codes then
-    reflect the supervisor's SIGTERM, not their own fault."""
+    reflect the supervisor's SIGTERM, not their own fault.
+    ``stalled_ranks`` maps each rank the health watchdog killed for a
+    stale heartbeat to the observed heartbeat age (the time-to-detect
+    evidence the elastic recovery metrics stamp). ``pre_kill_codes``
+    holds every non-clean exit observed BEFORE the kill-all — these
+    ranks died on their own, so (unlike ``exit_codes``, polluted by
+    the teardown SIGTERMs) they tell the elastic supervisor how many
+    workers were actually lost when it decides the shrink size."""
 
     exit_codes: Dict[int, Optional[int]]
     trigger: Optional[WorkerExit] = None
+    stalled_ranks: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
+    pre_kill_codes: Dict[int, int] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def code(self) -> int:
@@ -58,8 +70,11 @@ class JobResult:
 
     @property
     def category(self) -> str:
-        """clean | usage | preempted | crashed — the trigger worker's
-        classification (see run.driver.classify_exit)."""
+        """clean | usage | preempted | resized | stalled | crashed —
+        the trigger worker's classification (run.driver.classify_exit,
+        plus the watchdog's stalled mark)."""
+        if self.trigger is not None:
+            return self.trigger.category
         return classify_exit(self.code)
 
     def describe(self) -> str:
@@ -239,15 +254,23 @@ def launch_command(cmd: Sequence[str], np: int,
 def launch_job(cmd: Sequence[str], np: int,
                hosts: Optional[str] = None,
                env: Optional[Dict[str, str]] = None,
-               jax_distributed: bool = False) -> JobResult:
+               jax_distributed: bool = False,
+               watchdog=None) -> JobResult:
     """Run ``cmd`` as an N-rank job; returns a :class:`JobResult` with
     per-worker exit codes and the classified trigger failure.
 
     Fails fast: the first non-zero rank kills the rest (the reference
     relied on mpirun for exactly this) — but unlike the reference's
     collapsed mpirun code, the result records WHICH rank died and HOW
-    (clean / usage / preempted / crashed), so the elastic supervisor
-    can decide relaunch-vs-fail per incident.
+    (clean / usage / preempted / resized / stalled / crashed), so the
+    elastic supervisor can decide relaunch-vs-fail per incident.
+
+    ``watchdog`` (an :class:`~horovod_tpu.elastic.supervisor.
+    HealthWatchdog` or anything with its ``check(ranks) -> {rank:
+    age}``) rides this supervision poll: ranks it reports as
+    heartbeat-stale are SIGKILLed here and their exits marked
+    *stalled* — a silently-hung worker becomes an ordinary classified
+    incident instead of an eternal wait.
 
     ``jax_distributed``: also stand up a jax coordination service address
     (HOROVOD_JAX_COORDINATOR) so each worker's ``hvd.init()`` joins one
@@ -297,22 +320,52 @@ def launch_job(cmd: Sequence[str], np: int,
             else:
                 procs.append(_spawn_ssh(host, cmd, wenv))
         # Supervise: poll until all exit or one fails.
+        stalled: Dict[int, float] = {}
         while True:
             codes = [p.poll() for p in procs]
+            if watchdog is not None:
+                live = [r for r, c in enumerate(codes) if c is None]
+                for rank, age in watchdog.check(live).items():
+                    # A stale heartbeat means the worker is silently
+                    # wedged — possibly mid-collective, where SIGTERM's
+                    # graceful drain would hang too. SIGKILL converts
+                    # the hang into a classifiable incident.
+                    print(f"hvdrun: health watchdog: rank {rank} "
+                          f"heartbeat stale for {age:.1f}s (timeout "
+                          f"{watchdog.timeout:g}s) — killing the "
+                          "stalled worker", file=sys.stderr, flush=True)
+                    stalled[rank] = age
+                    watchdog.kills[rank] = age
+                    try:
+                        os.killpg(os.getpgid(procs[rank].pid),
+                                  signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                if stalled:
+                    codes = [p.poll() for p in procs]
             bad_ranks = [r for r, c in enumerate(codes)
                          if c not in (None, 0)]
             if bad_ranks:
                 # The lowest failing rank at this poll is the trigger;
                 # its code (not the peers' kill-all SIGTERMs) classifies
-                # the incident. Record every code observed BEFORE the
-                # kill so self-inflicted exits stay distinguishable.
-                trigger = WorkerExit(bad_ranks[0], codes[bad_ranks[0]])
+                # the incident — a watchdog-killed rank wins the tie so
+                # the incident is classed *stalled*, not by whatever
+                # exit its SIGKILL raced. Record every code observed
+                # BEFORE the kill so self-inflicted exits stay
+                # distinguishable.
+                first = min((r for r in bad_ranks if r in stalled),
+                            default=bad_ranks[0])
+                trigger = WorkerExit(first, codes[first],
+                                     stalled=first in stalled)
+                pre_kill = {r: c for r, c in enumerate(codes)
+                            if c not in (None, 0)}
                 _kill_all(procs)
                 _drain_output(procs)
                 return JobResult(
                     exit_codes={r: p.poll()
                                 for r, p in enumerate(procs)},
-                    trigger=trigger)
+                    trigger=trigger, stalled_ranks=dict(stalled),
+                    pre_kill_codes=pre_kill)
             if all(c == 0 for c in codes):
                 _drain_output(procs)
                 return JobResult(
@@ -387,4 +440,4 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
 
 __all__ = ["run", "launch_command", "launch_job", "JobResult",
            "WorkerExit", "classify_exit", "LaunchError",
-           "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_USAGE"]
+           "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_RESIZED", "EXIT_USAGE"]

@@ -96,16 +96,25 @@ EXIT_USAGE = 2
 #: reclaim), drained, wrote its final snapshot and exited on purpose.
 #: 75 = EX_TEMPFAIL from sysexits.h — "transient, retry later".
 EXIT_PREEMPTED = 75
+#: Resized: the worker drained, wrote its final snapshot and exited on
+#: purpose to request a world resize (the ``resize:`` fault action, or
+#: an external scheduler asking the job to change shape). The elastic
+#: supervisor relaunches at the requested world size without consuming
+#: the restart budget — an orchestrated resize is not a failure.
+EXIT_RESIZED = 76
 
 
 def classify_exit(code) -> str:
-    """Map a worker exit code to ``clean|usage|preempted|crashed``.
+    """Map a worker exit code to ``clean|usage|preempted|resized|crashed``.
 
     Negative codes are subprocess ``-signum`` deaths: ``-SIGTERM`` is
     classed *preempted* (the cluster reclaimed the worker before the
     in-process handler could convert it to :data:`EXIT_PREEMPTED` — same
     recovery either way), every other signal (SIGKILL = OOM-kill or
-    fault-injected crash, SIGSEGV, ...) is *crashed*.
+    fault-injected crash, SIGSEGV, ...) is *crashed*. A sixth category,
+    *stalled*, cannot be derived from the code alone — the health
+    watchdog marks it on the :class:`WorkerExit` when IT was the one
+    that killed the silent worker.
     """
     import signal as _signal
 
@@ -115,18 +124,29 @@ def classify_exit(code) -> str:
         return "usage"
     if code == EXIT_PREEMPTED or code == -_signal.SIGTERM:
         return "preempted"
+    if code == EXIT_RESIZED:
+        return "resized"
     return "crashed"
 
 
 @dataclasses.dataclass
 class WorkerExit:
-    """One worker's observed exit: rank, raw code, classified category."""
+    """One worker's observed exit: rank, raw code, classified category.
+
+    ``stalled`` is set by the launcher when the supervisor's health
+    watchdog killed this worker for a stale heartbeat — the raw code is
+    then the watchdog's SIGKILL, and the *category* reports ``stalled``
+    so the relaunch policy and recovery metrics see the real incident
+    class, not a generic crash."""
 
     rank: int
     code: int
+    stalled: bool = False
 
     @property
     def category(self) -> str:
+        if self.stalled:
+            return "stalled"
         return classify_exit(self.code)
 
 

@@ -39,20 +39,53 @@ def main(argv=None) -> int:
                         help="preemption-tolerant supervision "
                              "(horovod_tpu.elastic): classify each "
                              "worker exit (clean / usage / preempted / "
-                             "crashed), tear down the world and relaunch "
-                             "all ranks; workers resume from the latest "
-                             "snapshot manifest (elastic.run_elastic / "
-                             "Snapshotter). Preemptions (exit 75 or "
-                             "SIGTERM) relaunch for free; crashes consume "
-                             "the --max-restarts budget")
+                             "resized / stalled / crashed), tear down "
+                             "the world and relaunch; workers resume "
+                             "from the latest snapshot manifest "
+                             "(elastic.run_elastic / Snapshotter). "
+                             "Preemptions (exit 75 or SIGTERM) and "
+                             "resizes (exit 76) relaunch for free; "
+                             "crashes and stalls consume the "
+                             "--max-restarts budget")
     parser.add_argument("--max-restarts", type=int, default=1,
                         help="crash-restart budget for --elastic "
                              "(default 1; preemptions don't consume it)")
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="elastic world floor: a preemption "
+                             "relaunches at the surviving rank count "
+                             "(>= this) instead of retrying full size; "
+                             "workers reshard-resume through the "
+                             "manifest cursor remap (default: -np, a "
+                             "fixed world)")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="elastic world ceiling for regrowth "
+                             "(default: -np)")
+    parser.add_argument("--slots-file", default=None,
+                        help="path to a file holding the currently "
+                             "available worker-slot count (kept current "
+                             "by the fleet scheduler/agent); each "
+                             "relaunch clamps the world to min(slots, "
+                             "--max-np), so a shrunken job grows back "
+                             "when capacity returns")
+    parser.add_argument("--watchdog-timeout", type=float, default=None,
+                        help="health-watchdog deadline in seconds: a "
+                             "rank whose heartbeat (touched every "
+                             "window boundary) goes stale past this is "
+                             "killed, classified 'stalled' and the job "
+                             "relaunched (default: "
+                             "HOROVOD_WATCHDOG_TIMEOUT or 300; 0 "
+                             "disables)")
+    parser.add_argument("--metrics-file", default=None,
+                        help="append one PERF_RUNS.tsv-format JSON line "
+                             "of recovery metrics (restarts by class, "
+                             "world trajectory, time-to-detect/"
+                             "relaunch) at job end; rendered by "
+                             "tools/perf_summary.py's elastic column")
     parser.add_argument("--fault-plan", default=None,
                         help="deterministic fault injection plan, e.g. "
-                             "'kill:rank=1,step=7;stall:rank=2,step=12' "
-                             "— validated here, exported to workers as "
-                             "HOROVOD_FAULT_PLAN (grammar: "
+                             "'kill:rank=1,step=7;resize:rank=0,step=9,"
+                             "n=1' — validated here, exported to "
+                             "workers as HOROVOD_FAULT_PLAN (grammar: "
                              "docs/elastic.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
@@ -66,6 +99,15 @@ def main(argv=None) -> int:
     if args.restarts and args.elastic:
         parser.error("--restarts and --elastic are mutually exclusive "
                      "(--elastic already relaunches; use --max-restarts)")
+    for flag in ("min_np", "max_np", "slots_file", "watchdog_timeout",
+                 "metrics_file"):
+        if getattr(args, flag) is not None and not args.elastic:
+            parser.error(f"--{flag.replace('_', '-')} requires --elastic")
+    min_np = args.min_np if args.min_np is not None else args.num_proc
+    max_np = args.max_np if args.max_np is not None else args.num_proc
+    if args.elastic and not 1 <= min_np <= args.num_proc <= max_np:
+        parser.error(f"need 1 <= --min-np ({min_np}) <= -np "
+                     f"({args.num_proc}) <= --max-np ({max_np})")
     env = None
     if args.fault_plan is not None:
         # Validate the grammar HERE so a typo'd plan is a usage error at
@@ -74,19 +116,36 @@ def main(argv=None) -> int:
             parse_fault_plan
 
         try:
-            parse_fault_plan(args.fault_plan)
+            plan = parse_fault_plan(args.fault_plan)
         except FaultPlanError as e:
             parser.error(str(e))
+        if any(a.kind == "resize" for a in plan) and not args.elastic:
+            parser.error("resize: fault actions need --elastic (the "
+                         "supervisor is what relaunches at the new "
+                         "world size)")
+        for a in plan:
+            if a.kind == "resize" and not min_np <= a.n <= max_np:
+                parser.error(
+                    f"fault plan resize n={a.n} is outside the elastic "
+                    f"world bounds [{min_np}, {max_np}]; widen "
+                    "--min-np/--max-np or fix the plan")
         env = dict(os.environ)
         env["HOROVOD_FAULT_PLAN"] = args.fault_plan
     cmd = args.command[1:] if args.command[0] == "--" else args.command
     if args.elastic:
-        from horovod_tpu.elastic.supervisor import supervise
+        from horovod_tpu.elastic.supervisor import (slots_file_capacity,
+                                                    supervise)
 
+        capacity_fn = (slots_file_capacity(args.slots_file)
+                       if args.slots_file else None)
         return supervise(cmd, np=args.num_proc, hosts=args.hosts,
                          env=env, jax_distributed=args.jax_distributed,
                          max_restarts=args.max_restarts,
-                         restart_delay=3.0 if args.hosts else 0.0)
+                         restart_delay=3.0 if args.hosts else 0.0,
+                         min_np=min_np, max_np=max_np,
+                         capacity_fn=capacity_fn,
+                         watchdog_timeout=args.watchdog_timeout,
+                         metrics_path=args.metrics_file)
     for attempt in range(args.restarts + 1):
         rc = launch_command(cmd, np=args.num_proc, hosts=args.hosts,
                             env=env,

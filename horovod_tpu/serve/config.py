@@ -77,6 +77,12 @@ class ServeConfig:
     eos_token: Optional[int] = None
     max_queue: int = 0          # 0 = unbounded
     requeue_evicted: bool = True
+    #: Default per-request deadline in seconds from arrival (None =
+    #: no deadline; a per-request ``ttl=`` overrides). A request still
+    #: unfinished past its deadline is finished with the ``timeout``
+    #: status and its pages freed at the next engine step — one wedged
+    #: or abandoned stream can never hold KV pages forever.
+    default_ttl: Optional[float] = None
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -101,6 +107,10 @@ class ServeConfig:
         if self.attention not in ATTENTIONS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTIONS}")
+        if self.default_ttl is not None and self.default_ttl <= 0:
+            raise ValueError(
+                f"default_ttl must be > 0 seconds (or None), got "
+                f"{self.default_ttl}")
 
     @property
     def in_flight_limit(self) -> int:

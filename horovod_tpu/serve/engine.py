@@ -263,6 +263,7 @@ class ServeEngine:
         self.prefilling: Optional[Request] = None
         self.finished: List[Request] = []
         self.evicted: List[Request] = []    # terminal (requeue off)
+        self.timed_out: List[Request] = []  # terminal (deadline passed)
         self.occupancy_samples: List[float] = []
         #: Per-step decode-lane live-key counts (t+1 per slot, 0 =
         #: idle lane) — the raw input :func:`ops.paged_attention.
@@ -293,16 +294,22 @@ class ServeEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
                eos_token: Optional[int] = None, seed: int = 0,
-               arrival: Optional[float] = None) -> Request:
+               arrival: Optional[float] = None,
+               ttl: Optional[float] = None) -> Request:
         """Queue one generation request; returns it (check ``state`` —
-        ``rejected`` means it can never run or the queue is full)."""
+        ``rejected`` means it can never run or the queue is full).
+        ``ttl`` (seconds from arrival; default ``config.default_ttl``)
+        bounds how long the request may live: past it, the request is
+        finished with the ``timeout`` status and its pages freed."""
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       eos_token=eos_token
                       if eos_token is not None else self.config.eos_token,
                       seed=seed,
                       arrival=arrival if arrival is not None
-                      else self.clock())
+                      else self.clock(),
+                      ttl=ttl if ttl is not None
+                      else self.config.default_ttl)
         self.scheduler.submit(req)
         return req
 
@@ -329,20 +336,50 @@ class ServeEngine:
     def _do_evict(self, victim: Request) -> None:
         """Release a victim's pages and remove it from service; requeue
         (recompute path) or terminate per config."""
-        self.scheduler.release(victim)
+        self._remove_from_service(victim)
         victim.evictions += 1
-        for i, s in enumerate(self.slots):
-            if s is victim:
-                self.slots[i] = None
-        self.ready = [r for r in self.ready if r is not victim]
-        if self.prefilling is victim:
-            self.prefilling = None
         victim.state = RequestState.EVICTED
         if self.config.requeue_evicted:
             if not self.scheduler.requeue(victim):
                 self._finish(victim)
         else:
             self.evicted.append(victim)
+
+    def _remove_from_service(self, req: Request) -> None:
+        """Release the request's pages and detach it from every service
+        structure (slots, ready, prefill lane) — the shared half of
+        eviction and deadline timeout."""
+        self.scheduler.release(req)
+        for i, s in enumerate(self.slots):
+            if s is req:
+                self.slots[i] = None
+        self.ready = [r for r in self.ready if r is not req]
+        if self.prefilling is req:
+            self.prefilling = None
+
+    def _time_out(self, req: Request, now: float) -> None:
+        """Deadline epilogue: remove from service, mark terminal.
+        Unlike eviction there is no requeue — the client's latency
+        budget is already blown; recomputing for a dead stream would
+        only steal step time from live ones."""
+        self._remove_from_service(req)
+        self.scheduler.drop(req)
+        req.state = RequestState.TIMEOUT
+        req.t_finish = now
+        self.timed_out.append(req)
+
+    def _expire_deadlines(self) -> None:
+        """Sweep every live request (queued included — a request can
+        blow its deadline waiting) at the top of each step; one wedged
+        stream can never hold KV pages past its deadline + one step."""
+        now = self.clock()
+        live = ([s for s in self.slots if s is not None]
+                + list(self.ready)
+                + ([self.prefilling] if self.prefilling else [])
+                + list(self.scheduler.queue))
+        for req in live:
+            if req.expired(now):
+                self._time_out(req, now)
 
     def _evict_for(self, requester: Request) -> bool:
         """Lazy-mode page pressure: evict the newest-admitted request
@@ -426,6 +463,7 @@ class ServeEngine:
         (no active requests and nothing admissible in the queue)."""
         from horovod_tpu.serve.sampling import sample_tokens
 
+        self._expire_deadlines()
         self._promote_ready()
         if self.prefilling is None:
             self.prefilling = self.scheduler.pick_prefill(
@@ -546,6 +584,7 @@ class ServeEngine:
             raise RuntimeError("reset_metrics with requests in flight")
         self.finished = []
         self.evicted = []
+        self.timed_out = []
         self.scheduler.rejected = []
         self.occupancy_samples = []
         self.attn_len_samples = []
@@ -556,7 +595,8 @@ class ServeEngine:
         """Aggregate SLO metrics over every request seen so far."""
         from horovod_tpu.serve.metrics import summarize
 
-        everything = (self.finished + self.evicted + self.ready
+        everything = (self.finished + self.evicted + self.timed_out
+                      + self.ready
                       + [s for s in self.slots if s is not None]
                       + ([self.prefilling] if self.prefilling else [])
                       + self.scheduler.queue + self.scheduler.rejected)

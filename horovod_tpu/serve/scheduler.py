@@ -53,6 +53,10 @@ class RequestState:
     FINISHED = "finished"
     EVICTED = "evicted"
     REJECTED = "rejected"
+    #: Deadline exceeded: finished early with whatever was generated,
+    #: pages freed. Terminal, like FINISHED — the client already gave
+    #: up on the stream; holding its pages would starve live requests.
+    TIMEOUT = "timeout"
 
 
 _rid_counter = itertools.count()
@@ -74,6 +78,10 @@ class Request:                     # tracked by `is` in slot lists
     eos_token: Optional[int] = None
     seed: int = 0
     arrival: float = 0.0
+    #: Deadline in seconds from arrival (None = none). The engine
+    #: times the request out — ``timeout`` status, pages freed — at
+    #: the first step past ``arrival + ttl``.
+    ttl: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
 
     state: str = RequestState.QUEUED
@@ -109,12 +117,23 @@ class Request:                     # tracked by `is` in slot lists
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.ttl is not None and self.ttl <= 0:
+            raise ValueError(f"ttl must be > 0 seconds (or None), got "
+                             f"{self.ttl}")
         if not self.orig_prompt_len:
             self.orig_prompt_len = int(self.prompt.size)
         if not self.orig_max_new:
             self.orig_max_new = int(self.max_new_tokens)
 
     # ------------------------------------------------------ positions
+
+    @property
+    def deadline(self) -> Optional[float]:
+        """Absolute clock time past which the request times out."""
+        return None if self.ttl is None else self.arrival + self.ttl
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
 
     @property
     def prompt_len(self) -> int:
@@ -290,6 +309,12 @@ class Scheduler:
             req.pages = []
         if req.page_table is not None:
             req.page_table[:] = 0
+
+    def drop(self, req: Request) -> None:
+        """Remove a request from the queue (deadline timeout while
+        waiting). Queue membership is this module's invariant — callers
+        must not rebuild ``queue`` themselves."""
+        self.queue = [r for r in self.queue if r is not req]
 
 
 def pick_victim(candidates: Sequence[Request],

@@ -1,0 +1,153 @@
+"""Elastic RESIZE e2e worker: data-parallel training that survives a
+world-size change. Launched by tests/test_elastic.py as::
+
+    hvdrun --elastic --min-np 1 -np 2 --fault-plan "resize:rank=0,step=7,n=1" \
+        python tests/elastic_resize_worker.py OUTDIR CKPTDIR TOTAL_SAMPLES EVERY K
+
+Each rank emulates synchronous data parallelism deterministically: it
+evaluates the GLOBAL batch (every rank's :class:`ShardedBatchSource`
+shard for the step, concatenated) so the train state is replicated
+bit-identically across ranks without cross-process collectives — the
+CPU-testable stand-in for allreduce. That replication is what makes a
+resize well-defined: any rank's snapshot seeds any new world, and every
+rank resumes from rank 0's manifest (``resume_manager`` — the
+restore-then-re-broadcast discipline).
+
+The step budget is expressed in SAMPLES (``TOTAL_SAMPLES``), not steps:
+a world of n ranks runs ``TOTAL_SAMPLES / (B * n)`` steps, so the
+global stream consumed is invariant across resizes — which is exactly
+what the test asserts. Logged per rank:
+
+* ``rank<r>.traj``  — ``step repr(loss)`` per window (bit-exact compare),
+* ``rank<r>.samples`` — ``S <attempt> <size> <step> <watermark> <ids...>``
+  per step: the GLOBAL dataset indices consumed (the rank computes the
+  global gradient, so it genuinely consumes them), with the absolute
+  sample watermark; plus ``Z <old> <new> <lr>`` when the on_resize hook
+  rescales the learning rate,
+* ``rank<r>.final`` — sha256 state digest + the resume step.
+
+The test replays rank 0's lineage: at each attempt, entries at or past
+the attempt's resume watermark belong to a discarded lineage and are
+dropped; what remains must cover the global permutation prefix exactly
+once — the no-drop/no-duplicate resize contract.
+"""
+
+import hashlib
+import os
+import sys
+
+
+def main() -> int:
+    out_dir, ckpt_dir, total_samples, every, k = sys.argv[1:6]
+    total_samples, every, k = int(total_samples), int(every), int(k)
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    attempt = int(os.environ.get("HOROVOD_ELASTIC_RESTART", "0"))
+
+    # Each rank is an independent jax process here (no cross-process CPU
+    # collectives in this jaxlib); force the CPU platform in-process.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu import elastic
+    from horovod_tpu.flax.checkpoint import CheckpointManager
+
+    # Deterministic dataset. N is divisible by every world size under
+    # test times the batch, so epochs consume the same sample count at
+    # every size (the cross-epoch resize contract).
+    root = np.random.RandomState(0)
+    n, d, batch = 512, 4, 4
+    arrays = {"x": root.normal(size=(n, d)).astype(np.float32),
+              "y": root.normal(size=(n, 1)).astype(np.float32)}
+    sources = [elastic.ShardedBatchSource(arrays, batch_size=batch,
+                                          rank=r, size=size, seed=0)
+               for r in range(size)]
+    own = sources[rank]
+    global_batch = batch * size
+    if total_samples % global_batch:
+        raise SystemExit(f"TOTAL_SAMPLES {total_samples} not divisible "
+                         f"by global batch {global_batch}")
+    num_steps = total_samples // global_batch
+
+    def batch_for(step):
+        parts = [s.batch_at(step) for s in sources]
+        return {key: np.concatenate([p[key] for p in parts])
+                for key in parts[0]}
+
+    def step_fn(state, b):
+        def loss_fn(w):
+            pred = b["x"] @ w
+            return jnp.mean((pred - b["y"]) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(state["w"])
+        return ({"w": state["w"] - state["lr"] * g, "lr": state["lr"],
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    state = {"w": jnp.zeros((d, 1), jnp.float32),
+             "lr": jnp.float32(0.05),
+             "step": jnp.zeros((), jnp.int32)}
+
+    os.makedirs(out_dir, exist_ok=True)
+    traj = open(os.path.join(out_dir, f"rank{rank}.traj"), "a")
+    samples = open(os.path.join(out_dir, f"rank{rank}.samples"), "a")
+
+    def on_step(completed, metrics):
+        # repr() keeps full float precision: bit-exact, not approx.
+        traj.write(f"{completed} {float(metrics['loss'])!r}\n")
+        traj.flush()
+        for s in range(completed - k, completed):
+            ids = np.concatenate([src.indices_at(s) for src in sources])
+            watermark = s * global_batch
+            samples.write(f"S {attempt} {size} {s} {watermark} "
+                          + " ".join(str(int(i)) for i in ids) + "\n")
+        samples.flush()
+
+    def on_resize(old_world, new_world, st):
+        # The per-world-change rescale hook: linear LR scaling with the
+        # effective global batch (reference Horovod's elastic-state
+        # callback discipline).
+        st = dict(st)
+        st["lr"] = st["lr"] * (new_world / old_world)
+        samples.write(f"Z {old_world} {new_world} "
+                      f"{float(st['lr'])!r}\n")
+        samples.flush()
+        return st
+
+    own_mngr = CheckpointManager(os.path.join(ckpt_dir, f"rank{rank}"),
+                                 backend="numpy")
+    # Rank 0's directory is the authority every rank restores from — a
+    # grown world's new ranks have no history of their own, and the
+    # survivors of a shrink must agree on ONE resume point.
+    resume_mngr = CheckpointManager(os.path.join(ckpt_dir, "rank0"),
+                                    backend="numpy")
+    try:
+        state, _, resumed = elastic.run_elastic(
+            step_fn, state, batch_for, num_steps,
+            manager=own_mngr, snapshot_every=every, spill_every=1,
+            steps_per_dispatch=k, on_step=on_step,
+            world_size=size, rank=rank,
+            cursor_fn=own.cursor,
+            resume_manager=resume_mngr,
+            remap_step=own.resume_step, on_resize=on_resize)
+    finally:
+        traj.close()
+        samples.close()
+        own_mngr.close()
+        resume_mngr.close()
+
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        digest.update(np.asarray(leaf).tobytes())
+    final = os.path.join(out_dir, f"rank{rank}.final")
+    with open(f"{final}.tmp", "w") as f:
+        f.write(f"{digest.hexdigest()} resumed={resumed}\n")
+    os.replace(f"{final}.tmp", final)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

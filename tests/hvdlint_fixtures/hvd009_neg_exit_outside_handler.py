@@ -1,0 +1,22 @@
+"""NEGATIVE: non-taxonomy exits OUTSIDE handler context are ordinary
+CLI behavior (argparse exits 2 itself; mains exit whatever they like) —
+the rule only polices functions whose exit code reaches the supervisor
+from a registered signal handler or atexit callback."""
+
+import signal
+import sys
+
+
+class FlagOnly:
+    def __init__(self):
+        self.triggered = False
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self.triggered = True
+
+
+def main():
+    if not FlagOnly():
+        sys.exit(13)   # not a handler: fine
+    return 0

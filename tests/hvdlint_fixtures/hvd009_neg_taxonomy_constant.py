@@ -1,0 +1,26 @@
+"""NEGATIVE: the supported pattern — a handler exiting through the
+run.driver taxonomy, by constant name or by its literal value. The
+supervisor classifies 75 as *preempted* (free relaunch); the EXIT_*
+name and the taxonomy literal both stay silent."""
+
+import signal
+import sys
+
+EXIT_PREEMPTED = 75
+
+
+class TaxonomyShutdown:
+    def __init__(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self.triggered = True
+        sys.exit(EXIT_PREEMPTED)
+
+
+class LiteralTaxonomyShutdown:
+    def __init__(self):
+        signal.signal(signal.SIGUSR1, self._on_usr1)
+
+    def _on_usr1(self, signum, frame):
+        sys.exit(75)

@@ -1,0 +1,15 @@
+"""POSITIVE: an atexit teardown callback hard-exiting with an ad-hoc
+code. The launcher's per-worker exit classification sees 3 -> "crashed"
+and the elastic supervisor burns budget on a deliberate teardown; the
+taxonomy constants (EXIT_CLEAN/EXIT_USAGE/EXIT_PREEMPTED/EXIT_RESIZED)
+are the only codes the supervisor understands."""
+
+import atexit
+import os
+
+
+def _teardown():
+    os._exit(3)  # EXPECT: HVD009
+
+
+atexit.register(_teardown)

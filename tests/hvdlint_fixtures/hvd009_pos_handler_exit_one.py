@@ -1,0 +1,17 @@
+"""POSITIVE: a SIGTERM handler that exits 1 after its (deferred) drain.
+The elastic supervisor classifies exit 1 as a CRASH and burns a restart
+on what was actually a clean preemption — the exit code IS the recovery
+protocol (run.driver.classify_exit); handlers must exit through the
+EXIT_* taxonomy constants (75 = preempted here)."""
+
+import signal
+import sys
+
+
+class EagerShutdown:
+    def __init__(self):
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self.triggered = True
+        sys.exit(1)  # EXPECT: HVD009

@@ -109,7 +109,9 @@ def test_registry_shape():
         "parallel.spmd", "parallel.tp", "parallel.pipeline",
         "parallel.ulysses", "parallel.ring_attention", "parallel.moe"}
     elastic = by_group["elastic"]
-    assert len(elastic) == 1 and elastic[0].forbid_donation
+    assert {p.name for p in elastic} == {
+        "elastic.windowed_loop", "elastic.windowed_loop_resized"}
+    assert all(p.forbid_donation for p in elastic)
     serve = by_group["serve"]
     assert {p.name for p in serve} == {"serve.step", "serve.step_paged"}
     assert all(p.forbid_donation for p in serve)

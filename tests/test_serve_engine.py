@@ -376,3 +376,87 @@ class TestStats:
             assert r.t_admit is not None      # eviction order keys on it
             assert r.t_finish >= r.t_first_token >= r.arrival
             assert len(r.token_times) == len(r.output)
+
+
+class _Clock:
+    """Settable clock: the deadline sweep reads exactly what the test
+    wrote (no auto-advance), so expiry timing is deterministic."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestDeadlines:
+    """Per-request deadline/TTL: a request past ``arrival + ttl`` is
+    finished with the ``timeout`` status and its pages freed at the
+    next engine step — one wedged or abandoned stream can never hold
+    KV pages forever."""
+
+    def _engine(self, params, clock, **cfg):
+        base = dict(page_size=8, num_pages=16, decode_slots=2,
+                    prefill_chunk=8)
+        base.update(cfg)
+        return ServeEngine(params, ServeConfig(**base), clock=clock)
+
+    def test_decoding_request_times_out_and_frees_pages(self, params):
+        clock = _Clock()
+        eng = self._engine(params, clock)
+        free0 = eng.cache.allocator.available
+        hung = eng.submit(_prompt(0, 7), 40, ttl=5.0)   # never finishes
+        live = eng.submit(_prompt(1, 6), 3)             # no deadline
+        for _ in range(3):
+            clock.t += 0.5
+            eng.step()
+        assert hung.state == "decode" and hung.pages
+        clock.t = 10.0                                  # past the deadline
+        eng.step()
+        assert hung.state == "timeout"
+        assert hung in eng.timed_out and not hung.pages
+        assert hung.t_finish == 10.0
+        partial = list(hung.output)
+        assert partial                                  # kept what it had
+        eng.run()
+        assert live.state == "finished"                 # unaffected
+        assert live.output == _ref(params, live.prompt, 3)
+        assert hung.output == partial                   # no more tokens
+        assert eng.cache.allocator.available == free0   # all pages back
+        # Metrics cover the timeout, and reset drops it.
+        assert eng.stats()["by_state"] == {"finished": 1, "timeout": 1}
+        eng.reset_metrics()
+        assert eng.timed_out == []
+
+    @pytest.mark.slow
+    def test_queued_request_can_time_out_waiting(self, params):
+        clock = _Clock()
+        eng = self._engine(params, clock, decode_slots=1,
+                           num_pages=8, max_in_flight=1)
+        a = eng.submit(_prompt(2, 6), 4)
+        b = eng.submit(_prompt(3, 6), 4, ttl=1.0)       # starves in queue
+        clock.t = 2.0
+        eng.run()
+        assert a.state == "finished"
+        assert b.state == "timeout" and b.output == []
+        assert b in eng.timed_out
+
+    @pytest.mark.slow
+    def test_config_default_ttl_and_override(self, params):
+        clock = _Clock()
+        eng = self._engine(params, clock, default_ttl=1.0)
+        short = eng.submit(_prompt(4, 6), 8)            # inherits 1.0
+        long = eng.submit(_prompt(5, 6), 8, ttl=100.0)  # overrides
+        assert short.ttl == 1.0 and long.ttl == 100.0
+        clock.t = 2.0
+        eng.run()
+        assert short.state == "timeout"
+        assert long.state == "finished"
+
+    def test_ttl_validation(self, params):
+        with pytest.raises(ValueError, match="default_ttl"):
+            ServeConfig(default_ttl=0)
+        from horovod_tpu.serve.scheduler import Request
+
+        with pytest.raises(ValueError, match="ttl"):
+            Request(prompt=_prompt(7, 6), max_new_tokens=2, ttl=-1.0)

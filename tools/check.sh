@@ -9,10 +9,17 @@
 #                               the hvdverify rule fixtures + fast-group
 #                               registry sweep (optimizer/parallel/elastic
 #                               programs at zero unsuppressed findings) +
-#                               the elastic fault-injection smoke (a real
-#                               `hvdrun --elastic` job loses rank 1 to a
-#                               HOROVOD_FAULT_PLAN SIGKILL mid-run and
-#                               must finish bit-exact after the relaunch)
+#                               the elastic fault-injection smoke (real
+#                               `hvdrun --elastic` jobs: rank 1 lost to a
+#                               HOROVOD_FAULT_PLAN SIGKILL mid-run must
+#                               finish bit-exact after the relaunch; a
+#                               stall: fault must terminate via the
+#                               heartbeat watchdog; a resize:n=1 shrink
+#                               at np=2 must reshard-resume with every
+#                               global sample consumed exactly once and
+#                               rerun bit-identically — the full
+#                               shrink 4→2 / grow 2→4 matrix is
+#                               slow-marked)
 #                               + the serving smoke (tools/serve_bench.py:
 #                               8 Poisson requests through the
 #                               continuous-batching engine on CPU — all
@@ -65,8 +72,9 @@ if [[ "$VERIFY" == "1" ]]; then
 fi
 
 if [[ "$ELASTIC" == "1" ]]; then
-  echo "== elastic fault-injection smoke (kill rank 1, relaunch, bit-exact) =="
-  python -m pytest tests/test_elastic.py::TestEndToEnd -q \
+  echo "== elastic fault-injection smoke (kill + stall-watchdog + resize-shrink) =="
+  python -m pytest tests/test_elastic.py::TestEndToEnd \
+    tests/test_elastic.py::TestEndToEndResize -q \
     -p no:cacheprovider -m 'not slow'
 fi
 

@@ -671,6 +671,87 @@ def check_hvd008(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD009
+
+#: The run.driver exit taxonomy — the contract between workers, the
+#: launcher's supervision loop and the elastic supervisor: 0 clean,
+#: 2 usage, 75 preempted (EX_TEMPFAIL), 76 resized. A handler exiting
+#: with anything else is classified "crashed" and burns the restart
+#: budget even when the exit was deliberate.
+TAXONOMY_EXIT_CODES = {0, 2, 75, 76}
+
+#: Process-exit spellings a handler might use.
+EXIT_CALL_NAMES = {"exit", "_exit"}
+
+
+def _exit_handler_names(tree: ast.AST) -> Set[str]:
+    """Functions whose exit codes reach the supervisor from handler
+    context: registered signal handlers (``signal.signal(sig, fn)``)
+    and teardown callbacks (``atexit.register(fn)``)."""
+    out = set(_handler_names(tree))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and trailing_name(node.func) == "register"
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "atexit"
+                and node.args):
+            name = trailing_name(node.args[0])
+            if name:
+                out.add(name)
+    return out
+
+
+def check_hvd009(tree: ast.AST) -> List[RawFinding]:
+    """Non-taxonomy exit code from a registered signal handler or
+    supervisor callback.
+
+    The elastic supervisor decides relaunch-vs-fail from the exit code
+    alone (``run.driver.classify_exit``): 75 relaunches FREE (preempted),
+    76 resizes, 2 fails fast, anything else is a *crash* that burns the
+    restart budget. A handler that exits ``sys.exit(1)`` after a clean
+    drain therefore turns every preemption into a budgeted crash — the
+    exit code IS the recovery protocol. Handlers must exit through the
+    ``EXIT_*`` constants (``run.driver`` / ``elastic.signals``). Flagged:
+    ``sys.exit``/``os._exit`` with an integer (or string) literal outside
+    the taxonomy, inside a function registered via ``signal.signal`` or
+    ``atexit.register``. Names spelling a taxonomy constant (``EXIT_*``)
+    and bare ``sys.exit()`` (= 0) stay silent.
+    """
+    findings: List[RawFinding] = []
+    handlers = _exit_handler_names(tree)
+    if not handlers:
+        return findings
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in handlers):
+            continue
+        for call in _subtree_nodes(node.body):
+            if not (isinstance(call, ast.Call)
+                    and trailing_name(call.func) in EXIT_CALL_NAMES
+                    and call.args):
+                continue
+            arg = call.args[0]
+            bad = None
+            if isinstance(arg, ast.Constant):
+                if isinstance(arg.value, bool) or not isinstance(
+                        arg.value, int):
+                    bad = repr(arg.value)
+                elif arg.value not in TAXONOMY_EXIT_CODES:
+                    bad = str(arg.value)
+            if bad is None:
+                continue
+            findings.append(RawFinding(
+                call.lineno, call.col_offset, "HVD009", "error",
+                f"handler '{node.name}' exits with non-taxonomy code "
+                f"{bad}: the supervisor classifies this as a crash and "
+                "burns the restart budget; exit through the "
+                "run.driver constants (EXIT_CLEAN/EXIT_USAGE/"
+                "EXIT_PREEMPTED/EXIT_RESIZED) so the incident class "
+                "survives the exit"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -680,4 +761,5 @@ RULES = {
     "HVD006": check_hvd006,
     "HVD007": check_hvd007,
     "HVD008": check_hvd008,
+    "HVD009": check_hvd009,
 }

@@ -487,12 +487,18 @@ def _build_parallel_moe():
 # -------------------------------------------------------------- elastic
 
 
-def _build_elastic_windowed_loop():
+def _build_elastic_windowed_loop(per_window: int = 8):
     """The PR-5 elastic window program EXACTLY as run_elastic builds it:
     ``jax.jit(windowed(step_fn, k))`` with NO donation — an async
     snapshot may still be copying a buffer the next dispatch would
     otherwise reuse. ``forbid_donation`` turns any donating variant
-    into an HVV104 finding (the regression test donates on purpose)."""
+    into an HVV104 finding (the regression test donates on purpose).
+
+    ``per_window`` is the per-rank window batch: the resized-world
+    entry traces the SAME loop at the post-shrink batch geometry (a
+    2x-smaller world doubles nothing in the program but the batch the
+    survivors each carry) so the snapshot-in-flight invariant is
+    machine-checked at both world sizes the resize e2e exercises."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -510,8 +516,9 @@ def _build_elastic_windowed_loop():
     k = 4
     window_fn = jax.jit(windowed(step_fn, k))  # loop.py: NOT donated
     batch = {
-        "image": jax.ShapeDtypeStruct((k, 8, 28, 28, 1), jnp.float32),
-        "label": jax.ShapeDtypeStruct((k, 8), jnp.int32),
+        "image": jax.ShapeDtypeStruct((k, per_window, 28, 28, 1),
+                                      jnp.float32),
+        "label": jax.ShapeDtypeStruct((k, per_window), jnp.int32),
     }
     return (lambda s, b: window_fn(s, b)), (state, batch)
 
@@ -618,12 +625,24 @@ def _make_registry() -> List[Program]:
                 lambda: _build_parallel_moe()),
     ]
 
-    # The elastic windowed loop + its donation invariant.
+    # The elastic windowed loop + its donation invariant — at the
+    # launch world size AND the post-resize (shrunken-world) batch
+    # geometry, so the PR-5 snapshot-in-flight invariant is checked on
+    # both sides of a resize (the reshard resume re-jits this same
+    # program with the survivors' batch).
     progs.append(Program(
         "elastic.windowed_loop", "elastic",
         lambda: _build_elastic_windowed_loop(),
         forbid_donation=True,
         forbid_donation_why=_ELASTIC_WHY))
+    progs.append(Program(
+        "elastic.windowed_loop_resized", "elastic",
+        lambda: _build_elastic_windowed_loop(per_window=16),
+        forbid_donation=True,
+        forbid_donation_why=_ELASTIC_WHY + (
+            " — resized-world geometry: after a shrink the survivors "
+            "carry the lost ranks' share of the global batch, and the "
+            "re-jitted window must still never donate")))
 
     # The serving engine's compiled step + its page-donation invariant,
     # in both decode-attention modes (the paged variant streams pages

@@ -115,6 +115,28 @@ def snapshot_cell(rec):
     return cell
 
 
+def elastic_cell(rec):
+    """Compact render of the record's elastic recovery stamps (`hvdrun
+    --elastic --metrics-file`; horovod_tpu/elastic/supervisor.py):
+    "r2(crashed1,stalled1) 2→1 det 2.1s" = 2 relaunches by incident
+    class, world trajectory across resizes, worst stale-heartbeat
+    time-to-detect. Non-supervised records render as em-dash."""
+    e = rec.get("elastic")
+    if not isinstance(e, dict):
+        return "—"
+    by_class = e.get("restarts_by_class") or {}
+    classes = ",".join(f"{k}{v}" for k, v in sorted(by_class.items()))
+    cell = f"r{rec.get('value', '?')}"
+    if classes:
+        cell += f"({classes})"
+    world = e.get("world") or []
+    if len(world) > 1:
+        cell += " " + "→".join(str(w) for w in world)
+    if e.get("detect_s") is not None:
+        cell += f" det {e['detect_s']:g}s"
+    return cell
+
+
 def serve_cell(rec):
     """Compact render of the record's serving stamps (tools/
     serve_bench.py; horovod_tpu/serve): "ttft 42/180ms occ 0.61" =
@@ -152,9 +174,9 @@ def main():
     args = ap.parse_args()
     ok, err = load(args.today)
     print("| lane | value | unit | window | overlap | collectives "
-          "| flash grid | snapshot | serve | peak | probe TF "
+          "| flash grid | snapshot | elastic | serve | peak | probe TF "
           "| stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -168,6 +190,7 @@ def main():
               f"| {collectives_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
               f"| {snapshot_cell(rec)} "
+              f"| {elastic_cell(rec)} "
               f"| {serve_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
