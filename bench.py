@@ -304,9 +304,13 @@ def bench_image(args, log):
         0.01, momentum=0.9,
         accumulator_dtype=jnp.bfloat16 if args.bf16_momentum else None)
     state, optimizer = models.create_train_state(
-        rng, model, sgd, sample, zero=args.zero, overlap=args.overlap)
+        rng, model, sgd, sample, zero=args.zero, overlap=args.overlap,
+        compression=resolve_compression(args),
+        hierarchical=args.hierarchical)
     step_fn = models.make_train_step(model, optimizer, average_loss=False)
-    state_spec = models.state_partition_specs(state) if args.zero else P()
+    # state_partition_specs owns the sharded-vs-replicated knowledge
+    # (ZeRO flats, EF residuals -> P("hvd"); everything else P()).
+    state_spec = models.state_partition_specs(state)
 
     global_batch = batch_size * n
     batch = {
@@ -335,6 +339,7 @@ def bench_image(args, log):
         + (f", {k}-step dispatch windows" if k > 1 else ""),
         file=sys.stderr)
     stamp = overlap_stamp(args, state, log)
+    stamp.update(wire_stamp(args, state, log))
     stamp.update(collectives_stamp(run_step, state, batch, log))
     snap_ms = (measure_snapshot_ms(state, log)
                if args.snapshot_every > 0 and not args.compile_only
@@ -433,8 +438,10 @@ def bench_lm(args, log):
     opt = optax.adam(
         1e-4, mu_dtype=jnp.bfloat16 if args.bf16_momentum else None)
     state, optimizer = models.create_train_state(
-        rng, model, opt, sample, zero=args.zero, overlap=args.overlap)
-    state_spec = models.state_partition_specs(state) if args.zero else P()
+        rng, model, opt, sample, zero=args.zero, overlap=args.overlap,
+        compression=resolve_compression(args),
+        hierarchical=args.hierarchical)
+    state_spec = models.state_partition_specs(state)
 
     def step_fn(state, batch):
         tokens = batch["tokens"]
@@ -489,6 +496,7 @@ def bench_lm(args, log):
         file=sys.stderr)
     units_per_iter = batch_size * L * k * args.num_batches_per_iter
     stamp = overlap_stamp(args, state, log)
+    stamp.update(wire_stamp(args, state, log))
     stamp.update(collectives_stamp(run_step, state, batch, log))
     snap_ms = (measure_snapshot_ms(state, log)
                if args.snapshot_every > 0 and not args.compile_only
@@ -504,6 +512,65 @@ def bench_lm(args, log):
     return mean, peak, unit, metric, {"attention": attention,
                                       "flash_grid": flash_grid,
                                       **stamp}
+
+
+def resolve_compression(args):
+    """The Compression class the lane runs (and stamps)."""
+    from horovod_tpu.jax.compression import Compression
+
+    return getattr(Compression, args.compression or "none")
+
+
+def wire_leaves(leaves, compression):
+    """The leaves ``fused_reduce`` actually buckets: the compressor's
+    own ``plan_dtype`` rule (cast compressors halve floating leaves
+    BEFORE planning; none/int8/fp8 plan the raw tree), so the stamp's
+    plan can never drift from the executing one."""
+    import jax
+
+    out = []
+    changed = False
+    for l in leaves:
+        pd = compression.plan_dtype(l.dtype)
+        if pd == l.dtype:
+            out.append(l)
+        else:
+            out.append(jax.ShapeDtypeStruct(l.shape, pd))
+            changed = True
+    return out if changed else leaves
+
+
+def wire_stamp(args, state, log):
+    """The ``"hierarchical"``/``"wire"`` evidence fields: the resolved
+    ladder knob (mode + inner) and the per-leg static byte split
+    (fusion.hier_wire_summary — ICI vs DCN operand bytes, DCN wire
+    dtype, compression ratio), so a multi-slice A/B row carries the
+    bytes its prediction (tools/scaling_model.py) is priced on. Null
+    wire when the ladder is not engaged (single-slice default)."""
+    import jax
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.fusion import (
+        hier_wire_summary,
+        plan_buckets,
+        resolve_hierarchical,
+    )
+
+    mode = args.hierarchical or global_state().config.hierarchical
+    if args.zero:
+        return {"hierarchical": None, "wire": None}
+    inner = resolve_hierarchical(args.hierarchical, hvd.size())
+    if not inner:
+        return {"hierarchical": {"mode": mode, "inner": 0}, "wire": None}
+    comp = resolve_compression(args)
+    leaves = wire_leaves(jax.tree_util.tree_leaves(state["params"]), comp)
+    plan = plan_buckets(leaves, global_state().config.fusion_threshold)
+    wire = hier_wire_summary(plan, hvd.size(), inner, comp)
+    log(f"Hierarchical wire split: inner {inner}, ICI {wire['ici_mb']} "
+        f"MB, DCN {wire['dcn_mb']} MB @ {wire['dtype']} "
+        f"(x{wire['ratio']} vs uncompressed)", file=sys.stderr)
+    return {"hierarchical": {"mode": mode, "inner": inner}, "wire": wire}
 
 
 def overlap_stamp(args, state, log):
@@ -665,6 +732,8 @@ def supervise(argv, args):
             "vs_baseline": None, "peak": None, "probe_tflops": None,
             "window": getattr(args, "steps_per_dispatch", 1),
             "overlap": getattr(args, "overlap", None),
+            "hierarchical": None,
+            "wire": None,
             "snapshot": None,
             "collectives": None,
             "error": f"supervisor received signal {signum} mid-run "
@@ -767,6 +836,8 @@ def supervise(argv, args):
         "vs_baseline": None, "peak": None, "probe_tflops": None,
         "window": getattr(args, "steps_per_dispatch", 1),
         "overlap": getattr(args, "overlap", None),
+        "hierarchical": None,
+        "wire": None,
         "snapshot": None,
         "collectives": None,
         "error": last_err,
@@ -827,6 +898,30 @@ def build_parser():
                              "Default: the HOROVOD_OVERLAP env knob "
                              "(auto). The record stamps the mode plus "
                              "the bucket plan (count/MB/oversize)")
+    parser.add_argument("--hierarchical", default=None,
+                        choices=("auto", "on", "off"),
+                        help="hierarchical bucket collectives "
+                             "(horovod_tpu/jax/fusion.py): each fused "
+                             "bucket runs intra-slice reduce-scatter -> "
+                             "inter-slice DCN exchange of the 1/inner "
+                             "shard -> intra-slice all-gather. Default: "
+                             "the HOROVOD_HIERARCHICAL env knob (auto = "
+                             "engage only on a multi-slice/DCN mesh; "
+                             "pin the slice size with HOROVOD_"
+                             "HIERARCHICAL_INNER_SIZE). The record "
+                             "stamps the resolved mode/inner plus the "
+                             "per-leg 'wire' byte split")
+    parser.add_argument("--compression", default=None,
+                        choices=("none", "fp16", "bf16", "int8", "fp8"),
+                        help="gradient wire compression "
+                             "(horovod_tpu/jax/compression.py): fp16/"
+                             "bf16 cast every leg; int8/fp8 quantize "
+                             "ONLY the hierarchical DCN leg (per-bucket "
+                             "absmax scale + error-feedback residuals "
+                             "in optimizer state) and degrade to "
+                             "lossless without --hierarchical. The "
+                             "record's 'wire' stamp carries the "
+                             "ici/dcn byte split and compression ratio")
     parser.add_argument("--snapshot-every", type=int, default=0,
                         help="measure the elastic snapshot overhead at "
                              "this cadence (steps between host-RAM "

@@ -26,6 +26,15 @@ DEFAULT_CYCLE_TIME_MS = 5.0
 DEFAULT_OVERLAP_SCATTER_THRESHOLD = 4 * 1024 * 1024
 # HOROVOD_OVERLAP values (see horovod_tpu.jax.fusion.resolve_overlap).
 OVERLAP_MODES = ("auto", "on", "off")
+# HOROVOD_HIERARCHICAL values (horovod_tpu.jax.fusion.
+# resolve_hierarchical): run each fused bucket as the two-level
+# intra-slice reduce-scatter -> inter-slice exchange -> intra-slice
+# all-gather ladder instead of one flat psum. "auto" (default) engages
+# only when the device set spans a DCN boundary (multiple slices, or
+# multiple processes — parallel.mesh.slice_topology); "on" forces the
+# ladder with HOROVOD_HIERARCHICAL_INNER_SIZE (or chips-per-process)
+# as the fast-domain size; "off" is the flat collective.
+HIERARCHICAL_MODES = ("auto", "on", "off")
 # Reference: FUSION_BUFFER_ATOMIC_UNIT alignment (operations.h:52-54).
 FUSION_BUFFER_ATOMIC_UNIT = 64
 # Reference: STALL_WARNING_TIME 60s (operations.cc:258).
@@ -120,10 +129,18 @@ class Config:
     # seconds; 0 disables). Stale-heartbeat workers are killed and the
     # incident classified "stalled".
     watchdog_timeout_secs: float = DEFAULT_WATCHDOG_TIMEOUT_SECS
-    # Hierarchical collectives: on TPU this selects the explicit two-level
-    # ladder (reduce-scatter in the fast domain, cross-reduce, all-gather)
-    # rather than NCCL+MPI staging (reference semantics:
-    # operations.cc:1284-1436 allreduce, :929-1032 allgather).
+    # Hierarchical bucket collectives (HOROVOD_HIERARCHICAL=auto|on|off):
+    # each fused bucket runs the two-level intra-slice reduce-scatter ->
+    # inter-slice DCN exchange -> intra-slice all-gather ladder. "auto"
+    # keys off a multi-slice/DCN-present device set (HIERARCHICAL_MODES
+    # above; horovod_tpu/jax/fusion.py resolve_hierarchical).
+    hierarchical: str = "auto"
+    # Hierarchical collectives (legacy boolean spelling): on TPU this
+    # selects the explicit two-level ladder (reduce-scatter in the fast
+    # domain, cross-reduce, all-gather) rather than NCCL+MPI staging
+    # (reference semantics: operations.cc:1284-1436 allreduce,
+    # :929-1032 allgather). HOROVOD_HIERARCHICAL_ALLREDUCE=1 is read as
+    # HOROVOD_HIERARCHICAL=on.
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     # Fast-domain (ICI) size for the hierarchical ladder. 0 = auto: the
@@ -165,6 +182,9 @@ class Config:
             ),
             watchdog_timeout_secs=_env_float(
                 "HOROVOD_WATCHDOG_TIMEOUT", DEFAULT_WATCHDOG_TIMEOUT_SECS
+            ),
+            hierarchical=_env_choice(
+                "HOROVOD_HIERARCHICAL", "auto", HIERARCHICAL_MODES
             ),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),

@@ -45,11 +45,12 @@ Candidate = Tuple[int, bool]  # (fusion_threshold bytes, hierarchical)
 
 def _hier_available(st) -> bool:
     """Whether the two-level ladder can tile the "hvd" axis — delegated
-    to fusion.py's own degrade condition so the tuner's candidate space
-    and the traced collective can never drift apart."""
-    from horovod_tpu.jax.fusion import _hierarchical_inner
+    to fusion.py's own resolution (the SAME resolve_hierarchical the
+    traced collective runs, slice detection included) so the tuner's
+    candidate space and the executing path can never drift apart."""
+    from horovod_tpu.jax.fusion import resolve_hierarchical
 
-    return _hierarchical_inner(st, st.global_device_count, True) > 0
+    return resolve_hierarchical("on", st.global_device_count) > 0
 
 
 class StepAutotuner:
@@ -142,6 +143,11 @@ class StepAutotuner:
     def _apply(self, cand: Candidate) -> None:
         self.config.fusion_threshold = cand[0]
         self.config.hierarchical_allreduce = cand[1]
+        # Pin the tri-state knob too: without this, a FLAT candidate on
+        # a DCN-present mesh would still ladder through the default
+        # "auto" (fusion.resolve_hierarchical) and the categorical A/B
+        # would silently probe ladder-vs-ladder.
+        self.config.hierarchical = "on" if cand[1] else "off"
 
     def _current(self) -> Candidate:
         return (self.config.fusion_threshold,

@@ -7,6 +7,21 @@ and horovod/tensorflow/compression.py:33-74): a ``Compressor`` has
 ``Compression.bf16`` is the TPU-native addition (bfloat16 is the natural
 reduced-precision wire format on TPU: full fp32 exponent range, so no
 scale management, and ICI/MXU operate on it natively).
+
+Below the cast compressors sit the **low-bit wire codecs**
+(``Compression.int8`` / ``Compression.fp8``): per-bucket absmax-scaled
+quantization in the 1-bit-SGD / Deep-Gradient-Compression lineage, with
+an error-feedback residual carried in optimizer state so the
+quantization error of step ``t`` is re-injected at step ``t+1`` (Seide
+et al. 2014; Lin et al. 2018). These apply ONLY to the inter-slice DCN
+leg of the hierarchical bucket ladder (``HOROVOD_HIERARCHICAL``,
+horovod_tpu/jax/fusion.py): the ICI legs stay at the gradients' own
+dtype — ICI at 200 GB/s/chip is not the wall, DCN at ~3 GB/s/chip is
+(tools/scaling_model.py). Their ``compress``/``decompress`` protocol
+methods are identity (nothing is cast before bucketing); the
+``quantize``/``dequantize`` classmethods are the DCN wire codec fusion
+invokes per bucket shard. Without a hierarchical DCN leg they degrade
+to lossless.
 """
 
 from __future__ import annotations
@@ -24,6 +39,15 @@ class Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         raise NotImplementedError
+
+    @classmethod
+    def plan_dtype(cls, dtype):
+        """The dtype a leaf of ``dtype`` enters the bucket plan with —
+        what ``compress`` will hand ``fusion.plan_buckets``. Identity
+        for everything except the cast compressors; static-accounting
+        consumers (bench.py's wire stamp) use this so their plan can
+        never drift from the executing one."""
+        return dtype
 
 
 class NoneCompressor(Compressor):
@@ -44,9 +68,15 @@ class _CastCompressor(Compressor):
     @classmethod
     def compress(cls, tensor):
         dtype = tensor.dtype
-        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+        if cls.plan_dtype(dtype) != dtype:
             return tensor.astype(cls.wire_dtype), dtype
         return tensor, None
+
+    @classmethod
+    def plan_dtype(cls, dtype):
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return jnp.dtype(cls.wire_dtype)
+        return dtype
 
     @classmethod
     def decompress(cls, tensor, ctx):
@@ -68,9 +98,83 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class _ScaledQuantCompressor(Compressor):
+    """Base for the low-bit DCN wire codecs: per-bucket absmax scaling.
+
+    ``quantize(v) -> (payload, scale)`` maps a float tensor onto the
+    wire dtype with one scalar scale (``absmax / cap``; zero-safe);
+    ``dequantize(payload, scale)`` returns fp32. The Compressor
+    protocol methods are identity — quantization happens per DCN-leg
+    shard inside the hierarchical bucket ladder, never at bucketing
+    time (the ICI legs stay full-dtype). ``dcn_wire`` marks the class
+    for fusion's dispatch.
+    """
+
+    dcn_wire = True
+    wire_dtype: jnp.dtype
+    #: Largest representable magnitude of the wire dtype; absmax maps
+    #: onto it so the payload spans the full quantization range.
+    cap: float
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+    @classmethod
+    def quantize(cls, v):
+        v = v.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(v))
+        # Zero-safe: an all-zero shard quantizes to zeros at scale 1.
+        scale = jnp.where(absmax > 0, absmax / cls.cap, 1.0)
+        q = cls._encode(v / scale)
+        return q, scale.astype(jnp.float32)
+
+    @classmethod
+    def dequantize(cls, payload, scale):
+        return payload.astype(jnp.float32) * scale
+
+
+class Int8Compressor(_ScaledQuantCompressor):
+    """int8 DCN wire: symmetric linear quantization to [-127, 127]
+    with a per-bucket-shard absmax scale (4x fewer wire bytes than
+    fp32; error feedback makes the rounding error transient)."""
+
+    wire_dtype = jnp.int8
+    cap = 127.0
+
+    @staticmethod
+    def _encode(scaled):
+        return jnp.clip(jnp.round(scaled), -127.0, 127.0).astype(jnp.int8)
+
+
+class FP8Compressor(_ScaledQuantCompressor):
+    """float8_e4m3 DCN wire: 4 exponent + 3 mantissa bits (~2 decimal
+    digits, wider dynamic range than int8 at the same byte cost) —
+    absmax-scaled into the format's finite range."""
+
+    wire_dtype = jnp.float8_e4m3fn
+    cap = 448.0  # float8_e4m3fn finite max
+
+    @staticmethod
+    def _encode(scaled):
+        return jnp.clip(scaled, -448.0, 448.0).astype(jnp.float8_e4m3fn)
+
+
+def is_dcn_wire(compression) -> bool:
+    """True for the low-bit codecs that compress only the hierarchical
+    DCN leg (int8/fp8) — fusion/optimizer dispatch on this."""
+    return bool(getattr(compression, "dcn_wire", False))
+
+
 class Compression:
     """Optional gradient compression algorithm used during allreduce."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor

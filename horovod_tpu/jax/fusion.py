@@ -38,6 +38,53 @@ change, the math does not (pinned bit-exactly in tests/test_overlap.py).
 
 Same-dtype-only fusion matches the reference (it fused only responses with
 identical dtype/device signatures, operations.cc:2175-2230).
+
+Hierarchical bucket execution (HOROVOD_HIERARCHICAL=auto|on|off): on a
+multi-slice mesh the flat psum would push every gradient byte across
+DCN (~3 GB/s/chip) when 200 GB/s ICI sits inside each slice. With the
+ladder engaged, each bucket runs intra-slice reduce-scatter -> inter-
+slice exchange of the 1/``inner`` shard -> intra-slice all-gather (the
+reference's NCCL-within/MPI-across hierarchical allreduce,
+operations.cc:1284-1436, as explicit XLA collectives over
+``axis_index_groups`` — shared rung: parallel/mesh.py
+``hierarchical_ladder_in_axis``; two-level mesh factory:
+``hybrid_mesh``). "auto" engages only when the device set spans a DCN
+boundary (``parallel.mesh.dcn_present``). Composes with the overlap
+schedule (reverse-order issue applies per bucket regardless of its
+collective shape); hierarchical buckets never additionally take the
+rs+ag scatter form (the ladder already decomposes).
+
+Low-bit DCN wire (``Compression.int8`` / ``Compression.fp8``): the DCN
+leg optionally quantizes the shard with a per-bucket absmax scale (the
+scale rides beside the payload as a scalar all-gather) and an optional
+error-feedback residual carried in optimizer state
+(:func:`ef_residual_specs`; Seide et al. 2014 / DGC lineage), so
+quantization error is re-injected the next step instead of compounding.
+Two exchange shapes: at 2 slices, an all-gather of the quantized shards
+with local dequant-sum; at >2 slices, the quantized ring decomposition
+— all-to-all of quantized sub-shards, local dequant-sum, re-quantize
+(second residual), all-gather — keeping per-chip DCN wire at
+``~2(m-1)/m`` of the QUANTIZED shard instead of growing with the slice
+count. ICI legs always stay at the bucket's own dtype.
+
+Dtype ladder (where bytes live and where the Average divide happens —
+the no-double-scaling contract pinned by tests/test_hierarchical.py):
+
+    compression   ICI wire      DCN wire        accumulate  1/n divide
+    ------------  ------------  --------------  ----------  -------------
+    none          input dtype   input dtype     input       shard, pre-ag
+    fp16 / bf16   wire dtype    wire dtype      wire        tail, fp32*
+    int8 / fp8    input dtype   int8/fp8+scale  fp32        shard, pre-ag
+
+    (*) cast compressors divide once, at the decompressed tail — the
+    historical flat-path behavior, kept so hierarchical-off and -on
+    share one reduction + division sequence exactly. The quantized
+    codecs divide the dequantized fp32 shard BEFORE the all-gather
+    (elementwise divide commutes with gather — bit-identical to a tail
+    divide, 1/inner of the work) and never at the tail, so Average is
+    applied exactly once; the error-feedback residual lives in the
+    pre-divide SUM domain, so feedback composes with Average without
+    double-scaling.
 """
 
 from __future__ import annotations
@@ -48,10 +95,10 @@ from typing import List, NamedTuple, Optional, Sequence
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.common.config import OVERLAP_MODES
+from horovod_tpu.common.config import HIERARCHICAL_MODES, OVERLAP_MODES
 from horovod_tpu.common.exceptions import InvalidArgumentError
 from horovod_tpu.common.state import current_spmd_axis, global_state
-from horovod_tpu.jax.compression import Compression
+from horovod_tpu.jax.compression import Compression, is_dcn_wire
 
 
 def _plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
@@ -158,13 +205,245 @@ def resolve_overlap(mode: Optional[str], n_buckets: int) -> bool:
 def _hierarchical_inner(st, axis_size: int, enabled: bool) -> int:
     """Fast-domain size for the two-level ladder, or 0 when the flat
     collective should be used. Auto mode uses chips-per-process (the
-    reference's local/cross comm split, operations.cc:1760-1797)."""
+    reference's local/cross comm split, operations.cc:1760-1797).
+    (Legacy helper kept for the allgather lane — the allreduce path now
+    resolves through :func:`resolve_hierarchical`.)"""
     if not enabled:
         return 0
     inner = st.config.hierarchical_inner_size or st.local_device_count
     if 1 < inner < axis_size and axis_size % inner == 0:
         return inner
     return 0
+
+
+def resolve_hierarchical(mode: Optional[str], axis_size: int) -> int:
+    """Resolve the HOROVOD_HIERARCHICAL knob to a fast-domain (ICI)
+    size for this axis, or 0 for the flat collective.
+
+    ``auto`` (default) engages only when the device set spans a DCN
+    boundary (multiple slices or processes — ``parallel.mesh.
+    dcn_present``), with the detected chips-per-slice as the inner
+    size; ``on`` forces the ladder with HOROVOD_HIERARCHICAL_INNER_SIZE
+    (falling back to chips-per-slice, then chips-per-process); ``off``
+    is the flat collective. The legacy HOROVOD_HIERARCHICAL_ALLREDUCE=1
+    boolean reads as ``on``. An inner size that does not strictly
+    divide the axis (1 < inner < axis_size) degrades to flat, the
+    reference's is_homogeneous degradation (operations.cc:1303-1315).
+    """
+    st = global_state()
+    if mode is None:
+        mode = st.config.hierarchical
+        # The legacy boolean is an EXPLICIT opt-in (env var or the
+        # autotuner's categorical knob): when set it forces the ladder
+        # regardless of the tri-state default.
+        if st.config.hierarchical_allreduce:
+            mode = "on"
+    if mode is True:
+        mode = "on"
+    elif mode is False:
+        mode = "off"
+    if mode not in HIERARCHICAL_MODES:
+        raise InvalidArgumentError(
+            f"hierarchical must be one of {HIERARCHICAL_MODES} "
+            f"(got {mode!r})")
+    if mode == "off":
+        return 0
+    from horovod_tpu.parallel.mesh import dcn_present, slice_topology
+
+    devices = st.devices or None
+    inner = st.config.hierarchical_inner_size
+    if mode == "auto":
+        # auto = engage only on a REAL multi-slice/DCN mesh, explicit
+        # inner size or not — single-slice jobs stay flat (force the
+        # ladder there with "on").
+        if not dcn_present(devices):
+            return 0
+        if not inner:
+            try:
+                _, inner = slice_topology(devices)
+            except InvalidArgumentError:
+                # Heterogeneous chips-per-domain with no explicit inner:
+                # no valid ladder tiling exists — degrade to flat, the
+                # reference's is_homogeneous rule.
+                return 0
+    elif not inner:  # "on" without an explicit inner size
+        try:
+            domains, per = slice_topology(devices)
+            inner = per if domains > 1 else st.local_device_count
+        except InvalidArgumentError:
+            inner = st.local_device_count
+    if inner and 1 < inner < axis_size and axis_size % inner == 0:
+        return inner
+    return 0
+
+
+def _pad_up_elems(elems: int, quantum: int) -> int:
+    return (elems + quantum - 1) // quantum * quantum
+
+
+def hier_bucket_layout(elems: int, axis_size: int, inner: int,
+                       quantized: bool) -> dict:
+    """Static element-count layout of one hierarchical bucket: how the
+    flat buffer pads and shards on the ladder. ``m`` is the slice
+    (outer/DCN) count; quantized buckets at m > 2 take the two-stage
+    exchange, whose all-to-all needs the shard divisible by m as well.
+    Shared by the executing path, :func:`ef_residual_specs`,
+    :func:`hier_wire_summary` and the HVV105 reconciliation — one
+    layout, four consumers, no drift."""
+    m = axis_size // inner
+    two_stage = quantized and m > 2
+    quantum = inner * m if two_stage else inner
+    padded = _pad_up_elems(elems, quantum)
+    shard = padded // inner
+    return {
+        "m": m,
+        "two_stage": two_stage,
+        "padded_elems": padded,
+        "shard_elems": shard,
+        "sub_elems": shard // m if two_stage else 0,
+    }
+
+
+def _ef_eligible(bucket: "Bucket") -> bool:
+    """Buckets the low-bit DCN codec (and so the error-feedback
+    residual) applies to: floating dtypes only — integer gradients take
+    the plain psum DCN leg."""
+    return jnp.issubdtype(jnp.dtype(bucket.dtype), jnp.floating)
+
+
+def ef_residual_specs(leaves, threshold: int, axis_size: int, inner: int):
+    """GLOBAL-shaped ShapeDtypeStructs of the error-feedback residuals
+    for a quantized hierarchical exchange over ``leaves`` — one fp32
+    vector per quantized stage per floating bucket, in plan order.
+
+    Each residual is rank-LOCAL state: chip ``r`` owns rows
+    ``[r*shard : (r+1)*shard)`` of the global vector. Feed these leaves
+    through the training step with ``P("hvd")`` partition specs
+    (``models.state_partition_specs`` derives them) so shard_map hands
+    every chip exactly its own slice; the leaves are created zero by
+    ``allreduce_gradients_transform``'s init and updated in place of
+    the optimizer state each step. Buckets at 2 slices carry one
+    residual (the all-gather exchange quantizes once); buckets at >2
+    slices carry two (the two-stage exchange re-quantizes the summed
+    sub-shard)."""
+    import jax
+
+    specs = []
+    for bucket in plan_buckets(leaves, threshold):
+        if not _ef_eligible(bucket):
+            continue
+        itemsize = jnp.dtype(bucket.dtype).itemsize
+        layout = hier_bucket_layout(bucket.nbytes // itemsize, axis_size,
+                                    inner, quantized=True)
+        specs.append(jax.ShapeDtypeStruct(
+            (axis_size * layout["shard_elems"],), jnp.float32))
+        if layout["two_stage"]:
+            specs.append(jax.ShapeDtypeStruct(
+                (axis_size * layout["sub_elems"],), jnp.float32))
+    return specs
+
+
+def hier_wire_summary(plan: Sequence[Bucket], axis_size: int, inner: int,
+                      compression=Compression.none) -> dict:
+    """Per-leg STATIC operand-byte split of a hierarchical bucket plan —
+    the ``"wire"`` stamp bench.py records and the numbers
+    tools/scaling_model.py prices, derived from the same
+    :func:`hier_bucket_layout` the executing path uses (so the stamp is
+    checkable against the HVV105-reconciled schedule).
+
+    ``ici_bytes`` = intra-slice reduce-scatter + all-gather operands;
+    ``dcn_bytes`` = inter-slice exchange operands (quantized payloads +
+    their scale scalars under int8/fp8); ``ratio`` = what the DCN leg
+    would have carried at the input dtype over what it carries now
+    (1.0 uncompressed, ~4x under int8/fp8 from fp32)."""
+    quantizer = compression if is_dcn_wire(compression) else None
+    ici = dcn = flat_dcn = 0
+    dcn_dtype = None
+    for b in plan:
+        dt = jnp.dtype(b.dtype)
+        elems = b.nbytes // dt.itemsize
+        q = quantizer is not None and _ef_eligible(b)
+        layout = hier_bucket_layout(elems, axis_size, inner, quantized=q)
+        shard = layout["shard_elems"]
+        # Quantized buckets dequant-sum in fp32, so the final intra-
+        # slice all-gather carries fp32 regardless of the input dtype.
+        ag_itemsize = 4 if q else dt.itemsize
+        ici += layout["padded_elems"] * dt.itemsize + shard * ag_itemsize
+        if q:
+            wire = jnp.dtype(quantizer.wire_dtype)
+            dcn_dtype = wire.name
+            if layout["two_stage"]:
+                dcn += (shard + layout["sub_elems"]) * wire.itemsize + 8
+            else:
+                dcn += shard * wire.itemsize + 4
+        else:
+            dcn += shard * dt.itemsize
+            if dcn_dtype is None:
+                dcn_dtype = dt.name
+        flat_dcn += shard * dt.itemsize
+    return {
+        "ici_bytes": int(ici),
+        "dcn_bytes": int(dcn),
+        "ici_mb": round(ici / (1024 * 1024), 3),
+        "dcn_mb": round(dcn / (1024 * 1024), 3),
+        "dtype": dcn_dtype,
+        "ratio": round(flat_dcn / dcn, 2) if dcn else None,
+    }
+
+
+def _quantized_outer_exchange(shard_v, axis, outer_groups, quantizer,
+                              layout, r_in, act):
+    """The compressed inter-slice (DCN) leg of one bucket's ladder.
+
+    ``shard_v`` is this chip's intra-slice-reduced 1/inner shard. Two
+    shapes (see module docstring): at m == 2 slices, all-gather the
+    quantized shards + scales and dequant-sum locally; at m > 2, the
+    quantized ring decomposition — all-to-all quantized sub-shards,
+    dequant-sum, re-quantize, all-gather — so per-chip DCN wire stays
+    ~2(m-1)/m of the QUANTIZED shard instead of growing with m.
+    ``r_in`` is the bucket's error-feedback residual tuple (or None for
+    feedback-free quantization); returns ``(fp32 summed shard,
+    [new residuals])`` with residuals in the pre-divide SUM domain.
+    """
+    from jax import lax as _lax
+
+    from horovod_tpu.utils import timeline as _tl_names
+
+    new_res = []
+    v = shard_v.astype(jnp.float32)
+    if r_in is not None:
+        v = v + r_in[0]
+    q, scale = quantizer.quantize(v)
+    if r_in is not None:
+        new_res.append(v - quantizer.dequantize(q, scale))
+    if not layout["two_stage"]:
+        with act(_tl_names.ALLGATHER):
+            qs = _lax.all_gather(q, axis, axis=0,
+                                 axis_index_groups=outer_groups)
+            ss = _lax.all_gather(scale.reshape(1), axis, axis=0,
+                                 axis_index_groups=outer_groups)
+        out = (qs.astype(jnp.float32) * ss).sum(axis=0)
+        return out, new_res
+    m = layout["m"]
+    with act(_tl_names.ALLTOALL):
+        recv = _lax.all_to_all(q.reshape(m, -1), axis, split_axis=0,
+                               concat_axis=0,
+                               axis_index_groups=outer_groups, tiled=True)
+        ss = _lax.all_gather(scale.reshape(1), axis, axis=0,
+                             axis_index_groups=outer_groups)
+    u = (recv.astype(jnp.float32) * ss).sum(axis=0)
+    if r_in is not None:
+        u = u + r_in[1]
+    q2, scale2 = quantizer.quantize(u)
+    if r_in is not None:
+        new_res.append(u - quantizer.dequantize(q2, scale2))
+    with act(_tl_names.ALLGATHER):
+        qg = _lax.all_gather(q2, axis, axis=0,
+                             axis_index_groups=outer_groups)
+        sg = _lax.all_gather(scale2.reshape(1), axis, axis=0,
+                             axis_index_groups=outer_groups)
+    out = (qg.astype(jnp.float32) * sg).reshape(-1)
+    return out, new_res
 
 
 def fused_reduce(
@@ -176,6 +455,8 @@ def fused_reduce(
     name: Optional[str] = None,
     overlap: Optional[str] = None,
     scatter_threshold: Optional[int] = None,
+    hierarchical: Optional[str] = None,
+    residuals=None,
 ):
     """Allreduce a sequence of tensors via fused flat buckets.
 
@@ -190,6 +471,14 @@ def fused_reduce(
     unpack-later, reduce-scatter+all-gather for buckets >=
     ``scatter_threshold`` bytes (HOROVOD_OVERLAP_SCATTER_THRESHOLD).
     Changes dispatch shape only — results are bit-identical to ``off``.
+
+    ``hierarchical`` (auto|on|off, default HOROVOD_HIERARCHICAL) runs
+    each Sum/Average bucket as the two-level intra-slice reduce-scatter
+    -> inter-slice exchange -> intra-slice all-gather ladder (module
+    docstring); with ``Compression.int8``/``.fp8`` the inter-slice leg
+    is absmax-quantized, optionally error-corrected by ``residuals``
+    (the per-chip state from :func:`ef_residual_specs` — when passed,
+    the return value becomes ``(outputs, new_residuals)``).
     """
     from horovod_tpu.jax import mpi_ops
 
@@ -207,17 +496,35 @@ def fused_reduce(
     axis = current_spmd_axis()
     if axis is None:
         nproc = st.process_count
+        if nproc > 1 and residuals and is_dcn_wire(compression):
+            # Same config-drift class as the flat-resolution raise
+            # below: EF state exists (init saw an engageable ladder)
+            # but the eager lane has no hierarchical path — full-
+            # precision bytes would cross the wire while the user
+            # believes int8/fp8 EF is active. (Single-process identity
+            # passes through: no bytes move at all.)
+            raise InvalidArgumentError(
+                "error-feedback residuals are present but the multi-"
+                "process eager lane has no hierarchical/quantized "
+                "exchange — int8/fp8 wire compression requires the "
+                "SPMD lane (hvd.spmd_run/spmd_fn); use Compression."
+                "fp16/bf16 or none here")
         if nproc == 1:
-            return list(tensors)
-        # Multi-process eager: reduce each via the process-level path (the
-        # native core fuses on its own side, so this per-tensor loop is
-        # not the per-tensor anti-pattern HVD006 flags in user code).
-        return [
-            mpi_ops.allreduce(  # hvdlint: disable=HVD006
-                t, average=(op is mpi_ops.Average), op=op,
-                name=f"{name}.{i}" if name else None)
-            for i, t in enumerate(tensors)
-        ]
+            out = list(tensors)
+        else:
+            # Multi-process eager: reduce each via the process-level
+            # path (the native core fuses on its own side, so this
+            # per-tensor loop is not the per-tensor anti-pattern HVD006
+            # flags in user code).
+            out = [
+                mpi_ops.allreduce(  # hvdlint: disable=HVD006
+                    t, average=(op is mpi_ops.Average), op=op,
+                    name=f"{name}.{i}" if name else None)
+                for i, t in enumerate(tensors)
+            ]
+        if residuals is not None:  # no DCN leg here: residuals untouched
+            return out, tuple(residuals)
+        return out
 
     n = mpi_ops._axis_size(axis)
     # Min/Max/Product fuse just as well as Sum: any elementwise cross-rank
@@ -225,22 +532,31 @@ def fused_reduce(
     plain_sum = op is mpi_ops.Average or op is mpi_ops.Sum
     if plain_sum:
         reduce_fn = lax.psum
-        # HOROVOD_HIERARCHICAL_ALLREDUCE: route sum-reductions through the
-        # explicit two-level ladder (reference operations.cc:1284-1436) —
-        # reduce-scatter in the fast (ICI) domain, cross-reduce 1/inner of
-        # the bytes, all-gather back.
-        inner = _hierarchical_inner(st, n, st.config.hierarchical_allreduce)
-        if inner:
-            from horovod_tpu.parallel.mesh import hierarchical_allreduce_in_axis
-
-            def reduce_fn(v, ax, _inner=inner):
-                return hierarchical_allreduce_in_axis(v, ax, _inner)
+        # HOROVOD_HIERARCHICAL: run each bucket as the explicit
+        # two-level ladder (reference operations.cc:1284-1436) —
+        # reduce-scatter in the fast (ICI) domain, exchange 1/inner of
+        # the bytes across DCN, all-gather back.
+        hier = resolve_hierarchical(hierarchical, n)
     else:
-        inner = 0
+        hier = 0
         try:
             reduce_fn = mpi_ops._REDUCE_FNS[op]
         except KeyError:
             raise InvalidArgumentError(f"Unsupported reduction op: {op}")
+    quantizer = compression if (hier and is_dcn_wire(compression)) else None
+    if residuals and is_dcn_wire(compression) and quantizer is None:
+        # The caller initialized error-feedback state for an engaged
+        # ladder (ef_residual_specs at init world size), but on THIS
+        # axis the ladder resolves to flat — silently skipping the
+        # quantized exchange would let the user believe int8/fp8 EF is
+        # active while fp32 flows. Config drift, not a degrade case.
+        raise InvalidArgumentError(
+            "error-feedback residuals are present but the hierarchical "
+            f"ladder resolves to FLAT on this {n}-way axis "
+            "(HOROVOD_HIERARCHICAL_INNER_SIZE must satisfy 1 < inner "
+            f"< {n} and divide it): the optimizer state was initialized "
+            "against a different world/axis size — re-init the "
+            "optimizer (fusion.ef_residual_specs) for this axis")
     compressed = []
     ctxs = []
     for t in tensors:
@@ -250,10 +566,36 @@ def fused_reduce(
 
     plan = plan_buckets(compressed, fusion_threshold)
     use_overlap = resolve_overlap(overlap, len(plan))
-    # The rs+ag form needs the plain flat psum semantics (the ladder
-    # already decomposes; Min/Max/Product have no scatter primitive) and
-    # >1 rank for the scatter to mean anything.
-    can_scatter = use_overlap and plain_sum and not inner and n > 1
+    # The rs+ag form needs the plain flat psum semantics (Min/Max/
+    # Product have no scatter primitive) and >1 rank for the scatter to
+    # mean anything; hierarchical buckets never take it — the ladder
+    # already decomposes into schedulable halves.
+    can_scatter = use_overlap and plain_sum and not hier and n > 1
+
+    # Error-feedback residual slots: plan index -> (offset, count) into
+    # the ``residuals`` tuple, in plan order (the structure
+    # ef_residual_specs promises). Updated residuals land in
+    # ``new_residuals`` at the same offsets.
+    ef_map = {}
+    if hier and quantizer is not None:
+        off = 0
+        for pi, b in enumerate(plan):
+            if not _ef_eligible(b):
+                continue
+            layout = hier_bucket_layout(
+                b.nbytes // jnp.dtype(b.dtype).itemsize, n, hier,
+                quantized=True)
+            count = 2 if layout["two_stage"] else 1
+            ef_map[pi] = (off, count)
+            off += count
+        if residuals is not None and len(residuals) != off:
+            raise InvalidArgumentError(
+                f"error-feedback residuals carry {len(residuals)} "
+                f"leaves but this plan needs {off} (one per quantized "
+                "stage per floating bucket, plan order — rebuild them "
+                "with fusion.ef_residual_specs after changing the "
+                "fusion threshold, world size or inner size)")
+    new_residuals = list(residuals) if residuals is not None else None
 
     # Per-bucket observability (the SPMD half of the reference's
     # per-tensor activity taxonomy, operations.h:29-50): each bucket's
@@ -290,14 +632,30 @@ def fused_reduce(
     # the all-gather) — the tail must not divide them again.
     averaged = [False] * len(tensors)
 
-    def _issue(k, bucket: Bucket):
-        """Emit bucket ``bucket``'s collective (k-th in issue order);
-        return the unpack closure that splits results back out."""
+    def _pack_flat(members, bucket_name):
+        """Memcpy-in: ravel+concatenate the bucket members into the flat
+        fusion buffer (shared by the hierarchical and scatter forms)."""
+        with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
+            return (jnp.concatenate(
+                [compressed[i].ravel() for i in members])
+                if len(members) > 1
+                else compressed[members[0]].ravel())
+
+    def _issue(k, pi, bucket: Bucket):
+        """Emit bucket ``bucket``'s collective (k-th in issue order,
+        ``pi``-th in the plan); return the unpack closure that splits
+        results back out."""
         dtype = jnp.dtype(bucket.dtype)
         bucket_name = f"{name or 'fused'}.{dtype.name}.b{bucket.index}"
         scope = f"hvd_allreduce_{bucket_name}".replace(".", "_")
         members = list(bucket.members)
         scatter = can_scatter and bucket.nbytes >= scatter_threshold
+        hier_q = hier and quantizer is not None and _ef_eligible(bucket)
+        if hier:
+            path = (f"hier_{jnp.dtype(quantizer.wire_dtype).name}"
+                    if hier_q else "hier")
+        else:
+            path = "rs_ag" if scatter else "psum"
         if emit:
             tl.start(bucket_name, _tl_names.ALLREDUCE,
                      args={"span": "trace", "tensors": len(members),
@@ -306,15 +664,75 @@ def fused_reduce(
                            # Sequential emission unpacks each bucket
                            # before issuing the next: never >1 in flight.
                            "in_flight": k + 1 if use_overlap else 1,
-                           "path": "rs_ag" if scatter else "psum"})
+                           "path": path,
+                           **({"inner": int(hier)} if hier else {})})
+        # The hierarchical ladder and the scatter form both hand the
+        # unpack a FLAT reduced buffer; the psum forms keep shape.
+        flat_form = bool(scatter or hier)
         try:
             with _jax.named_scope(scope):
-                if scatter:
-                    with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
-                        flat = (jnp.concatenate(
-                            [compressed[i].ravel() for i in members])
-                            if len(members) > 1
-                            else compressed[members[0]].ravel())
+                if hier:
+                    flat = _pack_flat(members, bucket_name)
+                    size = flat.size
+                    layout = hier_bucket_layout(size, n, hier,
+                                                quantized=hier_q)
+                    pad = layout["padded_elems"] - size
+                    if pad:
+                        flat = jnp.pad(flat, (0, pad))
+                    # Average: divide the dequantized/summed 1/inner
+                    # shard BEFORE the gather (commutes elementwise —
+                    # bit-identical to a tail divide, 1/inner the work);
+                    # cast compressors keep the historical tail divide
+                    # so hier-off/on share one division sequence.
+                    div_on_shard = op is mpi_ops.Average and (
+                        hier_q or compression is Compression.none)
+                    r_in = None
+                    if hier_q and residuals is not None:
+                        offr, cnt = ef_map[pi]
+                        r_in = tuple(residuals[offr:offr + cnt])
+                        want = (layout["shard_elems"],)
+                        if tuple(r_in[0].shape) != want:
+                            raise InvalidArgumentError(
+                                f"error-feedback residual for bucket "
+                                f"{bucket_name} arrives with shape "
+                                f"{tuple(r_in[0].shape)}, expected the "
+                                f"per-chip shard {want}: residual "
+                                "leaves are rank-local state and must "
+                                "enter the step sharded P(axis) — pass "
+                                "the train state through models."
+                                "state_partition_specs")
+
+                    def _outer(shard_v, ax, og, _layout=layout,
+                               _r=r_in, _div=div_on_shard, _pi=pi,
+                               _bn=bucket_name, _hq=hier_q):
+                        if _hq:
+                            out_s, res_new = _quantized_outer_exchange(
+                                shard_v, ax, og, quantizer, _layout, _r,
+                                lambda a: _act(_bn, a))
+                            if _r is not None:
+                                offr, cnt = ef_map[_pi]
+                                new_residuals[offr:offr + cnt] = res_new
+                        else:
+                            out_s = lax.psum(shard_v, ax,
+                                             axis_index_groups=og)
+                        if _div:
+                            out_s = out_s / n
+                        return out_s
+
+                    from horovod_tpu.parallel.mesh import (
+                        hierarchical_ladder_in_axis,
+                    )
+
+                    with _act(bucket_name, _tl_names.REDUCESCATTER):
+                        reduced = hierarchical_ladder_in_axis(
+                            flat, axis, hier, outer_exchange=_outer)
+                    if div_on_shard:
+                        for i in members:
+                            averaged[i] = True
+                    if pad:
+                        reduced = reduced[:size]
+                elif scatter:
+                    flat = _pack_flat(members, bucket_name)
                     size = flat.size
                     pad = (-size) % n
                     if pad:
@@ -339,10 +757,8 @@ def fused_reduce(
                 elif len(members) == 1:
                     reduced = reduce_fn(compressed[members[0]], axis)
                 else:
-                    with _act(bucket_name, _tl_names.MEMCPY_IN_FUSION_BUFFER):
-                        flat = jnp.concatenate(
-                            [compressed[i].ravel() for i in members])
-                    reduced = reduce_fn(flat, axis)
+                    reduced = reduce_fn(_pack_flat(members, bucket_name),
+                                        axis)
         except Exception:
             if emit:
                 tl.end(bucket_name, _tl_names.ALLREDUCE)
@@ -351,7 +767,7 @@ def fused_reduce(
         def _unpack():
             try:
                 with _jax.named_scope(scope):
-                    if len(members) == 1 and not scatter:
+                    if len(members) == 1 and not flat_form:
                         results[members[0]] = reduced
                         return
                     with _act(bucket_name,
@@ -377,12 +793,12 @@ def fused_reduce(
         # backward compute.
         unpacks = [None] * len(plan)
         for k, bi in enumerate(reversed(range(len(plan)))):
-            unpacks[bi] = _issue(k, plan[bi])
+            unpacks[bi] = _issue(k, bi, plan[bi])
         for unpack in unpacks:
             unpack()
     else:
         for k, bucket in enumerate(plan):
-            _issue(k, bucket)()
+            _issue(k, k, bucket)()
 
     out = []
     for i, t in enumerate(tensors):
@@ -390,4 +806,6 @@ def fused_reduce(
         if op is mpi_ops.Average and not averaged[i]:
             r = r / n
         out.append(r.astype(t.dtype) if r.dtype != t.dtype else r)
+    if residuals is not None:
+        return out, tuple(new_residuals)
     return out

@@ -328,6 +328,7 @@ def grouped_allreduce(
     op=None,
     fusion_threshold: Optional[int] = None,
     overlap: Optional[str] = None,
+    hierarchical: Optional[str] = None,
 ):
     """Allreduce a list of tensors as fused flat buckets.
 
@@ -338,7 +339,8 @@ def grouped_allreduce(
     ``lax.psum``, then the results are split back out. One big ICI
     all-reduce amortizes latency exactly like the reference's fusion buffer
     amortized NCCL launch + ring latency. ``overlap`` (auto|on|off)
-    selects the backward-overlapped bucket emission — see
+    selects the backward-overlapped bucket emission and ``hierarchical``
+    (auto|on|off) the two-level ICI/DCN ladder — see
     :mod:`horovod_tpu.jax.fusion`.
     """
     from horovod_tpu.jax.fusion import fused_reduce
@@ -350,6 +352,7 @@ def grouped_allreduce(
         op=op,
         fusion_threshold=fusion_threshold,
         overlap=overlap,
+        hierarchical=hierarchical,
         name=_normalize_name(name) if name else None,
     )
 

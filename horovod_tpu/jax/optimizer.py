@@ -32,12 +32,25 @@ import optax
 from horovod_tpu.common import basics
 from horovod_tpu.common.state import current_spmd_axis, global_state
 from horovod_tpu.jax import mpi_ops
-from horovod_tpu.jax.compression import Compression
-from horovod_tpu.jax.fusion import fused_reduce
+from horovod_tpu.jax.compression import Compression, is_dcn_wire
+from horovod_tpu.jax.fusion import (
+    ef_residual_specs,
+    fused_reduce,
+    resolve_hierarchical,
+)
 
 
 class _AllreduceState(NamedTuple):
-    pass
+    """State of the allreduce transform. ``residuals`` is empty except
+    under a low-bit DCN wire codec (Compression.int8/fp8) on an engaged
+    hierarchical ladder, where it carries the error-feedback residual
+    vectors (:func:`horovod_tpu.jax.fusion.ef_residual_specs`) — GLOBAL
+    shapes at init, rank-local slices inside the SPMD region. These
+    leaves are rank-VARYING state: feed the train state through
+    ``models.state_partition_specs`` (or map them to ``P("hvd")``
+    yourself) so each chip keeps its own slice across steps."""
+
+    residuals: tuple = ()
 
 
 def allreduce_gradients_transform(
@@ -46,6 +59,7 @@ def allreduce_gradients_transform(
     average: bool = True,
     fusion_threshold: Optional[int] = None,
     overlap: Optional[str] = None,
+    hierarchical: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """An optax transform that replaces gradients with their cross-rank
     (fused) allreduce. Composable with any optax chain.
@@ -56,27 +70,73 @@ def allreduce_gradients_transform(
     bucket's gradients become available, so XLA's async collective
     scheduling hides them under remaining backward compute. Dispatch
     shape only — numerics are bit-identical across modes.
+
+    ``hierarchical`` (auto|on|off; default HOROVOD_HIERARCHICAL) runs
+    each bucket as the intra-slice reduce-scatter -> inter-slice (DCN)
+    exchange -> intra-slice all-gather ladder; with
+    ``Compression.int8``/``.fp8`` the DCN leg is absmax-quantized and
+    the quantization error carried forward as an error-feedback
+    residual in this transform's state (re-injected next step, the
+    1-bit-SGD/DGC discipline).
     """
 
+    def _ef_engaged():
+        if not is_dcn_wire(compression):
+            return 0
+        return resolve_hierarchical(hierarchical, basics.size())
+
     def init_fn(params):
-        del params
-        return _AllreduceState()
+        inner = _ef_engaged()
+        if not inner:
+            return _AllreduceState()
+        st = global_state()
+        threshold = (fusion_threshold if fusion_threshold is not None
+                     else st.config.fusion_threshold)
+        leaves = jax.tree_util.tree_leaves(params)
+        specs = ef_residual_specs(leaves, threshold, basics.size(), inner)
+        return _AllreduceState(residuals=tuple(
+            jnp.zeros(s.shape, s.dtype) for s in specs))
 
     def update_fn(updates, state, params=None):
         del params
         leaves, treedef = jax.tree_util.tree_flatten(updates)
-        reduced = fused_reduce(
-            leaves,
+        kwargs = dict(
             average=average,
             compression=compression,
             op=op,
             fusion_threshold=fusion_threshold,
             overlap=overlap,
+            hierarchical=hierarchical,
             name="grads",
         )
+        if state.residuals:
+            reduced, new_res = fused_reduce(
+                leaves, residuals=state.residuals, **kwargs)
+            state = _AllreduceState(residuals=new_res)
+        else:
+            reduced = fused_reduce(leaves, **kwargs)
         return jax.tree_util.tree_unflatten(treedef, reduced), state
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def ef_state_partition_specs(opt_state, axis_name: str = "hvd"):  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    """Partition specs for an optimizer state that may contain
+    :class:`_AllreduceState` error-feedback residuals: residual vectors
+    get ``P(axis)`` (rank-local shards), everything else replicated.
+    ``models.state_partition_specs`` composes this with the ZeRO spec
+    derivation; use directly when hand-building specs."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(node):
+        if isinstance(node, _AllreduceState):
+            return _AllreduceState(residuals=tuple(
+                P(axis_name) for _ in node.residuals))
+        return P()
+
+    return jax.tree_util.tree_map(
+        spec_for, opt_state,
+        is_leaf=lambda n: isinstance(n, _AllreduceState))
 
 
 def DistributedOptimizer(
@@ -88,6 +148,7 @@ def DistributedOptimizer(
     average: bool = True,
     fusion_threshold: Optional[int] = None,
     overlap: Optional[str] = None,
+    hierarchical: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see cross-rank-averaged gradients.
 
@@ -101,7 +162,10 @@ def DistributedOptimizer(
     (torch/__init__.py:71-73,114-130).
 
     ``overlap`` (auto|on|off) selects the backward-overlapped bucket
-    schedule — see :func:`allreduce_gradients_transform`.
+    schedule and ``hierarchical`` (auto|on|off) the two-level
+    ICI/DCN ladder (with error-feedback residuals in this optimizer's
+    state under ``Compression.int8``/``.fp8``) — see
+    :func:`allreduce_gradients_transform`.
     """
     del named_parameters
     chain = optax.chain(
@@ -111,6 +175,7 @@ def DistributedOptimizer(
             average=average,
             fusion_threshold=fusion_threshold,
             overlap=overlap,
+            hierarchical=hierarchical,
         ),
         optimizer,
     )

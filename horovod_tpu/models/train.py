@@ -64,6 +64,7 @@ def create_train_state(
     backward_passes_per_step: int = 1,
     zero: bool = False,
     overlap: Optional[str] = None,
+    hierarchical: Optional[str] = None,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params/batch_stats and the (wrapped) optimizer state.
 
@@ -76,6 +77,13 @@ def create_train_state(
     (:mod:`horovod_tpu.jax.fusion`): dispatch shape only, numerics are
     bit-identical across modes. Ignored with ``zero=True`` (the ZeRO
     path is already reduce-scatter shaped).
+
+    ``hierarchical`` (auto|on|off; default HOROVOD_HIERARCHICAL) runs
+    each gradient bucket as the two-level ICI/DCN ladder; with
+    ``compression=Compression.int8``/``.fp8`` the DCN leg is quantized
+    and the optimizer state carries rank-local error-feedback
+    residuals — feed the state through :func:`state_partition_specs`
+    (it maps them to ``P("hvd")``).
 
     ``zero=True`` uses ZeRO-1 optimizer-state sharding instead
     (:mod:`horovod_tpu.jax.zero`): same wire bytes, optimizer state and
@@ -106,6 +114,7 @@ def create_train_state(
             compression=compression,
             backward_passes_per_step=backward_passes_per_step,
             overlap=overlap,
+            hierarchical=hierarchical,
         )
     opt_state = optimizer.init(params)
     state = TrainState(
@@ -212,17 +221,36 @@ def make_windowed_train_step(model, optimizer: optax.GradientTransformation,
 
 def state_partition_specs(state: TrainState):
     """Partition-spec pytree for a :class:`TrainState`: everything
-    replicated except ZeRO-sharded optimizer-state vectors (which get
-    ``P("hvd")``). Pass as both ``in_specs`` and the state half of
-    ``out_specs`` when training with ``create_train_state(..., zero=True)``."""
+    replicated except the rank-sharded optimizer-state vectors —
+    ZeRO-sharded flats and hierarchical error-feedback residuals — which
+    get ``P("hvd")``. Pass as both ``in_specs`` and the state half of
+    ``out_specs`` when training with ``create_train_state(..., zero=True)``
+    or with a low-bit DCN wire codec (``compression=Compression.int8`` /
+    ``.fp8`` + hierarchical)."""
+    import jax as _jax
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.jax import zero as _zero
+    from horovod_tpu.jax.optimizer import (
+        _AllreduceState,
+        ef_state_partition_specs,
+    )
 
+    def spec_for(node):
+        if isinstance(node, _zero.ZeroState):
+            return _zero.state_partition_specs(node)
+        if isinstance(node, _AllreduceState):
+            return ef_state_partition_specs(node)
+        return P()
+
+    opt_spec = _jax.tree_util.tree_map(
+        spec_for, state["opt_state"],
+        is_leaf=lambda n: isinstance(n, (_zero.ZeroState,
+                                         _AllreduceState)))
     return TrainState(
         params=P(),
         batch_stats=P(),
-        opt_state=_zero.state_partition_specs(state["opt_state"]),
+        opt_state=opt_spec,
         step=P(),
     )
 

@@ -51,6 +51,98 @@ def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     return Mesh(arr, tuple(names))
 
 
+def slice_topology(devices=None):
+    """``(num_domains, chips_per_domain)`` of the device set's DCN
+    topology: devices grouped by ``slice_index`` (multi-slice TPU
+    runtimes expose it; the T5X ``create_hybrid_device_mesh`` signal,
+    SNIPPETS.md [2]) or, when absent, by ``process_index`` (the
+    reference's node boundary, operations.cc:1760-1797). Heterogeneous
+    domain sizes raise — mirroring the reference's is_homogeneous
+    degradation rule (operations.cc:1303-1315)."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    counts: Dict[int, int] = {}
+    has_slice = any(getattr(d, "slice_index", None) is not None
+                    for d in devices)
+    for d in devices:
+        key = (getattr(d, "slice_index", None) if has_slice
+               else getattr(d, "process_index", 0))
+        counts[key if key is not None else -1] = counts.get(
+            key if key is not None else -1, 0) + 1
+    sizes = set(counts.values())
+    if len(sizes) > 1:
+        raise InvalidArgumentError(
+            "heterogeneous chips-per-domain layout; pass inner= "
+            f"explicitly (saw {sorted(sizes)})")
+    per = next(iter(sizes)) if sizes else 1
+    return len(counts), per
+
+
+def dcn_present(devices=None) -> bool:
+    """True when the device set spans a DCN boundary (more than one
+    slice/process domain) — what HOROVOD_HIERARCHICAL=auto keys off."""
+    try:
+        domains, _ = slice_topology(devices)
+    except InvalidArgumentError:
+        return True  # heterogeneous = definitely multi-domain
+    return domains > 1
+
+
+def hybrid_mesh(ici_axes: Optional[Dict[str, int]] = None,
+                dcn_axes: Optional[Dict[str, int]] = None,
+                devices=None) -> Mesh:
+    """Two-level ICI x DCN mesh, the T5X ``create_hybrid_device_mesh``
+    pattern (SNIPPETS.md [2]): DCN axes major (striding across slices),
+    ICI axes minor (contiguous within a slice), so a collective over the
+    ICI axes never crosses the data-center network and a collective over
+    the DCN axes moves only already-reduced shards.
+
+    ``ici_axes``/``dcn_axes`` map axis name -> size in major-to-minor
+    order; the ICI product must equal chips-per-slice and the DCN
+    product the slice count (both default to the detected
+    :func:`slice_topology`, axes named "ici"/"dcn"). Devices are
+    ordered slice-major so each slice's chips are contiguous on the
+    flattened mesh — the layout the in-axis ladder
+    (:func:`hierarchical_allreduce_in_axis` / fusion.py's hierarchical
+    buckets) assumes for its ``axis_index_groups``.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    domains, per = slice_topology(devices)
+    if ici_axes is None:
+        ici_axes = {"ici": per}
+    if dcn_axes is None:
+        dcn_axes = {"dcn": domains}
+    ici_n = math.prod(ici_axes.values())
+    dcn_n = math.prod(dcn_axes.values())
+    if ici_n * dcn_n != len(devices):
+        raise InvalidArgumentError(
+            f"hybrid mesh {dict(dcn_axes)} x {dict(ici_axes)} needs "
+            f"{ici_n * dcn_n} devices, have {len(devices)}")
+    # On a REAL multi-domain topology the ICI axes must tile exactly one
+    # slice (and the DCN axes the slice count) — an ICI axis spanning a
+    # DCN boundary would silently run the "fast" legs over the slow
+    # fabric. Single-domain device sets (the CPU virtual-mesh testing
+    # path) may factor freely: every boundary there is virtual.
+    if domains > 1 and (ici_n != per or dcn_n != domains):
+        raise InvalidArgumentError(
+            f"hybrid mesh ICI axes {dict(ici_axes)} x DCN axes "
+            f"{dict(dcn_axes)} do not tile the detected topology of "
+            f"{domains} domain(s) x {per} chip(s): ICI product must be "
+            f"{per} and DCN product {domains}, or an ICI axis would "
+            "cross a DCN boundary")
+    # Slice-major device order: group by domain, concatenate.
+    has_slice = any(getattr(d, "slice_index", None) is not None
+                    for d in devices)
+    keyed = sorted(
+        devices,
+        key=lambda d: ((getattr(d, "slice_index", 0) or 0) if has_slice
+                       else getattr(d, "process_index", 0),
+                       d.id))
+    sizes = list(dcn_axes.values()) + list(ici_axes.values())
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    arr = np.asarray(keyed).reshape(sizes)
+    return Mesh(arr, names)
+
+
 def hierarchical_mesh(devices=None, inner: Optional[int] = None,
                       outer_axis: str = "dcn",
                       inner_axis: str = "ici") -> Mesh:
@@ -96,17 +188,44 @@ def outer_groups(size: int, inner: int):
             for i in range(inner)]
 
 
+def hierarchical_ladder_in_axis(flat, axis: str, inner: int,
+                                outer_exchange=None):
+    """The two-level ladder INSIDE a flat 1-D SPMD axis, via
+    ``axis_index_groups`` — no second mesh axis needed. This is the
+    shared rung every hierarchical consumer runs (fusion.py's bucket
+    path, the per-tensor wrapper below): reduce-scatter within the fast
+    (ICI) group, exchange the 1/``inner`` shard across the slow (DCN)
+    group, all-gather within the fast group. The cross-domain phase
+    moves size/inner bytes per chip — the bandwidth property the
+    reference's hierarchical design bought (operations.cc:1284-1436).
+
+    ``flat`` must be 1-D with ``flat.size % inner == 0``.
+    ``outer_exchange(shard, axis, outer_groups)`` replaces the default
+    cross-domain ``lax.psum`` — fusion.py passes the quantized
+    (int8/fp8) DCN wire exchange here. Returns the reduced flat array.
+    """
+    from jax import lax
+
+    size = lax.axis_size(axis)
+    ig = inner_groups(size, inner)
+    og = outer_groups(size, inner)
+    shards = flat.reshape(inner, -1)
+    my_shard = lax.psum_scatter(shards, axis, scatter_dimension=0,
+                                axis_index_groups=ig, tiled=False)
+    if outer_exchange is None:
+        my_shard = lax.psum(my_shard, axis, axis_index_groups=og)
+    else:
+        my_shard = outer_exchange(my_shard, axis, og)
+    return lax.all_gather(my_shard, axis, axis=0,
+                          axis_index_groups=ig).reshape(-1)
+
+
 def hierarchical_allreduce_in_axis(x, axis: str, inner: int,
                                    average: bool = False):
-    """Two-level allreduce INSIDE a flat 1-D SPMD axis, via
-    ``axis_index_groups`` — no second mesh axis needed.
-
-    Same ladder as the reference's hierarchical path (operations.cc:
-    1284-1436): reduce-scatter within the fast (ICI) group, allreduce the
-    1/inner shard across the slow (DCN) group, all-gather within the fast
-    group. The cross-domain phase moves size/inner bytes per chip — the
-    bandwidth property the reference's design bought.
-    """
+    """Two-level allreduce of one tensor inside a flat 1-D SPMD axis — a
+    thin pad/reshape wrapper over :func:`hierarchical_ladder_in_axis`
+    (fusion.py's bucket path runs the same ladder over whole fused
+    buckets)."""
     from jax import lax
     import jax.numpy as jnp
 
@@ -114,20 +233,13 @@ def hierarchical_allreduce_in_axis(x, axis: str, inner: int,
     if inner <= 1 or inner >= size or size % inner != 0:
         out = lax.psum(x, axis)
         return out / size if average else out
-    ig = inner_groups(size, inner)
-    og = outer_groups(size, inner)
     orig_shape = x.shape
     n = x.size
     pad = (-n) % inner
     flat = x.reshape(-1)
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    shards = flat.reshape(inner, -1)
-    my_shard = lax.psum_scatter(shards, axis, scatter_dimension=0,
-                                axis_index_groups=ig, tiled=False)
-    my_shard = lax.psum(my_shard, axis, axis_index_groups=og)
-    full = lax.all_gather(my_shard, axis, axis=0,
-                          axis_index_groups=ig).reshape(-1)
+    full = hierarchical_ladder_in_axis(flat, axis, inner)
     if pad:
         full = full[:n]
     out = full.reshape(orig_shape)

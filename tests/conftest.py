@@ -118,6 +118,81 @@ _SLOW_TESTS = {
     # optimizer/parallel/elastic programs; the gate lanes run here and
     # in tools/check.sh --verify.
     "test_hvdverify.py::test_repo_sweep_is_clean",
+    # ~65s, two whole-bench subprocess runs; stand-ins: the in-process
+    # wire-summary/layout pins (test_hierarchical.py) and the traced
+    # per-leg byte conservation (test_wire_bytes.py hierarchical
+    # params) cover the stamp math — this wrapper pins only the JSON
+    # plumbing, like the other slow-marked bench contract tests.
+    "test_bench.py::test_hierarchical_wire_stamp_in_record",
+    # ~35s: three 24-step LM trainings (fp32 / fp8+EF / fp8 no-EF).
+    # Fast stand-ins: test_error_feedback_time_average_converges pins
+    # the EF mechanics and test_ef_exact_codec_leaves_zero_residual the
+    # Average composition; the LM trajectory pin runs in the CI gate
+    # and tools/check.sh's full lane.
+    "test_hierarchical.py::test_ef_convergence_small_lm",
+    # Round-10 re-budget: the fast lane had grown to ~18 min on the
+    # 1-core box (the 870 s tier-1 window truncated it mid-suite, which
+    # is worse than demoting — a timeout drops ~170 later tests
+    # arbitrarily). Same discipline as round 4: whole-program
+    # subprocess wrappers whose internals have fast in-process
+    # stand-ins move to the slow lane (still in the full CI gate).
+    # 55s whole-bench flash A/B wrapper; stand-ins: the packed-vs-full
+    # grid exactness + grid-table pins in test_parallel.py
+    # TestFlashAttention (fast) cover the kernels, this pins JSON
+    # plumbing like its slow-marked bench siblings.
+    "test_bench.py::test_lm_flash_grid_stamp_and_full_grid_ab",
+    # 33s / 20s / 18s whole-bench subprocess wrappers; stand-ins:
+    # test_elastic.py snapshot pins, ops/attention crossover constants,
+    # and the overlap/bucket-plan pins in test_overlap.py +
+    # tests/test_scaling_model.py respectively.
+    "test_bench.py::test_snapshot_stamp_in_record",
+    "test_bench.py::test_lm_attention_auto_policy",
+    "test_bench.py::test_overlap_and_bucket_stamps_in_record",
+    # 42s TF keras multi-process wrapper; its three TestMultiProcess
+    # siblings are already slow-marked with the same justification
+    # (single-process keras coverage stays fast).
+    "test_tf_binding.py::TestMultiProcess::test_keras_lr_callbacks_and_load_model",
+    # 30s + 20s: the even-vocab (32/8) vocab-parallel xent pair; the
+    # harder ragged 28/8 pair (uneven shards) stays fast and covers the
+    # same chunk math.
+    "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense[32-8]",
+    "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense_in_region[32-8]",
+    # 30s + 24s torch multi-process integration depth; test_ops[2] and
+    # the single-process optimizer tests stay fast (test_ops[3] was
+    # already slow-marked on the same grounds).
+    "test_torch_binding.py::TestMultiProcess::test_distributed_optimizer_converges",
+    "test_torch_binding.py::TestMultiProcess::test_optimizer_features",
+    # 22s + 11s serving-bench subprocess wrappers: their two sibling
+    # contract tests are already slow-marked (stand-ins:
+    # test_serve_engine exactness matrix + the check.sh serve smoke,
+    # which runs BOTH attention modes end-to-end).
+    "test_serve_bench.py::TestServeBenchContract::test_attention_paged_record_contract",
+    "test_serve_bench.py::TestServeBenchContract::test_ab_attention_record_carries_both_sides",
+    # 14s whole-CLI launch wrapper; the TestRunFn in-process launcher
+    # tests (identity env, collectives through the launcher) stay fast,
+    # and the restart-path CLI tests were already slow-marked.
+    "test_launcher.py::TestCLI::test_launch_command_success",
+    # 22s: the in-region ragged-vocab grads variant; its through-
+    # boundary twin test_loss_and_grads_match_dense[28-8] (fast) runs
+    # the same chunk math and ragged shard geometry end-to-end.
+    "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense_in_region[28-8]",
+    # 12s 4-process launcher collective round-trip; test_identity_env
+    # pins the in-process launcher plumbing fast, and the elastic e2e
+    # lanes drive launch_job with real collectives every run.
+    "test_launcher.py::TestRunFn::test_collectives_through_launcher",
+    # 20s: the longest serve-engine exactness matrix entry; the other
+    # exactness classes (eviction-recompute, chunk-invariance, single
+    # request, max_new=1) stay fast in both attention modes, and the
+    # check.sh serve smoke re-pins greedy==lm_decode end-to-end.
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[gather]",
+    # 12s whole-tf.keras rewrap wrapper; the settings plumbing it pins
+    # is asserted by the fast native-core knob tests, full run in CI.
+    "test_review_regressions.py::test_tf_keras_rewrap_honors_new_settings",
+    # 6s each native-lane forked-rank hierarchical variants; the core
+    # ladder exactness (4ranks_2groups) and the degrade rules stay
+    # fast, auth is covered by TestTransportAuth.
+    "test_native_core.py::TestHierarchical::test_hierarchical_authenticated",
+    "test_native_core.py::TestHierarchical::test_group_size_defaults_to_local_size",
 }
 
 

@@ -1,0 +1,58 @@
+"""HVV105 negative: the int8-wire hierarchical ladder at the >2-slice
+shape (inner 2 -> 4 slice groups on the 8-way mesh): the inter-slice
+leg is the TWO-STAGE quantized exchange — all-to-all of int8 sub-shards
++ scale all-gather, dequant-sum, re-quantize, int8 sub-shard all-gather
++ scale all-gather (fusion.py's quantized ring decomposition). The
+reconciliation must accept every leg: rs(padded), a2a(int8 shard),
+ag(int8 sub-shard), two 4 B scale gathers, ag(fp32 shard)."""
+
+import jax.numpy as jnp
+
+from tests.hvdverify_fixtures._common import P, f32
+
+EXPECT = ()
+
+_THRESHOLD = 300
+_INNER = 2
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((130,), jnp.float32),
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8, hier_inner=_INNER,
+                         dcn_dtype="int8")
+
+
+def build():
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.compression import Compression
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+
+    def exchange(a, b):
+        st = global_state()
+        saved = st.config.hierarchical_inner_size
+        st.config.hierarchical_inner_size = _INNER
+        try:
+            return tuple(fused_reduce([a, b], average=True,
+                                      compression=Compression.int8,
+                                      fusion_threshold=_THRESHOLD,
+                                      overlap="on", hierarchical="on",
+                                      name="grads"))
+        finally:
+            st.config.hierarchical_inner_size = saved
+
+    run = hvd.spmd_fn(exchange, in_specs=(P(), P()),
+                      out_specs=(P(), P()))
+    return (lambda *a: run(*a)), (f32(130), f32(64))

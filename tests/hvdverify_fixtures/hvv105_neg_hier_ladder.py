@@ -1,0 +1,55 @@
+"""HVV105 negative: the hierarchical bucket ladder (PR-10 tentpole) at
+the 2-slice shape — every bucket runs intra-slice reduce-scatter ->
+inter-slice shard psum -> intra-slice all-gather under overlap
+(fusion.py, HOROVOD_HIERARCHICAL=on, inner 4 on the 8-way mesh). The
+reconciliation must accept the three-leg decomposition per bucket:
+rs of the inner-padded bucket, psum of the 1/inner shard across the
+slice groups, all-gather of the shard back."""
+
+import jax.numpy as jnp
+
+from tests.hvdverify_fixtures._common import P, f32
+
+EXPECT = ()
+
+_THRESHOLD = 300
+_INNER = 4
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((130,), jnp.float32),  # pads to 132
+            jax.ShapeDtypeStruct((64,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8, hier_inner=_INNER)
+
+
+def build():
+    from horovod_tpu.common.state import global_state
+    from horovod_tpu.jax.fusion import fused_reduce
+
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+
+    def exchange(a, b):
+        st = global_state()
+        saved = st.config.hierarchical_inner_size
+        st.config.hierarchical_inner_size = _INNER
+        try:
+            return tuple(fused_reduce([a, b], average=True,
+                                      fusion_threshold=_THRESHOLD,
+                                      overlap="on", hierarchical="on",
+                                      name="grads"))
+        finally:
+            st.config.hierarchical_inner_size = saved
+
+    run = hvd.spmd_fn(exchange, in_specs=(P(), P()),
+                      out_specs=(P(), P()))
+    return (lambda *a: run(*a)), (f32(130), f32(64))

@@ -1,0 +1,57 @@
+"""HVV105 positive: a "hierarchical" ladder whose inter-slice leg moves
+the FULL bucket across DCN instead of the 1/inner shard — reduce-
+scatter within the slice, then psum of the whole flat buffer across
+slice groups, then the all-gather. The bandwidth property the ladder
+exists for (DCN carries size/inner bytes per chip,
+operations.cc:1284-1436) is silently gone: the job trains correctly and
+scales like a flat psum. The declared hierarchical plan must refuse to
+reconcile the inner-sized DCN psum it promises against the full-sized
+one the trace shows."""
+
+import jax.numpy as jnp  # noqa: F401
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV105",)
+
+_THRESHOLD = 1 << 20
+_INNER = 4
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((128,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8, hier_inner=_INNER)
+
+
+def build():
+    from jax import lax
+
+    from horovod_tpu.parallel.mesh import inner_groups, outer_groups
+
+    ig = inner_groups(8, _INNER)
+    og = outer_groups(8, _INNER)
+
+    def exchange(a):
+        flat = a.ravel()
+        shards = flat.reshape(_INNER, -1)
+        my = lax.psum_scatter(shards, "hvd", scatter_dimension=0,
+                              axis_index_groups=ig, tiled=False)
+        # BUG: the DCN leg reduces the FULL flat buffer (inner x the
+        # shard) — the gather below then uses only the local rows, so
+        # numerics survive while the DCN win is gone.
+        full = lax.psum(flat, "hvd", axis_index_groups=og)
+        my = my + 0.0 * full[: my.shape[0]]
+        out = lax.all_gather(my, "hvd", axis=0,
+                             axis_index_groups=ig).reshape(-1)
+        return out.reshape(a.shape) / 8.0
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(),), out_specs=P())
+    return fn, (f32(128),)

@@ -1,0 +1,52 @@
+"""HVV105 positive: a hand-rolled hierarchical ladder that reduce-
+scatters within the slice and psums the shard across slices but NEVER
+all-gathers the shard back — every chip is left holding 1/inner of the
+reduced bucket while the step "reconstructs" the rest by local
+broadcast of its own shard. The training bug this encodes: the ladder's
+third rung is dropped (or gathered over the wrong groups) and 3/4 of
+every parameter update silently comes from the wrong shard — no crash,
+just divergence. The declared hierarchical plan must flag the missing
+intra-slice all-gather leg."""
+
+import jax.numpy as jnp
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV105",)
+
+_THRESHOLD = 1 << 20
+_INNER = 4
+
+
+def _leaves():
+    import jax
+
+    return [jax.ShapeDtypeStruct((128,), jnp.float32)]
+
+
+def RECONCILE():
+    from tools.hvdverify.rules import ReconcileSpec
+
+    return ReconcileSpec(leaves=_leaves(), threshold=_THRESHOLD,
+                         axis_size=8, hier_inner=_INNER)
+
+
+def build():
+    from jax import lax
+
+    from horovod_tpu.parallel.mesh import inner_groups, outer_groups
+
+    ig = inner_groups(8, _INNER)
+    og = outer_groups(8, _INNER)
+
+    def exchange(a):
+        flat = a.ravel()
+        shards = flat.reshape(_INNER, -1)
+        my = lax.psum_scatter(shards, "hvd", scatter_dimension=0,
+                              axis_index_groups=ig, tiled=False)
+        my = lax.psum(my, "hvd", axis_index_groups=og)
+        # BUG: no intra-slice all-gather — tile the local shard instead.
+        return jnp.tile(my, _INNER).reshape(a.shape) / 8.0
+
+    fn = shmap(exchange, mesh(hvd=8), in_specs=(P(),), out_specs=P())
+    return fn, (f32(128),)

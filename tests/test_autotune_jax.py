@@ -15,7 +15,25 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture(autouse=True)
+def _restore_tuned_config(hvd):
+    """Every StepAutotuner constructed here mutates the live config
+    (thresholds, the hierarchical bool AND the tri-state knob — the
+    tuner's whole job is persistent application); restore all of it so
+    tuner tests cannot leak a pinned "on"/"off" into the rest of the
+    session (resolve_hierarchical reads the tri-state default)."""
+    from horovod_tpu.common.state import global_state
+
+    cfg = global_state().config
+    saved = (cfg.fusion_threshold, cfg.hierarchical_allreduce,
+             cfg.hierarchical_inner_size, cfg.hierarchical)
+    yield
+    (cfg.fusion_threshold, cfg.hierarchical_allreduce,
+     cfg.hierarchical_inner_size, cfg.hierarchical) = saved
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -173,7 +191,7 @@ def test_tuner_flips_hierarchy_by_measured_speed(hvd, monkeypatch):
 
     st = global_state()
     saved = (st.config.fusion_threshold, st.config.hierarchical_allreduce,
-             st.config.hierarchical_inner_size)
+             st.config.hierarchical_inner_size, st.config.hierarchical)
     fake_now = [0.0]
     monkeypatch.setattr(at.time, "perf_counter", lambda: fake_now[0])
 
@@ -202,14 +220,19 @@ def test_tuner_flips_hierarchy_by_measured_speed(hvd, monkeypatch):
         t_on = run(hier_faster=True)
         assert t_on.best_hierarchical is True
         assert st.config.hierarchical_allreduce is True
+        # The tri-state knob is pinned alongside the legacy bool, so a
+        # flat candidate cannot ladder through the "auto" default on a
+        # DCN-present mesh.
+        assert st.config.hierarchical == "on"
 
         t_off = run(hier_faster=False)
         assert t_off.best_hierarchical is False
         assert st.config.hierarchical_allreduce is False
+        assert st.config.hierarchical == "off"
     finally:
         st.autotuner = None
         (st.config.fusion_threshold, st.config.hierarchical_allreduce,
-         st.config.hierarchical_inner_size) = saved
+         st.config.hierarchical_inner_size, st.config.hierarchical) = saved
 
 
 def test_owner_handoff_when_first_handle_goes_idle(hvd):

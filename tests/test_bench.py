@@ -219,6 +219,64 @@ def test_overlap_and_bucket_stamps_in_record():
     assert c["by_kind"] and sum(c["by_kind"].values()) == c["count"]
 
 
+def test_wire_leaves_mirror_fused_reduce_compression():
+    """The wire stamp's plan must be built over the SAME leaves
+    fused_reduce buckets: cast compressors (bf16/fp16) halve floating
+    leaves before planning; none/int8/fp8 plan the raw tree (their
+    compress() is identity at bucketing time)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import wire_leaves
+    from horovod_tpu.jax.compression import Compression
+
+    leaves = [jax.ShapeDtypeStruct((64,), jnp.float32),
+              jax.ShapeDtypeStruct((8,), jnp.int32)]
+    for comp in (Compression.none, Compression.int8, Compression.fp8):
+        assert wire_leaves(leaves, comp) is leaves
+    cast = wire_leaves(leaves, Compression.bf16)
+    assert cast[0].dtype == jnp.bfloat16 and cast[0].shape == (64,)
+    assert cast[1].dtype == jnp.int32  # non-floating leaves untouched
+
+
+def test_hierarchical_wire_stamp_in_record():
+    """--hierarchical on + --compression int8 stamps the resolved ladder
+    knob (mode/inner) and the per-leg wire split (ICI vs DCN operand
+    bytes, DCN dtype, compression ratio) into the record — the evidence
+    the hw_sweep hier/int8 A/B rows and the scaling-model predictions
+    are reconciled against. The int8 error-feedback residuals ride the
+    optimizer state (sharded specs), so the timed step is the REAL
+    quantized exchange, not a stampede of stamps over a flat run."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--hierarchical", "on",
+        "--compression", "int8",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--d-model", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1",
+        extra_env={"HOROVOD_HIERARCHICAL_INNER_SIZE": "4"})
+    assert out["hierarchical"] == {"mode": "on", "inner": 4}
+    w = out["wire"]
+    assert w["dtype"] == "int8" and w["ratio"] > 2.5
+    assert 0 < w["dcn_bytes"] < w["ici_bytes"]
+    assert {"ici_mb", "dcn_mb"} <= set(w)
+    assert out["value"] > 0
+    # The static audit sees the ladder: scatter + gather traffic, and
+    # strictly less reduce payload than a flat psum would carry.
+    c = out["collectives"]
+    assert c["by_kind"].get("all_to_all") or c["by_kind"].get(
+        "all_gather"), c
+    # Ladder off (default auto on a single-slice mesh): stamp says so.
+    out2, _ = _run_bench(
+        "--model", "transformer_lm",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--d-model", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out2["hierarchical"]["inner"] == 0
+    assert out2["wire"] is None
+
+
 def test_snapshot_stamp_in_record():
     """--snapshot-every K measures the elastic host-RAM snapshot cost
     and stamps cadence / ms-per-snapshot / overhead%% into the record

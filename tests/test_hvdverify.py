@@ -104,6 +104,11 @@ def test_registry_shape():
         by_group.setdefault(p.group, []).append(p)
     assert len(by_group["gate"]) >= 9
     assert len(by_group["optimizer"]) == 3
+    # The hierarchical DP exchange programs (PR-10): both DCN exchange
+    # shapes, each byte-reconciled per ladder leg.
+    assert {p.name for p in by_group["dp"]} == {
+        "dp.hier_overlap", "dp.hier_int8"}
+    assert all(p.reconcile is not None for p in by_group["dp"])
     names = {p.name for p in by_group["parallel"]}
     assert names == {
         "parallel.spmd", "parallel.tp", "parallel.pipeline",
@@ -384,6 +389,42 @@ def test_hvv105_flags_untagged_exchange_beside_tagged(hvd):
     assert [f.rule for f in res.findings] == ["HVV105"], [
         f.format() for f in res.findings]
     assert "OUTSIDE the tagged fused exchange" in res.findings[0].message
+
+
+def test_hvv105_flags_flat_trace_under_declared_ladder(hvd):
+    """A program that DECLARES the hierarchical ladder (hier_inner set)
+    but traces one flat full-bytes psum per bucket must NOT reconcile
+    clean: the ladder silently never engaged (resolve_hierarchical
+    config drift) and the inter-slice leg carries inner x the promised
+    bytes — the exact regression that would otherwise keep the dp.*
+    sweep green while the DCN win is gone."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.jax.fusion import fused_reduce
+    from tools.hvdverify.rules import ReconcileSpec
+
+    leaves = [jax.ShapeDtypeStruct((128,), jnp.float32)]
+
+    def exchange(a):
+        return fused_reduce([a], average=True, fusion_threshold=1 << 20,
+                            hierarchical="off", name="grads")[0]
+
+    run = hvd.spmd_fn(exchange, in_specs=(P(),), out_specs=P())
+    result = verify(
+        (lambda a: run(a)), (leaves[0],), name="flat_under_ladder",
+        reconcile=ReconcileSpec(leaves=leaves, threshold=1 << 20,
+                                axis_size=8, hier_inner=4))
+    msgs = [f.message for f in result.findings if f.rule == "HVV105"]
+    assert any("FLAT psum" in m and "ladder" in m for m in msgs), (
+        [f.format() for f in result.findings])
+    # The SAME trace with no ladder declared reconciles clean.
+    clean = verify(
+        (lambda a: run(a)), (leaves[0],), name="flat_no_ladder",
+        reconcile=ReconcileSpec(leaves=leaves, threshold=1 << 20,
+                                axis_size=8))
+    assert not clean.findings, [f.format() for f in clean.findings]
 
 
 def test_hvv105_flags_gather_without_scatter(hvd):

@@ -133,6 +133,87 @@ def test_overlap_dp_step_conserves_gradient_bytes(hvd):
     assert ag * 8 == rs, (ag, rs)
 
 
+@pytest.mark.parametrize("inner,comp_name", [(4, "none"), (4, "int8"),
+                                             (2, "int8")])
+def test_hierarchical_dp_step_wire_bytes(hvd, inner, comp_name):
+    """Hierarchical path (fusion.py, PR-10): per-leg bytes of the DP
+    step's exchange. The intra-slice rs carries the inner-padded
+    buckets and its all-gather the 1/inner shards; the inter-slice
+    (DCN) leg carries exactly the shard bytes — divided by ~4 again
+    under int8 (quantized payloads + 4 B scales) — and the whole split
+    must agree with fusion.hier_wire_summary (the bench "wire" stamp's
+    math), so the stamp is checkable against the traced schedule."""
+    import optax
+
+    import horovod_tpu.jax as hvd_jax
+    from horovod_tpu.jax.fusion import (
+        hier_wire_summary,
+        plan_buckets,
+    )
+    from horovod_tpu.jax.optimizer import DistributedOptimizer
+
+    comp = getattr(hvd_jax.Compression, comp_name)
+    model = models.MNISTNet()
+    state, _ = models.create_train_state(
+        jax.random.PRNGKey(0), model, optax.sgd(0.1, momentum=0.9),
+        jnp.zeros((1, 28, 28, 1)))
+    opt = DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               fusion_threshold=64 * 1024,
+                               hierarchical="on", compression=comp)
+    st = _state.global_state()
+    saved = st.config.hierarchical_inner_size
+    st.config.hierarchical_inner_size = inner
+    try:
+        state["opt_state"] = opt.init(state["params"])
+        spec = models.state_partition_specs(state)
+        step = models.make_train_step(model, opt)
+        batch = {"image": jnp.zeros((16, 28, 28, 1)),
+                 "label": jnp.zeros((16,), jnp.int32)}
+        tok = _state.set_spmd_axis("hvd")
+        try:
+            jaxpr = jax.make_jaxpr(jax.shard_map(
+                step, mesh=hvd.mesh(), in_specs=(spec, P("hvd")),
+                out_specs=(spec, P()), check_vma=False))(state, batch)
+        finally:
+            _state.reset_spmd_axis(tok)
+    finally:
+        st.config.hierarchical_inner_size = saved
+    leaves = jax.tree_util.tree_leaves(state["params"])
+    plan = plan_buckets(leaves, 64 * 1024)
+    expect = hier_wire_summary(plan, 8, inner, comp)
+    colls = collect_collectives(jaxpr)
+    # The flat parameter-sized psum must be GONE (metric scalars stay).
+    big_psums = [b for n, b in colls if n.startswith("psum") and b > 64]
+    rs = sum(b for n, b in colls
+             if n in ("reduce_scatter", "psum_scatter"))
+    ag = sum(b for n, b in colls if n == "all_gather")
+    a2a = sum(b for n, b in colls if n == "all_to_all")
+    grad_bytes = sum(l.size * 4 for l in leaves)
+    assert grad_bytes <= rs <= grad_bytes + 8 * inner * 4 * len(plan)
+    if comp_name == "none":
+        # DCN leg = shard psums (payload = padded/inner each).
+        dcn = sum(b for b in big_psums)
+        assert dcn == expect["dcn_bytes"], (dcn, expect)
+        assert rs + ag + dcn == (expect["ici_bytes"]
+                                 + expect["dcn_bytes"])
+        assert not a2a
+    else:
+        # DCN leg = quantized payloads + scale scalars; nothing
+        # gradient-sized psums anymore.
+        assert not big_psums, big_psums
+        int8_bytes = sum(b for n, b in colls
+                         if n in ("all_gather", "all_to_all"))
+        # Everything on the wire reconciles with the static stamp.
+        assert rs + int8_bytes == (expect["ici_bytes"]
+                                   + expect["dcn_bytes"]), (
+            rs, int8_bytes, expect)
+    # The headline property: DCN bytes <= 1/inner of the flat psum
+    # bytes, and /4 again (up to scale scalars) under int8.
+    assert expect["dcn_bytes"] <= grad_bytes / inner + 8 * 4 * len(plan)
+    if comp_name == "int8":
+        assert expect["dcn_bytes"] < grad_bytes / inner / 2
+
+
 def test_zero_step_reduce_scatters_instead_of_allreducing(hvd):
     colls, grad_bytes = _trace_step(zero=True)
     names = {n for n, _ in colls}

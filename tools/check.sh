@@ -7,8 +7,9 @@
 #                               be at zero unsuppressed findings) + the
 #                               hvdlint fixture/suppression test suite +
 #                               the hvdverify rule fixtures + fast-group
-#                               registry sweep (optimizer/parallel/elastic
-#                               programs at zero unsuppressed findings) +
+#                               registry sweep (optimizer/dp/parallel/
+#                               elastic/serve programs at zero
+#                               unsuppressed findings) +
 #                               the elastic fault-injection smoke (real
 #                               `hvdrun --elastic` jobs: rank 1 lost to a
 #                               HOROVOD_FAULT_PLAN SIGKILL mid-run must
@@ -28,12 +29,21 @@
 #                               TWICE, once per decode-attention path —
 #                               the gather reference and the fused paged
 #                               kernel in interpret mode)
+#                               + the hierarchical smoke (a 2x2 virtual
+#                               hybrid ICI x DCN mesh on CPU: the
+#                               hybrid_mesh factory builds, the bucket
+#                               ladder with HOROVOD_HIERARCHICAL=on is
+#                               bit-exact vs the flat psum at
+#                               Compression.none, the int8 DCN wire
+#                               stays inside tolerance, and the static
+#                               DCN byte split lands under flat/inner/2)
 #   tools/check.sh --verify     additionally run the FULL hvdverify sweep
 #                               (`python -m tools.hvdverify --sweep`): all
 #                               registry programs incl. the 9 driver gate
 #                               lanes traced at zero unsuppressed findings
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --no-serve   skip the serving smoke
+#   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
 #                               the self-building loader) and run the
@@ -46,14 +56,16 @@ cd "$(dirname "$0")/.."
 SANITIZE=0
 ELASTIC=1
 SERVE=1
+HIER=1
 VERIFY=0
 for arg in "$@"; do
   case "$arg" in
     --sanitize) SANITIZE=1 ;;
     --no-elastic) ELASTIC=0 ;;
     --no-serve) SERVE=0 ;;
+    --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -103,6 +115,63 @@ print("serve smoke [%s]: all 8 finished, TTFT p50/p99 = %s/%s ms, "
                               a["kv_fetch_frac"]))
 '
   done
+fi
+
+if [[ "$HIER" == "1" ]]; then
+  echo "== hierarchical smoke (2x2 virtual hybrid mesh: ladder exact, int8 DCN wire in tolerance) =="
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.common.state import global_state
+from horovod_tpu.jax.fusion import fused_reduce, hier_wire_summary, plan_buckets
+from horovod_tpu.parallel.mesh import hybrid_mesh
+
+hvd.init()
+st = global_state()
+st.config.hierarchical_inner_size = 2
+
+# The factory builds a 2x2 ICI x DCN mesh over the virtual devices.
+mesh = hybrid_mesh(ici_axes={"ici": 2}, dcn_axes={"dcn": 2})
+assert mesh.devices.shape == (2, 2), mesh.devices.shape
+
+rng = np.random.RandomState(0)
+bases = [np.asarray(rng.randint(-8, 8, size=s), np.float32)
+         for s in [(33,), (257,)]]
+
+
+def run(hier, comp):
+    def fn():
+        ts = [b * (hvd.rank() + 1).astype(b.dtype) for b in bases]
+        return tuple(fused_reduce(ts, average=True, compression=comp,
+                                  fusion_threshold=400, hierarchical=hier))
+    return [np.asarray(o) for o in hvd.spmd_run(fn)]
+
+
+flat = run("off", hvd.Compression.none)
+for f, l in zip(flat, run("on", hvd.Compression.none)):
+    np.testing.assert_array_equal(f, l)  # exactness gate
+for f, g in zip(flat, run("on", hvd.Compression.int8)):
+    err = float(np.max(np.abs(f - g)))
+    lim = 0.05 * max(1.0, float(np.max(np.abs(f))))
+    assert err < lim, (err, lim)         # tolerance gate
+
+leaves = [jax.ShapeDtypeStruct(b.shape, b.dtype) for b in bases]
+plan = plan_buckets(leaves, 400)
+wire = hier_wire_summary(plan, 4, 2, hvd.Compression.int8)
+flat_b = sum(b.nbytes for b in plan)
+assert wire["dcn_bytes"] <= flat_b / 2 / 2, wire
+print("hier smoke: 2x2 hybrid mesh OK — ladder bit-exact, int8 DCN "
+      "%d B vs %d B flat (x%s)" % (wire["dcn_bytes"], flat_b,
+                                   wire["ratio"]))
+EOF
 fi
 
 if [[ "$SANITIZE" == "1" ]]; then

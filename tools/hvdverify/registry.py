@@ -15,6 +15,10 @@ structure). Groups:
 * ``optimizer``— DistributedOptimizer's fused / overlap / scatter
                  emission modes, each with an HVV105 ReconcileSpec
                  pinning the traced bytes to ``plan_buckets``.
+* ``dp``       — the hierarchical DP exchange (HOROVOD_HIERARCHICAL)
+                 in both DCN shapes: the 2-slice ladder under overlap
+                 and the int8-wire 4-slice two-stage exchange, each
+                 HVV105-reconciled per ladder leg.
 * ``parallel`` — all six hand-rolled sharding modules
                  (spmd collectives, tp, pipeline, ulysses,
                  ring_attention, moe), gradients included where the
@@ -315,6 +319,73 @@ def _optimizer_mode(*, overlap, scatter):
     return build, reconcile
 
 
+def _dp_hier_mode(*, inner, compression_name):
+    """The hierarchical DP exchange (PR-10 tentpole) traced in one
+    emission mode over the MNIST tree: every bucket must decompose into
+    intra-slice reduce-scatter -> inter-slice exchange (quantized under
+    int8) -> intra-slice all-gather, HVV105-reconciled per leg.
+    ``inner=4`` on the 8-way mesh is the 2-slice (all-gather DCN
+    exchange) shape; ``inner=2`` the 4-slice two-stage
+    all-to-all shape."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.common.state import global_state
+        from horovod_tpu.jax.compression import Compression
+        from horovod_tpu.jax.fusion import fused_reduce
+
+        hvd = _init()
+        leaves = _mnist_param_leaves()
+        compression = getattr(Compression, compression_name)
+
+        def exchange(*grads):
+            # Inner-size pinned at TRACE time (build() must not leak
+            # config into later registry programs).
+            st = global_state()
+            saved = st.config.hierarchical_inner_size
+            st.config.hierarchical_inner_size = inner
+            try:
+                return tuple(fused_reduce(
+                    list(grads), average=True,
+                    fusion_threshold=_OPT_THRESHOLD,
+                    overlap="on", hierarchical="on",
+                    compression=compression,
+                    name="grads"))
+            finally:
+                st.config.hierarchical_inner_size = saved
+
+        run = hvd.spmd_fn(
+            exchange,
+            in_specs=tuple(P() for _ in leaves),
+            out_specs=tuple(P() for _ in leaves),
+        )
+        args = tuple(jax.ShapeDtypeStruct(l.shape, jnp.float32)
+                     for l in leaves)
+        return (lambda *a: run(*a)), args
+
+    def reconcile():
+        from horovod_tpu.jax.compression import Compression
+        from horovod_tpu.jax.compression import is_dcn_wire
+
+        import jax.numpy as jnp
+
+        compression = getattr(Compression, compression_name)
+        dcn_dtype = (jnp.dtype(compression.wire_dtype).name
+                     if is_dcn_wire(compression) else None)
+        return ReconcileSpec(
+            leaves=_mnist_param_leaves(),
+            threshold=_OPT_THRESHOLD,
+            axis_size=WORLD,
+            hier_inner=inner,
+            dcn_dtype=dcn_dtype,
+        )
+
+    return build, reconcile
+
+
 # ------------------------------------------------------------- parallel
 
 
@@ -609,6 +680,15 @@ def _make_registry() -> List[Program]:
         progs.append(Program(f"optimizer.{mode}", "optimizer", build,
                              reconcile=reconcile))
 
+    # The hierarchical DP exchange (PR-10): the 2-slice ladder under
+    # overlap, and the int8-wire 4-slice two-stage shape — each leg
+    # HVV105-reconciled against fusion.hier_bucket_layout.
+    for pname, inner, comp in (("dp.hier_overlap", 4, "none"),
+                               ("dp.hier_int8", 2, "int8")):
+        build, reconcile = _dp_hier_mode(inner=inner,
+                                         compression_name=comp)
+        progs.append(Program(pname, "dp", build, reconcile=reconcile))
+
     # All six hand-rolled sharding modules.
     progs += [
         Program("parallel.spmd", "parallel",
@@ -667,7 +747,7 @@ REGISTRY: List[Program] = _make_registry()
 #: Programs cheap enough for the fast (tier-1) sweep pin: everything
 #: except the big-model gate lanes, whose tracing cost belongs to the
 #: full-suite / check.sh --verify gate.
-FAST_GROUPS = ("optimizer", "parallel", "elastic", "serve")
+FAST_GROUPS = ("optimizer", "dp", "parallel", "elastic", "serve")
 
 
 def programs(groups=None, names=None) -> List[Program]:

@@ -39,7 +39,9 @@ RULES: Dict[str, str] = {
               "invariant)",
     "HVV105": "static wire-byte accounting does not reconcile with the "
               "declared fusion bucket plan "
-              "(horovod_tpu.jax.fusion.plan_buckets)",
+              "(horovod_tpu.jax.fusion.plan_buckets; flat psum, "
+              "scatter rs+ag, or the hierarchical rs->exchange->ag "
+              "ladder incl. quantized DCN legs)",
 }
 
 
@@ -80,12 +82,23 @@ class ReconcileSpec:
     bucketed exchange reduces; ``threshold``: the fusion threshold the
     plan was built with; ``axis_size``: the collective axis size (the
     scatter form pads flat buckets to a multiple of it).
+
+    ``hier_inner`` declares the hierarchical ladder (HOROVOD_
+    HIERARCHICAL, fusion.py): each bucket must decompose into
+    intra-slice reduce-scatter -> inter-slice exchange of the
+    1/inner shard -> intra-slice all-gather. ``dcn_dtype`` (e.g.
+    "int8"/"float8_e4m3fn") additionally declares the low-bit DCN
+    wire: floating buckets' inter-slice leg must be the quantized
+    exchange (payload + scalar scale all-gathers; the two-stage
+    all-to-all shape at >2 slices) instead of a shard psum.
     """
 
     leaves: Sequence
     threshold: int
     axis_size: int
     axis: str = "hvd"  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    hier_inner: int = 0
+    dcn_dtype: Optional[str] = None
 
 
 def _pad_up(nbytes: int, quantum: int) -> int:
@@ -101,6 +114,16 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
 
     * a ``psum`` entry whose payload equals the bucket's bytes (the
       fused flat allreduce), or
+    * when ``spec.hier_inner`` is set, the hierarchical rs->exchange->ag
+      decomposition (fusion.hier_bucket_layout — the SAME layout the
+      executing path computes): a ``psum_scatter`` of the
+      inner-padded bucket, the inter-slice leg (a shard ``psum``, or
+      under ``spec.dcn_dtype`` the quantized payload + scale
+      all-gathers / two-stage all-to-all), and the intra-slice
+      ``all_gather`` of the shard — any missing or mis-sized leg is a
+      finding, and a bucket traced as one FLAT full-bytes psum under a
+      declared ladder is a finding too (a ladder that silently never
+      engaged must not keep the sweep green); or
     * a ``reduce_scatter``/``psum_scatter`` entry whose payload equals
       the bucket's bytes padded up to ``axis_size`` elements (the
       overlap scatter form) AND a matching ``all_gather`` of the 1/n
@@ -120,7 +143,7 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
 
     plan = plan_buckets(list(spec.leaves), spec.threshold)
     exchange_kinds = ("psum", "psum2", "reduce_scatter", "psum_scatter",
-                      "all_gather")
+                      "all_gather", "all_to_all")
     tagged = [op for op in schedule if "hvd_allreduce" in op.name_stack
               and spec.axis in op.axes]
     used_tag_filter = bool(tagged)
@@ -159,6 +182,7 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
     scatters = [op for op in tagged
                 if op.kind in ("reduce_scatter", "psum_scatter")]
     gathers = [op for op in tagged if op.kind == "all_gather"]
+    a2as = [op for op in tagged if op.kind == "all_to_all"]
 
     def _take(pool, nbytes):
         for i, op in enumerate(pool):
@@ -166,9 +190,93 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
                 return pool.pop(i)
         return None
 
+    def _match_hier(bucket, itemsize) -> Optional[List[str]]:
+        """Try the hierarchical decomposition for ``bucket``: returns
+        None when the intra-slice reduce-scatter itself is absent (the
+        bucket may match another form), else the list of missing/
+        mis-sized legs (empty = fully reconciled)."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.jax.fusion import hier_bucket_layout
+
+        quantized = (spec.dcn_dtype is not None
+                     and np.issubdtype(np.dtype(bucket.dtype),
+                                       np.floating))
+        layout = hier_bucket_layout(
+            bucket.nbytes // itemsize, spec.axis_size, spec.hier_inner,
+            quantized=quantized)
+        if _take(scatters, layout["padded_elems"] * itemsize) is None:
+            return None
+        shard_e = layout["shard_elems"]
+        missing: List[str] = []
+        if quantized:
+            wire_isz = jnp.dtype(spec.dcn_dtype).itemsize
+            if layout["two_stage"]:
+                if _take(a2as, shard_e * wire_isz) is None:
+                    missing.append(
+                        f"{shard_e * wire_isz} B quantized "
+                        f"({spec.dcn_dtype}) inter-slice all-to-all")
+                if _take(gathers,
+                         layout["sub_elems"] * wire_isz) is None:
+                    missing.append(
+                        f"{layout['sub_elems'] * wire_isz} B quantized "
+                        "sub-shard all-gather")
+                scale_count = 2
+            else:
+                if _take(gathers, shard_e * wire_isz) is None:
+                    missing.append(
+                        f"{shard_e * wire_isz} B quantized "
+                        f"({spec.dcn_dtype}) shard all-gather")
+                scale_count = 1
+            for _ in range(scale_count):
+                if _take(gathers, 4) is None:
+                    missing.append("4 B scale all-gather")
+            ag_bytes = shard_e * 4  # dequant-summed in fp32
+        else:
+            if _take(reduces, shard_e * itemsize) is None:
+                missing.append(
+                    f"{shard_e * itemsize} B inter-slice (DCN) shard "
+                    "psum")
+            ag_bytes = shard_e * itemsize
+        if _take(gathers, ag_bytes) is None:
+            missing.append(
+                f"{ag_bytes} B intra-slice all-gather of the shard")
+        return missing
+
     for bucket in plan:
         itemsize = np.dtype(bucket.dtype).itemsize
-        if _take(reduces, bucket.nbytes) is not None:
+        if spec.hier_inner:
+            # Declared ladder: try the three-leg decomposition FIRST —
+            # and refuse to let a flat full-bytes psum reconcile
+            # quietly, or a regression that stops the ladder engaging
+            # (config drift, a lost inner-size pin) would keep the
+            # sweep green while the 1/inner DCN-bytes property is gone.
+            missing = _match_hier(bucket, itemsize)
+            if missing is not None:
+                for leg in missing:
+                    findings.append(Finding(
+                        program, "HVV105",
+                        f"bucket {bucket.dtype}.b{bucket.index} "
+                        f"({bucket.nbytes} B) reduce-scatters on the "
+                        f"hierarchical ladder (inner "
+                        f"{spec.hier_inner}) but its {leg} is missing "
+                        "or mis-sized — the ladder must run rs -> "
+                        "inter-slice exchange -> ag per bucket "
+                        "(fusion.py hierarchical contract)"))
+                continue
+            if _take(reduces, bucket.nbytes) is not None:
+                findings.append(Finding(
+                    program, "HVV105",
+                    f"bucket {bucket.dtype}.b{bucket.index} "
+                    f"({bucket.nbytes} B) traced as ONE FLAT psum "
+                    f"while the plan declares the inner-"
+                    f"{spec.hier_inner} hierarchical ladder: the "
+                    "ladder silently did not engage, and the "
+                    "inter-slice leg carries inner x the bytes the "
+                    "program promises (resolve_hierarchical config "
+                    "drift)"))
+                continue
+        elif _take(reduces, bucket.nbytes) is not None:
             continue
         padded = _pad_up(bucket.nbytes, spec.axis_size * itemsize)
         rs = _take(scatters, padded)
@@ -191,7 +299,7 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
             "in the traced schedule: the program does not execute the "
             "bucket plan it claims (plan_buckets/scaling_model would "
             "account bytes the wire never moves)"))
-    for op in reduces + scatters + gathers:
+    for op in reduces + scatters + gathers + a2as:
         findings.append(Finding(
             program, "HVV105",
             f"schedule entry {op.describe()} matches NO bucket of the "

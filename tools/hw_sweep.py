@@ -51,6 +51,25 @@ LANES = [
      {"HOROVOD_FUSION_THRESHOLD": "1048576"}),
     ("resnet50_overlap_off", ["bench.py", "--overlap", "off"],
      {"HOROVOD_FUSION_THRESHOLD": "1048576"}),
+    # Hierarchical-ladder A/B (round-10 tentpole, horovod_tpu/jax/
+    # fusion.py HOROVOD_HIERARCHICAL): each bucket as intra-slice rs ->
+    # inter-slice exchange -> intra-slice ag, vs the adjacent flat
+    # baselines (resnet50 / vgg16 above share chip condition). On a
+    # single chip the ladder degrades to flat (the record's
+    # "hierarchical" stamp says so — inner 0); on a multi-chip slice
+    # the pinned inner=4 prices the ladder's extra collective launches
+    # against the flat psum, and on a real multi-slice job the "wire"
+    # stamp carries the ICI/DCN byte split the scaling model predicts
+    # from. vgg16_dcn_int8_ab adds the int8 DCN wire (error-feedback
+    # residuals ride the optimizer state): VGG's 528 MB gradient is the
+    # DCN-bound regime where docs/benchmarks.md predicts 90.2% -> 96.4%
+    # at 8x8.
+    ("resnet50_hier_ab", ["bench.py", "--hierarchical", "on"],
+     {"HOROVOD_HIERARCHICAL_INNER_SIZE": "4"}),
+    ("vgg16_dcn_int8_ab", ["bench.py", "--model", "vgg16",
+                           "--hierarchical", "on",
+                           "--compression", "int8"],
+     {"HOROVOD_HIERARCHICAL_INNER_SIZE": "4"}),
     # Honest re-adjudication lanes (round 5): both options were priced
     # under dispatch timing ("within noise" / never measured) — the
     # fixed protocol decides them on device time.

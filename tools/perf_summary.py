@@ -88,6 +88,24 @@ def overlap_cell(rec):
     return str(mode)
 
 
+def wire_cell(rec):
+    """Compact render of the record's hierarchical wire stamps (bench.py
+    --hierarchical/--compression; fusion.hier_wire_summary): "i4 dcn
+    0.76MB int8 x4.0" = ladder engaged at inner 4, 0.76 MB of DCN-leg
+    operands in int8, 4x below the uncompressed shard. Ladder-off (or
+    pre-hierarchical) records render as em-dash."""
+    w = rec.get("wire")
+    if not isinstance(w, dict):
+        return "—"
+    h = rec.get("hierarchical") or {}
+    cell = f"i{h.get('inner', '?')} dcn {w.get('dcn_mb', '?')}MB"
+    if w.get("dtype"):
+        cell += f" {w['dtype']}"
+    if w.get("ratio") is not None:
+        cell += f" x{w['ratio']:g}"
+    return cell
+
+
 def collectives_cell(rec):
     """Compact render of the record's static collective audit (bench.py
     stamps it from the tools/hvdverify schedule walker): "4c/101.8MB" =
@@ -173,10 +191,10 @@ def main():
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | overlap | collectives "
+    print("| lane | value | unit | window | overlap | wire | collectives "
           "| flash grid | snapshot | elastic | serve | peak | probe TF "
           "| stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -187,6 +205,7 @@ def main():
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
               f"| {window if window is not None else '—'} "
               f"| {overlap_cell(rec)} "
+              f"| {wire_cell(rec)} "
               f"| {collectives_cell(rec)} "
               f"| {flash_grid_cell(rec)} "
               f"| {snapshot_cell(rec)} "

@@ -145,31 +145,65 @@ def ring_allreduce_us(nbytes, n, bw_gbps, hop_latency_us, dispatch_us,
             + dispatch_us * split_collectives)
 
 
-def hierarchical_allreduce_us(nbytes, n, inner, dispatch_us):
+# DCN wire options (bench.py --compression; horovod_tpu/jax/compression):
+# fp16/bf16 cast EVERY leg to 2 bytes/elem; int8/fp8 quantize ONLY the
+# DCN leg to 1 byte/elem (+ scalar scales, negligible) and leave ICI at
+# fp32 — the fusion.py hierarchical contract this model prices.
+DCN_WIRE_MODES = ("none", "fp16", "bf16", "int8", "fp8")
+
+
+def hierarchical_allreduce_us(nbytes, n, inner, dispatch_us,
+                              dcn_wire="none"):
     """Multi-slice ladder: reduce-scatter inside the inner-chip ICI
-    domain, cross-reduce 1/inner of the bytes over DCN between the n/inner
-    slices, all-gather back (fusion.py -> mesh.py ladder)."""
+    domain, exchange 1/inner of the bytes over DCN between the n/inner
+    slices, all-gather back (fusion.py -> mesh.py ladder).
+
+    ``dcn_wire`` prices the compression of the inter-slice leg.
+    int8/fp8 use the shapes fusion.py actually traces: at 2 slices an
+    all-gather of the quantized shards ((m-1) x q bytes per chip); at
+    >2 slices the two-stage quantized ring decomposition (all-to-all +
+    all-gather, 2(m-1)/m x q bytes, two collective launches) — per-chip
+    DCN wire stays ~2q instead of growing with the slice count."""
+    cast = dcn_wire in ("fp16", "bf16")
+    quant = dcn_wire in ("int8", "fp8")
     if n <= inner:
-        return ring_allreduce_us(nbytes, n, ICI_GBPS, ICI_HOP_LATENCY_US,
+        # Single slice, no DCN leg: cast compressors still halve the
+        # (only) leg — the table must stay comparable across the
+        # c == inner boundary; the DCN-only codecs do nothing here.
+        return ring_allreduce_us(nbytes / 2 if cast else nbytes, n,
+                                 ICI_GBPS, ICI_HOP_LATENCY_US,
                                  dispatch_us)
     m = n // inner
-    ici = ring_allreduce_us(nbytes, inner, ICI_GBPS, ICI_HOP_LATENCY_US,
+    ici_bytes = nbytes / 2 if cast else nbytes
+    ici = ring_allreduce_us(ici_bytes, inner, ICI_GBPS, ICI_HOP_LATENCY_US,
                             dispatch_us, split_collectives=2)
-    dcn = ring_allreduce_us(nbytes / inner, m, DCN_GBPS_PER_CHIP,
-                            DCN_HOP_LATENCY_US, dispatch_us)
+    if quant:
+        q = (nbytes / 4) / inner  # fp32 elements -> 1-byte payloads
+        if m == 2:
+            wire_bytes, colls = (m - 1) * q, 1
+        else:
+            wire_bytes, colls = 2.0 * (m - 1) / m * q, 2
+        dcn = (wire_bytes / (DCN_GBPS_PER_CHIP * 1e3)
+               + colls * (m - 1) * DCN_HOP_LATENCY_US
+               + dispatch_us * colls)
+    else:
+        dcn = ring_allreduce_us(ici_bytes / inner, m, DCN_GBPS_PER_CHIP,
+                                DCN_HOP_LATENCY_US, dispatch_us)
     return ici + dcn
 
 
 def predict_efficiency(name, n, fusion_threshold, overlap="auto",
                        dispatch_us=DEFAULT_DISPATCH_US, dcn_inner=0,
-                       _stats=None):
+                       dcn_wire="none", _stats=None):
     """Predicted weak-scaling efficiency of the DP step at n chips.
 
     ``overlap``: "off" = the legacy post-backward block (no hiding);
     "on"/"auto" = the overlap schedule hides up to
     ``(buckets-1)/buckets * backward`` of the communication (the
     plan-derived fraction; see module docstring). ``dcn_inner`` > 0
-    switches to the multi-slice ladder with that ICI domain size.
+    switches to the multi-slice ladder with that ICI domain size;
+    ``dcn_wire`` prices the wire compression of the hierarchical DCN
+    leg (int8/fp8 compress the DCN leg only, fp16/bf16 every leg).
     """
     plan, summary = _stats if _stats is not None else bucket_stats(
         name, fusion_threshold)
@@ -182,7 +216,8 @@ def predict_efficiency(name, n, fusion_threshold, overlap="auto",
     split = 2 if overlapped else 1
     if dcn_inner:
         comm_us = sum(hierarchical_allreduce_us(b.nbytes, n, dcn_inner,
-                                                dispatch_us)
+                                                dispatch_us,
+                                                dcn_wire=dcn_wire)
                       for b in plan)
     else:
         comm_us = sum(ring_allreduce_us(b.nbytes, n, ICI_GBPS,
@@ -202,12 +237,12 @@ def predict_efficiency(name, n, fusion_threshold, overlap="auto",
     }
 
 
-CHIP_LADDER = (1, 2, 4, 8, 16, 32, 64)
+CHIP_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def efficiency_table(fusion_threshold, overlap="auto",
                      dispatch_us=DEFAULT_DISPATCH_US, dcn_inner=0,
-                     models=None):
+                     dcn_wire="none", models=None):
     """Markdown rows: per model, predicted efficiency across the chip
     ladder plus the bucket accounting that produced it."""
     lines = ["| model | buckets | grad MB | step ms | "
@@ -220,7 +255,8 @@ def efficiency_table(fusion_threshold, overlap="auto",
         for c in CHIP_LADDER:
             p = predict_efficiency(name, c, fusion_threshold,
                                    overlap=overlap, dispatch_us=dispatch_us,
-                                   dcn_inner=dcn_inner, _stats=stats)
+                                   dcn_inner=dcn_inner, dcn_wire=dcn_wire,
+                                   _stats=stats)
             cells.append(f"{p['efficiency'] * 100:.1f}%")
         step_ms = step_time_ms(name, summary)
         est = "" if MEASURED[name]["step_ms"] is not None else "~"
@@ -281,6 +317,12 @@ def main():
                     help="model multi-slice DP: ICI domain size joined "
                          "over DCN via the hierarchical ladder (0 = "
                          "all-ICI, the single-slice default)")
+    ap.add_argument("--dcn-compression", default="none",
+                    choices=DCN_WIRE_MODES,
+                    help="price the wire compression of the "
+                         "hierarchical DCN leg (int8/fp8: quantized "
+                         "payloads, fusion.py's exchange shapes; "
+                         "fp16/bf16: every leg cast). Needs --dcn-inner")
     ap.add_argument("--microbench", action="store_true",
                     help="measure the per-collective dispatch overhead "
                          "on this chip instead of the documented default")
@@ -297,15 +339,20 @@ def main():
         if m not in MEASURED:
             ap.error(f"unknown model {m!r}; have {sorted(MEASURED)}")
 
+    if args.dcn_compression != "none" and not args.dcn_inner:
+        ap.error("--dcn-compression prices the hierarchical DCN leg; "
+                 "pass --dcn-inner as well")
     print(f"# Predicted weak-scaling efficiency "
           f"(fusion threshold {args.fusion_threshold} B, "
           f"overlap={args.overlap}, dispatch {dispatch_us:.1f} us, "
-          + (f"multi-slice DCN inner={args.dcn_inner}"
+          + (f"multi-slice DCN inner={args.dcn_inner}, "
+             f"wire={args.dcn_compression}"
              if args.dcn_inner else "all-ICI") + ")")
     print()
     print(efficiency_table(args.fusion_threshold, overlap=args.overlap,
                            dispatch_us=dispatch_us,
-                           dcn_inner=args.dcn_inner, models=models))
+                           dcn_inner=args.dcn_inner,
+                           dcn_wire=args.dcn_compression, models=models))
     return 0
 
 
