@@ -28,7 +28,33 @@ from typing import Iterable, Optional
 from horovod_tpu.run.driver import (EXIT_PREEMPTED,  # canonical home
                                     EXIT_RESIZED)
 
-__all__ = ["PreemptionHandler", "Heartbeat", "EXIT_PREEMPTED"]
+__all__ = ["PreemptionHandler", "Heartbeat", "EXIT_PREEMPTED",
+           "namespaced_heartbeat_dir"]
+
+
+def namespaced_heartbeat_dir(base: Optional[str] = None) -> str:
+    """A heartbeat directory unique to ONE supervisor/fleet instance.
+
+    ``HOROVOD_HEARTBEAT_DIR`` is exported to workers, so two watchdog
+    owners sharing a directory on one host would watch each other's
+    ``hb-<rank>`` files: supervisor A's rank 0 touching ``hb-0`` keeps
+    supervisor B's stalled rank 0 alive forever (and vice versa), which
+    silently defeats stall detection exactly when two jobs — or a
+    training job and a serving fleet — colocate. Every watchdog owner
+    therefore namespaces its directory per INSTANCE: a fresh unique
+    subdirectory under ``base`` (or the system tempdir), never the
+    shared path itself.
+    """
+    import tempfile
+    import uuid
+
+    if base:
+        os.makedirs(base, exist_ok=True)
+        path = os.path.join(
+            base, f"hvd-hb-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        os.makedirs(path)
+        return path
+    return tempfile.mkdtemp(prefix="hvd-heartbeat-")
 
 
 class PreemptionHandler:

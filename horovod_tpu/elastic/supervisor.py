@@ -62,7 +62,6 @@ import datetime
 import json
 import os
 import sys
-import tempfile
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -226,10 +225,21 @@ def supervise(cmd: Sequence[str], np: int,
     timeout = _resolve_watchdog_timeout(watchdog_timeout)
     watchdog = None
     if timeout > 0:
-        if heartbeat_dir is None:
-            heartbeat_dir = tempfile.mkdtemp(prefix="hvd-heartbeat-")
+        from horovod_tpu.elastic.signals import namespaced_heartbeat_dir
+
+        # Namespaced per supervisor INSTANCE (a unique subdir even when
+        # the caller passes a shared base): two supervisors — or a
+        # training job and a serving fleet — on one host must never
+        # watch each other's hb-<rank> files, where a foreign rank 0's
+        # touches would keep a stalled local rank 0 "alive" forever.
+        heartbeat_dir = namespaced_heartbeat_dir(heartbeat_dir)
         base_env["HOROVOD_HEARTBEAT_DIR"] = heartbeat_dir
         watchdog = HealthWatchdog(heartbeat_dir, timeout)
+    else:
+        # Watchdog disabled: drop any INHERITED heartbeat dir so this
+        # job's workers don't feed an outer supervisor's watchdog (a
+        # stalled outer rank sharing our rank id would look alive).
+        base_env.pop("HOROVOD_HEARTBEAT_DIR", None)
 
     def _clamp(n: int) -> int:
         return max(min_np, min(max_np, n))
@@ -352,6 +362,14 @@ def supervise(cmd: Sequence[str], np: int,
                 # settle before the relaunch contends for devices.
                 time.sleep(restart_delay)
     finally:
+        if watchdog is not None:
+            # The namespaced heartbeat dir is THIS supervise() call's
+            # own (unique by construction): remove it, or a long-lived
+            # service looping over supervise() accumulates one orphan
+            # dir of stale hb-<rank> files per invocation forever.
+            import shutil
+
+            shutil.rmtree(heartbeat_dir, ignore_errors=True)
         if metrics_path:
             record = {
                 "metric": "elastic_recovery",

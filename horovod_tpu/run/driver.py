@@ -137,7 +137,12 @@ class WorkerExit:
     watchdog killed this worker for a stale heartbeat — the raw code is
     then the watchdog's SIGKILL, and the *category* reports ``stalled``
     so the relaunch policy and recovery metrics see the real incident
-    class, not a generic crash."""
+    class, not a generic crash.
+
+    The taxonomy is deliberately process-agnostic: the serving fleet
+    (:mod:`horovod_tpu.serve.fleet`) classifies replica incidents with
+    the same class — ``rank`` is then the replica id — so training and
+    serving recovery metrics speak one vocabulary."""
 
     rank: int
     code: int
@@ -148,6 +153,11 @@ class WorkerExit:
         if self.stalled:
             return "stalled"
         return classify_exit(self.code)
+
+    def describe(self, role: str = "rank") -> str:
+        """One-line incident description for supervisor/fleet logs,
+        e.g. ``"replica 1 exited -9 (crashed)"``."""
+        return f"{role} {self.rank} exited {self.code} ({self.category})"
 
 
 class Driver:

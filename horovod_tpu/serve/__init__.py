@@ -21,23 +21,34 @@ steps without ever recompiling.
 * :mod:`~horovod_tpu.serve.sampling` — vectorized per-slot sampling;
 * :mod:`~horovod_tpu.serve.metrics` — TTFT / per-token latency /
   page-occupancy accounting for the bench lane
-  (`tools/serve_bench.py`).
+  (`tools/serve_bench.py`);
+* :mod:`~horovod_tpu.serve.fleet` + :mod:`~horovod_tpu.serve.router` —
+  the fault-tolerant multi-replica fleet: N engines behind a
+  least-loaded router with classified replica incidents (PR 9's
+  heartbeat watchdog + exit taxonomy), drain/redispatch of a dead
+  replica's in-flight requests (at-most-once, greedy bit-identical),
+  budgeted exponential-backoff relaunches, and bounded-queue load
+  shedding ("rejected: overloaded" + retry-after).
 
 Architecture, page math, and the SLO tuning runbook: docs/serving.md.
 """
 
-from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.config import FleetConfig, ServeConfig
 from horovod_tpu.serve.engine import ServeEngine
+from horovod_tpu.serve.fleet import Replica, ServeFleet
 from horovod_tpu.serve.kvcache import OutOfPages, PageAllocator, PagedKVCache
 from horovod_tpu.serve.scheduler import Request, RequestState, Scheduler
 
 __all__ = [
+    "FleetConfig",
     "OutOfPages",
     "PageAllocator",
     "PagedKVCache",
+    "Replica",
     "Request",
     "RequestState",
     "Scheduler",
     "ServeConfig",
     "ServeEngine",
+    "ServeFleet",
 ]

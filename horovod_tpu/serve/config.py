@@ -120,3 +120,75 @@ class ServeConfig:
         (a prefill can always start while every slot decodes)."""
         return self.max_in_flight if self.max_in_flight > 0 \
             else self.decode_slots + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Knobs for :class:`horovod_tpu.serve.ServeFleet` — the
+    multi-replica layer on top of one :class:`ServeConfig` (every
+    replica runs the same engine geometry).
+
+    ``max_queue`` bounds the ROUTER's admission queue — the fleet's
+    load-shedding valve: past it, new requests are rejected terminally
+    with ``reject_reason="overloaded"`` and a ``retry_after`` hint
+    instead of queueing until their TTFT diverges. 0 = unbounded (no
+    shedding).
+
+    ``max_restarts`` is the fleet-wide replica relaunch budget (the
+    elastic supervisor's discipline: a crash loop must converge, not
+    burn the host); each relaunch backs off exponentially —
+    ``backoff_base * 2**attempts_of_that_replica``, capped at
+    ``backoff_cap``. A replica whose relaunch would exceed the budget
+    is marked ``failed`` and the fleet degrades (load shedding takes
+    over).
+
+    ``watchdog_timeout`` > 0 arms the stale-heartbeat watchdog
+    (:class:`horovod_tpu.elastic.supervisor.HealthWatchdog`): every
+    live replica's heartbeat stamps at the END of each fleet TICK (all
+    together, once every replica has stepped — per-step stamping would
+    let one slow step age every peer's file into a spurious kill), so
+    a replica that silently stops stepping is SIGKILL-classified
+    ``stalled`` and relaunched instead of wedging its slice of the
+    fleet forever. Size the timeout ABOVE a full fleet tick (the sum
+    of all replicas' step times in-process — a relaunch recompile is
+    one step), not one replica's step. The directory is ALWAYS
+    namespaced per fleet instance (under ``heartbeat_dir`` when
+    given) — two fleets, or a fleet and a training supervisor, on one
+    host never watch each other's files.
+
+    ``retry_after_min`` floors the overload hint so clients never get
+    told to hammer back immediately.
+    """
+
+    replicas: int = 2
+    max_queue: int = 0            # 0 = unbounded router queue
+    max_restarts: int = 2
+    backoff_base: float = 0.05
+    backoff_cap: float = 2.0
+    watchdog_timeout: float = 0.0  # 0 = watchdog disabled
+    heartbeat_dir: Optional[str] = None   # base dir; namespaced per fleet
+    retry_after_min: float = 0.05
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(
+                f"replicas must be >= 1, got {self.replicas}")
+        if self.max_queue < 0:
+            raise ValueError(
+                f"max_queue must be >= 0 (0 = unbounded), got "
+                f"{self.max_queue}")
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {self.max_restarts}")
+        if self.backoff_base < 0 or self.backoff_cap < self.backoff_base:
+            raise ValueError(
+                f"need 0 <= backoff_base <= backoff_cap, got "
+                f"{self.backoff_base}/{self.backoff_cap}")
+        if self.watchdog_timeout < 0:
+            raise ValueError(
+                f"watchdog_timeout must be >= 0 (0 disables), got "
+                f"{self.watchdog_timeout}")
+        if self.retry_after_min <= 0:
+            raise ValueError(
+                f"retry_after_min must be > 0, got "
+                f"{self.retry_after_min}")

@@ -301,15 +301,12 @@ class ServeEngine:
         ``ttl`` (seconds from arrival; default ``config.default_ttl``)
         bounds how long the request may live: past it, the request is
         finished with the ``timeout`` status and its pages freed."""
-        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                      temperature=temperature, top_k=top_k,
-                      eos_token=eos_token
-                      if eos_token is not None else self.config.eos_token,
-                      seed=seed,
-                      arrival=arrival if arrival is not None
-                      else self.clock(),
-                      ttl=ttl if ttl is not None
-                      else self.config.default_ttl)
+        from horovod_tpu.serve.scheduler import make_request
+
+        req = make_request(self.config, self.clock, prompt,
+                           max_new_tokens, temperature=temperature,
+                           top_k=top_k, eos_token=eos_token, seed=seed,
+                           arrival=arrival, ttl=ttl)
         self.scheduler.submit(req)
         return req
 

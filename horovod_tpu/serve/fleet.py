@@ -1,0 +1,721 @@
+"""Fault-tolerant multi-replica serving: N engines behind one router.
+
+PR 9 made training survive real clusters (classified worker exits,
+heartbeat watchdog, budgeted relaunches); this module gives serving the
+same story instead of reinventing it. A :class:`ServeFleet` runs N
+:class:`~horovod_tpu.serve.engine.ServeEngine` replicas behind a
+least-loaded router (:mod:`~horovod_tpu.serve.router`), and every
+failure mode is first-class:
+
+* **replica death** (``kill:`` faults, real crashes) is drained and
+  **redispatched**: the router — which streamed every emitted token to
+  the client and therefore knows each request's generated-so-far
+  prefix — re-submits unfinished requests to survivors with the prefix
+  folded into the prompt (:func:`~horovod_tpu.serve.scheduler.
+  rebase_for_recompute`, the same arithmetic as eviction-recompute).
+  Tokens already emitted are NEVER re-emitted (at-most-once), and
+  greedy output stays bit-identical to an uninterrupted run (pinned in
+  tests/test_serve_fleet.py and the ``serve_bench --fleet`` A/B);
+* **silent stalls** become classified incidents: every live replica's
+  per-replica heartbeat file is stamped at the END of each fleet tick
+  (all together, once every replica has stepped — see :meth:`ServeFleet.
+  step` for why per-step stamping would mis-kill healthy peers), and a
+  :class:`~horovod_tpu.elastic.supervisor.HealthWatchdog` (PR 9's, not
+  a copy) kills any replica stale past the timeout — classified
+  ``stalled`` via :class:`~horovod_tpu.run.driver.WorkerExit`, exactly
+  the training taxonomy;
+* **relaunch** consumes a fleet-wide restart budget with exponential
+  backoff (the anti-pattern of an unbudgeted, backoff-less retry loop
+  is lint rule HVD010); a replica past the budget is ``failed`` and the
+  fleet degrades;
+* a degraded fleet **sheds load** instead of letting TTFT diverge: the
+  router's admission queue is bounded (``FleetConfig.max_queue``), and
+  overflow is rejected terminally — ``reject_reason="overloaded"``
+  with a ``retry_after`` hint — while requests that can NEVER fit the
+  replica geometry reject as ``infeasible``. Rejected requests never
+  touch a replica, so they can never allocate KV pages (allocator
+  conservation is pinned in tests).
+
+Replicas here are in-process engines with a process-shaped lifecycle
+(real heartbeat files, the real watchdog, the real exit taxonomy with
+synthetic ``-SIGKILL`` codes): that keeps the whole recovery story —
+including the bit-exact redispatch pin — CI-exercisable on CPU in
+seconds, with deterministic fault injection
+(:func:`~horovod_tpu.elastic.faults.parse_serve_fault_plan`) and an
+injectable clock. What stays honest about the real multi-process fleet:
+the router's drain uses only router-side bookkeeping (dispatched
+requests + streamed tokens), never the dead engine's internals, and a
+crash loses the replica's engine state wholesale. docs/serving.md "The
+fleet" covers the runbook.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from horovod_tpu.elastic.faults import (FaultPlanError, ServeFaultAction,
+                                        parse_serve_fault_plan)
+from horovod_tpu.elastic.signals import Heartbeat, namespaced_heartbeat_dir
+from horovod_tpu.elastic.supervisor import HealthWatchdog
+from horovod_tpu.run.driver import WorkerExit
+from horovod_tpu.serve.config import FleetConfig, ServeConfig
+from horovod_tpu.serve.engine import ServeEngine
+from horovod_tpu.serve.router import (pick_replica, replica_load,
+                                      retry_after_hint)
+from horovod_tpu.serve.scheduler import (Request, RequestState,
+                                         rebase_for_recompute)
+
+
+def _log(msg: str) -> None:
+    print(f"[hvd fleet] {msg}", file=sys.stderr, flush=True)
+
+
+class Replica:
+    """One engine + its process-shaped lifecycle.
+
+    ``state``: ``healthy`` (serving; may currently be stalled or
+    slowed by a fault) -> ``dead`` (killed; relaunch pending behind the
+    backoff) -> ``healthy`` again, or ``failed`` (terminal: the restart
+    budget is spent). ``assigned`` is the ROUTER's bookkeeping —
+    dispatched-but-unfinished requests — and is what drain/redispatch
+    reads, never the engine's internals (a crashed engine's state is
+    gone).
+    """
+
+    def __init__(self, rid: int, engine: ServeEngine, heartbeat: Heartbeat):
+        self.id = rid
+        self.engine: Optional[ServeEngine] = engine
+        self.heartbeat = heartbeat
+        self.state = "healthy"
+        self.assigned: List[Request] = []
+        self.exit: Optional[WorkerExit] = None
+        self.restarts = 0               # relaunches consumed so far
+        self.relaunch_at: Optional[float] = None
+        self.stall_until: Optional[float] = None   # None = not stalled
+        self.slow_factor = 1.0
+        self.steps = 0
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == "healthy"
+
+
+class ServeFleet:
+    """N continuous-batching replicas behind a fault-tolerant router.
+
+    ``params``/``config`` build each replica's engine (one geometry
+    fleet-wide); ``fleet`` sizes the fleet and its recovery policy.
+    ``clock`` and ``sleep`` are injectable for deterministic tests —
+    the heartbeat/watchdog lane alone reads real file mtimes, so stall
+    detection tests run on the wall clock (slow-marked).
+
+    The lifecycle mirrors :class:`ServeEngine`: :meth:`submit` admits
+    (or sheds), :meth:`step` runs one fleet tick (faults -> watchdog ->
+    relaunches -> dispatch -> one engine step per live replica),
+    :meth:`run` drains to idle, :meth:`stats` aggregates SLO + recovery
+    metrics.
+    """
+
+    def __init__(self, params: Dict, config: ServeConfig,
+                 fleet: Optional[FleetConfig] = None, *,
+                 chips_per_replica: int = 1,
+                 clock=time.perf_counter, sleep=time.sleep):
+        self.params = params
+        self.config = config
+        self.fleet = fleet if fleet is not None else FleetConfig()
+        self.chips_per_replica = chips_per_replica
+        self.chips = chips_per_replica * self.fleet.replicas
+        self.clock = clock
+        self._sleep = sleep
+
+        # Static admission geometry (survives every replica dying):
+        # exactly PagedKVCache.fits, computed off params + config —
+        # capacity derived from the kvcache module's own constant so
+        # router and engines can never disagree on the reserved count.
+        from horovod_tpu.serve.kvcache import allocatable_pages
+
+        self._lmax = int(params["pos"].shape[0])
+        self._page_capacity = allocatable_pages(config.num_pages)
+
+        # Router state.
+        self.queue: List[Request] = []
+        self.rejected: List[Request] = []
+        self.finished: List[Request] = []
+        self.timed_out: List[Request] = []
+        self.evicted: List[Request] = []    # engine-terminal evictions
+        # admit->finish secs feeding retry_after_hint — a BOUNDED
+        # recency window, not the full history: the hint is recomputed
+        # on every overloaded rejection (hot exactly when shedding is),
+        # and recent service times describe a degraded fleet better
+        # than its lifetime average anyway.
+        import collections
+
+        self._service_samples = collections.deque(maxlen=256)
+
+        # Recovery metrics.
+        self.incidents: List[Dict] = []
+        self.incidents_by_class: Dict[str, int] = {}
+        self.redispatched_total = 0
+        self.tokens_recomputed_total = 0
+        self.shed_total = 0
+        self.restarts_used = 0
+
+        self.occupancy_samples: List[float] = []
+        self.steps = 0
+        self._t_start = clock()
+
+        # Fault plan (armed via arm_fault_plan; fires on the clock).
+        self._pending_faults: List[tuple] = []   # (fire_at_s, action)
+        self._fault_t0: Optional[float] = None
+
+        # Supervision: heartbeat dir namespaced per fleet INSTANCE so
+        # colocated fleets/supervisors never watch each other's files.
+        self.heartbeat_dir = namespaced_heartbeat_dir(
+            self.fleet.heartbeat_dir)
+        self.watchdog: Optional[HealthWatchdog] = None
+        if self.fleet.watchdog_timeout > 0:
+            self.watchdog = HealthWatchdog(
+                self.heartbeat_dir, self.fleet.watchdog_timeout,
+                interval=min(0.5, self.fleet.watchdog_timeout / 2))
+
+        self._closed = False
+        self.replicas: List[Replica] = [
+            self._spawn(i) for i in range(self.fleet.replicas)]
+
+    def close(self) -> None:
+        """Release the fleet's host-side footprint — the per-instance
+        heartbeat directory (uniquely named by construction, so a
+        long-lived service or bench loop constructing fleets repeatedly
+        would otherwise accumulate one directory per instance under the
+        base/tempdir forever). Idempotent; a closed fleet can no longer
+        step. Context-manager form closes on exit."""
+        if self._closed:
+            return
+        self._closed = True
+        import shutil
+
+        shutil.rmtree(self.heartbeat_dir, ignore_errors=True)
+
+    def __enter__(self) -> "ServeFleet":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # ------------------------------------------------------- lifecycle
+
+    def _spawn(self, rid: int) -> Replica:
+        engine = ServeEngine(self.params, self.config,
+                             chips=self.chips_per_replica,
+                             clock=self.clock)
+        hb = Heartbeat(self.heartbeat_dir, rank=rid)
+        # A (re)spawned replica is unwatched until its first completed
+        # step: no stale file from a previous incarnation may insta-kill
+        # it while it recompiles.
+        try:
+            os.unlink(hb.path)
+        except OSError:
+            pass
+        return Replica(rid, engine, hb)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(len(r.assigned) for r in self.replicas) + \
+            len(self.queue)
+
+    @property
+    def idle(self) -> bool:
+        return self.in_flight == 0
+
+    @property
+    def alive(self) -> bool:
+        """At least one replica is serving or can still come back."""
+        return any(r.state != "failed" for r in self.replicas)
+
+    # ------------------------------------------------------ fault plan
+
+    def arm_fault_plan(self, plan: Union[str, Sequence[ServeFaultAction]],
+                       horizon: Optional[float] = None) -> None:
+        """Arm a serving fault plan (string grammar or parsed actions).
+        Fire offsets are measured from the fault epoch — the fleet's
+        first step, re-anchored only by :meth:`reset_metrics` (the
+        bench's measurement start) — NEVER by arming itself: a second
+        mid-run arm must not silently shift the fire times of actions
+        already armed. An offset already in the past fires at the next
+        step. ``horizon`` resolves percent ``at=`` forms (e.g. the
+        bench passes its last workload arrival); replica ids are
+        validated against the fleet size fail-fast."""
+        actions = (parse_serve_fault_plan(plan)
+                   if isinstance(plan, str) else list(plan))
+        for a in actions:
+            # Hand-built actions get the parser's fail-fast contract
+            # too — a malformed one must raise HERE, not TypeError
+            # out of the fleet loop at fire time.
+            a.validate()
+            if not 0 <= a.replica < len(self.replicas):
+                raise FaultPlanError(
+                    f"fault action {a}: replica {a.replica} is outside "
+                    f"this fleet (replicas 0..{len(self.replicas) - 1})")
+        self._pending_faults.extend(
+            (a.resolve_at(horizon), a) for a in actions)
+        self._pending_faults.sort(key=lambda p: p[0])
+
+    def _inject_faults(self, now: float) -> None:
+        if not self._pending_faults:
+            return
+        t = now - self._fault_t0
+        while self._pending_faults and self._pending_faults[0][0] <= t:
+            _, action = self._pending_faults.pop(0)
+            rep = self.replicas[action.replica]
+            _log(f"fault injection: {action} firing (replica state "
+                 f"{rep.state})")
+            if action.kind == "kill":
+                if rep.healthy:
+                    self._kill_replica(rep, code=-int(_signal.SIGKILL),
+                                       stalled=False, now=now)
+            elif action.kind == "stall":
+                if rep.healthy:
+                    rep.stall_until = (now + action.secs
+                                       if action.secs is not None
+                                       else float("inf"))
+            elif action.kind == "slow":
+                # Like kill/stall: a fault addressed to a dead replica
+                # is a no-op — it must not brand the NEXT incarnation
+                # (kill resets slow_factor to 1.0 for the same reason).
+                if rep.healthy:
+                    rep.slow_factor = float(action.factor)
+
+    # ------------------------------------------------------ submission
+
+    def _fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """PagedKVCache.fits without a live engine — the SAME
+        :func:`~horovod_tpu.serve.kvcache.fits_geometry` predicate, so
+        admission control keeps answering (and rejecting honestly)
+        while every replica is mid-relaunch and can never drift from
+        what the engines would admit."""
+        from horovod_tpu.serve.kvcache import fits_geometry
+
+        return fits_geometry(prompt_len, max_new_tokens,
+                             max_len=self._lmax,
+                             page_size=self.config.page_size,
+                             capacity=self._page_capacity)
+
+    def _healthy_slots(self) -> int:
+        return sum(r.engine.config.decode_slots for r in self.replicas
+                   if r.healthy and r.engine is not None)
+
+    def _reject(self, req: Request, reason: str,
+                retry_after: Optional[float] = None) -> Request:
+        req.state = RequestState.REJECTED
+        req.reject_reason = reason
+        req.retry_after = retry_after
+        self.rejected.append(req)
+        if reason == "overloaded":
+            self.shed_total += 1
+        return req
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token: Optional[int] = None, seed: int = 0,
+               arrival: Optional[float] = None,
+               ttl: Optional[float] = None) -> Request:
+        """Admit one request at the router (same surface as
+        :meth:`ServeEngine.submit`). Check ``state`` — ``rejected``
+        carries ``reject_reason`` (``infeasible``: can never run on
+        this geometry; ``overloaded``: the bounded queue is full or the
+        fleet is permanently down — retry after ``retry_after`` when
+        it is not None)."""
+        from horovod_tpu.serve.scheduler import make_request
+
+        req = make_request(self.config, self.clock, prompt,
+                           max_new_tokens, temperature=temperature,
+                           top_k=top_k, eos_token=eos_token, seed=seed,
+                           arrival=arrival, ttl=ttl)
+        if not self._fits(req.prompt_len, req.max_new_tokens):
+            return self._reject(req, "infeasible")
+        if not self.alive:
+            # Permanently degraded to zero replicas: shed with no hint
+            # (there is no "later" this fleet can promise).
+            return self._reject(req, "overloaded")
+        if self.fleet.max_queue and \
+                len(self.queue) >= self.fleet.max_queue:
+            hint = retry_after_hint(
+                len(self.queue), max(1, self._healthy_slots()),
+                self._service_samples, self.fleet.retry_after_min)
+            return self._reject(req, "overloaded", round(hint, 4))
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return req
+
+    # ---------------------------------------------------- supervision
+
+    def _kill_replica(self, rep: Replica, *, code: int, stalled: bool,
+                      now: float, detect_age: Optional[float] = None
+                      ) -> None:
+        """Classify + drain + schedule relaunch: the fleet edition of
+        the supervisor's per-incident policy."""
+        rep.exit = WorkerExit(rank=rep.id, code=code, stalled=stalled)
+        category = rep.exit.category
+        self.incidents_by_class[category] = \
+            self.incidents_by_class.get(category, 0) + 1
+        moved, recomputed = self._drain(rep, now)
+        # The engine object (pages, allocator, compiled-step cache) is
+        # dropped wholesale — the crash shape. Its heartbeat file goes
+        # too so the relaunch starts unwatched.
+        rep.engine = None
+        rep.state = "dead"
+        rep.stall_until = None
+        rep.slow_factor = 1.0
+        try:
+            os.unlink(rep.heartbeat.path)
+        except OSError:
+            pass
+        backoff = min(self.fleet.backoff_cap,
+                      self.fleet.backoff_base * (2 ** rep.restarts))
+        rep.relaunch_at = now + backoff
+        self.incidents.append({
+            "replica": rep.id,
+            "category": category,
+            "code": code,
+            "t_s": round(now - self._t_start, 4),
+            # Watchdog kills carry the observed heartbeat age (real
+            # detection latency). In-process crashes are observed
+            # synchronously — 0.0 is honest here where a multi-process
+            # fleet would pay one supervision-poll interval.
+            "detect_s": round(detect_age, 4) if detect_age is not None
+            else 0.0,
+            "redispatched": moved,
+            "tokens_recomputed": recomputed,
+            "backoff_s": round(backoff, 4),
+        })
+        _log(f"{rep.exit.describe(role='replica')} — drained {moved} "
+             f"request(s) to survivors ({recomputed} KV tokens to "
+             f"recompute); relaunch in {backoff:g}s")
+
+    def _drain(self, rep: Replica, now: float) -> tuple:
+        """Recover every dispatched-but-unfinished request of a dead
+        replica from ROUTER bookkeeping: rebase generated-so-far into
+        the prompt and requeue at the HEAD (they already consumed
+        service), preserving their relative order. Returns
+        ``(redispatched, kv_tokens_to_recompute)``."""
+        moved: List[Request] = []
+        recomputed = 0
+        terminal = {
+            RequestState.FINISHED: self.finished,
+            RequestState.TIMEOUT: self.timed_out,
+            RequestState.REJECTED: self.rejected,
+            RequestState.EVICTED: self.evicted,
+        }
+        for req in rep.assigned:
+            dest = terminal.get(req.state)
+            if dest is not None:
+                # Terminal but not yet collected — the replica died in
+                # the very step that finished/expired it, before the
+                # end-of-tick _collect ran (e.g. its engine raised
+                # mid-step). The router's streamed-token truth stands:
+                # route it to the fleet list, never drop it.
+                if not any(r is req for r in dest):
+                    dest.append(req)
+                continue
+            # The dead engine's pages died with it; only the request's
+            # host-side bookkeeping survives.
+            req.pages = []
+            req.page_table = None
+            recomputed += req.prefill_pos + len(req.generated)
+            if rebase_for_recompute(req):
+                req.state = RequestState.QUEUED
+                req.requeued = True
+                req.redispatches += 1
+                moved.append(req)
+            else:
+                # Killed after its last token was emitted but before
+                # the bookkeeping finished it: nothing left to
+                # generate — finish, never re-emit (at-most-once).
+                req.state = RequestState.FINISHED
+                req.t_finish = now
+                if req.t_admit is not None:
+                    # same service-time sample _collect would stamp —
+                    # incident-affected requests must not vanish from
+                    # the retry-after estimate.
+                    self._service_samples.append(now - req.t_admit)
+                self.finished.append(req)
+        rep.assigned = []
+        self.queue[0:0] = moved
+        self.redispatched_total += len(moved)
+        self.tokens_recomputed_total += recomputed
+        return len(moved), recomputed
+
+    def _check_watchdog(self, now: float) -> None:
+        if self.watchdog is None:
+            return
+        live = [r.id for r in self.replicas if r.healthy]
+        for rid, age in self.watchdog.check(live).items():
+            rep = self.replicas[rid]
+            self.watchdog.kills[rid] = age
+            _log(f"health watchdog: replica {rid} heartbeat stale for "
+                 f"{age:.2f}s (timeout {self.watchdog.timeout:g}s) — "
+                 "killing the stalled replica")
+            self._kill_replica(rep, code=-int(_signal.SIGKILL),
+                               stalled=True, now=now, detect_age=age)
+
+    def _relaunch_due(self, now: float) -> None:
+        for rep in self.replicas:
+            if rep.state != "dead" or now < rep.relaunch_at:
+                continue
+            if self.restarts_used >= self.fleet.max_restarts:
+                rep.state = "failed"
+                _log(f"replica {rep.id}: restart budget exhausted "
+                     f"({self.restarts_used}/{self.fleet.max_restarts} "
+                     "used) — marking failed; the fleet degrades")
+                continue
+            self.restarts_used += 1
+            rep.restarts += 1
+            fresh = self._spawn(rep.id)
+            rep.engine = fresh.engine
+            rep.heartbeat = fresh.heartbeat
+            rep.state = "healthy"
+            rep.exit = None
+            if self.watchdog is not None:
+                # The PREVIOUS incarnation's kill record must not mute
+                # watching the fresh one.
+                self.watchdog.kills.pop(rep.id, None)
+            _log(f"replica {rep.id} relaunched (attempt {rep.restarts}; "
+                 f"{self.fleet.max_restarts - self.restarts_used} "
+                 "restart(s) left fleet-wide)")
+        if not self.alive and self.queue:
+            # Zero replicas left, forever: shed the backlog instead of
+            # holding clients in a queue that can never drain.
+            _log(f"all replicas failed — shedding {len(self.queue)} "
+                 "queued request(s)")
+            for req in self.queue:
+                self._reject(req, "overloaded")
+            self.queue = []
+
+    # ------------------------------------------------------- dispatch
+
+    def _expire_queued(self, now: float) -> None:
+        """Router-level TTL sweep: a request can blow its deadline
+        waiting in the FLEET queue (each engine sweeps its own)."""
+        expired = [r for r in self.queue if r.expired(now)]
+        if not expired:
+            return
+        self.queue = [r for r in self.queue if not r.expired(now)]
+        for req in expired:
+            req.state = RequestState.TIMEOUT
+            req.t_finish = now
+            self.timed_out.append(req)
+
+    def _dispatch(self) -> None:
+        while self.queue:
+            req = self.queue[0]
+            rep = pick_replica(self.replicas, req)
+            if rep is None:
+                break   # head waits; order (and requeue priority) holds
+            self.queue.pop(0)
+            if not rep.engine.scheduler.submit(req):
+                # Defensive only: eligible() mirrors every admission
+                # check (geometry, in-flight headroom, the engine's own
+                # bounded queue), so a failure here means drift the
+                # router could not see. The engine already stamped the
+                # reject and listed it — move that ONE record to the
+                # fleet list (never both: stats must not double-count).
+                if req in rep.engine.scheduler.rejected:
+                    rep.engine.scheduler.rejected.remove(req)
+                self.rejected.append(req)
+                if req.reject_reason == "overloaded":
+                    self.shed_total += 1
+                continue
+            rep.assigned.append(req)
+
+    def _collect(self, rep: Replica) -> None:
+        """Pull terminal requests out of a live replica into the fleet
+        lists and release router bookkeeping."""
+        eng = rep.engine
+        done: List[Request] = []
+        if eng.finished:
+            for req in eng.finished:
+                if req.t_finish is not None and req.t_admit is not None:
+                    self._service_samples.append(
+                        req.t_finish - req.t_admit)
+            self.finished.extend(eng.finished)
+            done.extend(eng.finished)
+            eng.finished = []
+        if eng.timed_out:
+            self.timed_out.extend(eng.timed_out)
+            done.extend(eng.timed_out)
+            eng.timed_out = []
+        if eng.evicted:
+            self.evicted.extend(eng.evicted)
+            done.extend(eng.evicted)
+            eng.evicted = []
+        if eng.scheduler.rejected:
+            self.rejected.extend(eng.scheduler.rejected)
+            done.extend(eng.scheduler.rejected)
+            eng.scheduler.rejected = []
+        if done:
+            gone = set(id(r) for r in done)
+            rep.assigned = [r for r in rep.assigned
+                            if id(r) not in gone]
+
+    # ------------------------------------------------------------ step
+
+    def step(self) -> bool:
+        """One fleet tick: inject due faults, run the watchdog, process
+        due relaunches, expire queued deadlines, dispatch, then step
+        every live replica once. Returns whether any replica made
+        progress (False = idle, everything stalled, or everything
+        waiting on a backoff — callers let wall time pass)."""
+        if self._closed:
+            raise RuntimeError("step() on a closed ServeFleet")
+        now = self.clock()
+        if self._fault_t0 is None:
+            self._fault_t0 = now
+        self._inject_faults(now)
+        self._check_watchdog(now)
+        self._relaunch_due(now)
+        self._expire_queued(now)
+        self._dispatch()
+
+        progressed = False
+        occ: List[float] = []
+        ticked: List[Replica] = []
+        for rep in self.replicas:
+            if not rep.healthy:
+                continue
+            if rep.stall_until is not None:
+                if now < rep.stall_until:
+                    continue   # no step, no heartbeat: a silent stall
+                rep.stall_until = None
+            t0 = self.clock()
+            try:
+                stepped = rep.engine.step()
+            except Exception as e:
+                # A REAL replica crash (engine bug, allocator error,
+                # device OOM) — the docstring's contract: one replica
+                # is one failure domain. Classify + drain + relaunch
+                # like any kill; never let it abort the fleet loop.
+                import traceback
+
+                _log(f"replica {rep.id} raised "
+                     f"{type(e).__name__}: {e} — classifying as a "
+                     "crash\n" + traceback.format_exc())
+                self._kill_replica(rep, code=1, stalled=False, now=now)
+                continue
+            if stepped:
+                progressed = True
+                rep.steps += 1
+                if rep.slow_factor > 1.0:
+                    dt = self.clock() - t0
+                    if dt > 0:
+                        self._sleep((rep.slow_factor - 1.0) * dt)
+            ticked.append(rep)
+            self._collect(rep)
+            occ.append(rep.engine.cache.occupancy())
+        # Heartbeats stamp at the END of the tick, together: replicas
+        # step sequentially in-process, so stamping each inside the
+        # loop would let one slow step (a fresh replica's compile) age
+        # every PEER's file past the watchdog timeout — a spurious
+        # "stalled" kill of a healthy replica. End-of-tick stamping
+        # means the next check (top of the following tick) sees ~zero
+        # age for every replica that completed this tick; only
+        # genuinely skipped replicas — stalled or dead — go stale. An
+        # idle-but-healthy replica still stamps (engine.step() False is
+        # "nothing to do", not "wedged").
+        for rep in ticked:
+            rep.heartbeat.touch(rep.steps)
+        if occ:
+            self.occupancy_samples.append(sum(occ) / len(occ))
+        self.steps += 1
+        return progressed
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drain to idle (or ``max_steps`` fleet ticks); returns
+        requests finished so far. Ticks that make no progress (a stall
+        waiting for the watchdog, a relaunch waiting out its backoff)
+        sleep briefly so wall time — which heartbeat mtimes and
+        backoffs are measured in — actually passes."""
+        while not self.idle:
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            if not self.step():
+                if self.idle:
+                    break
+                self._sleep(0.001)
+        return self.finished
+
+    # ---------------------------------------------------------- stats
+
+    def reset_metrics(self) -> None:
+        """Bench warmup discipline (compile+warm every replica, then
+        measure from a clean slate). Only valid when idle; replica
+        health/restart state survives (a mid-life reset must not
+        forget a failed replica)."""
+        if not self.idle:
+            raise RuntimeError("reset_metrics with requests in flight")
+        self.finished = []
+        self.timed_out = []
+        self.evicted = []
+        self.rejected = []
+        self._service_samples.clear()
+        self.incidents = []
+        self.incidents_by_class = {}
+        self.redispatched_total = 0
+        self.tokens_recomputed_total = 0
+        self.shed_total = 0
+        self.occupancy_samples = []
+        self.steps = 0
+        for rep in self.replicas:
+            if rep.healthy and rep.engine is not None:
+                rep.engine.reset_metrics()
+                rep.steps = 0
+        self._fault_t0 = None
+        self._t_start = self.clock()
+
+    def stats(self) -> Dict:
+        """SLO metrics over every request seen, plus the ``fleet``
+        block: per-replica occupancy/health, rejection/timeout/
+        redispatch counts, classified incidents, and
+        detection/recovery evidence (the router-level satellite of
+        ROADMAP's "serve-engine TTL/SLO metrics in the fleet
+        router")."""
+        from horovod_tpu.serve.metrics import summarize
+
+        in_service = [r for rep in self.replicas for r in rep.assigned]
+        everything = (self.finished + self.timed_out + self.evicted
+                      + self.rejected + list(self.queue) + in_service)
+        out = summarize(everything, self.clock() - self._t_start,
+                        self.chips, self.occupancy_samples)
+        by_reason: Dict[str, int] = {}
+        for req in self.rejected:
+            key = req.reject_reason or "?"
+            by_reason[key] = by_reason.get(key, 0) + 1
+        detect = [i["detect_s"] for i in self.incidents
+                  if i["category"] == "stalled"]
+        out["fleet"] = {
+            "replicas": len(self.replicas),
+            "healthy": sum(1 for r in self.replicas if r.healthy),
+            "dead": sum(1 for r in self.replicas if r.state == "dead"),
+            "failed": sum(1 for r in self.replicas
+                          if r.state == "failed"),
+            "queued": len(self.queue),
+            "redispatched": self.redispatched_total,
+            "tokens_recomputed": self.tokens_recomputed_total,
+            "shed": self.shed_total,
+            "rejected_by_reason": by_reason,
+            "timeout": len(self.timed_out),
+            "incidents": list(self.incidents),
+            "incidents_by_class": dict(self.incidents_by_class),
+            "restarts_used": self.restarts_used,
+            "max_restarts": self.fleet.max_restarts,
+            "detect_s": round(max(detect), 4) if detect else None,
+            "per_replica": [
+                dict(replica_load(r), id=r.id, state=r.state,
+                     steps=r.steps, restarts=r.restarts)
+                for r in self.replicas],
+        }
+        return out

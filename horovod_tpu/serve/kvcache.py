@@ -31,6 +31,48 @@ class OutOfPages(Exception):
     satisfy the request (all-or-nothing; nothing was allocated)."""
 
 
+#: Physical pages never handed out: page 0, the reserved null sink
+#: (inactive lanes and padded prefill rows scatter there). The ONE
+#: definition both the live allocator and the router-side static
+#: admission math derive from.
+RESERVED_NULL_PAGES = 1
+
+
+def allocatable_pages(num_pages: int) -> int:
+    """Pages the allocator can actually grant (the capacity both
+    :class:`PageAllocator` and the fleet router's static
+    :func:`fits_geometry` check must agree on)."""
+    return num_pages - RESERVED_NULL_PAGES
+
+
+def pages_needed(prompt_len: int, max_new_tokens: int,
+                 page_size: int) -> int:
+    """Worst-case pages for a request: cache positions
+    ``0..prompt_len + max_new_tokens - 2`` are written (the final
+    sampled token is never fed back), so the last page slot touched is
+    ``(prompt_len + max_new_tokens - 2) // page_size``. Module-level so
+    the fleet router's static admission check and the live cache share
+    ONE page-math implementation."""
+    positions = prompt_len + max_new_tokens - 1
+    return max(1, math.ceil(positions / page_size))
+
+
+def fits_geometry(prompt_len: int, max_new_tokens: int, *, max_len: int,
+                  page_size: int, capacity: int) -> bool:
+    """Whether a request can EVER run on this cache geometry: position
+    bound (``prompt + steps <= Lmax``) and total-capacity bound.
+    ``capacity`` is the ALLOCATABLE page count (num_pages minus the
+    reserved null page). The single feasibility predicate behind both
+    :meth:`PagedKVCache.fits` (live engine) and
+    :meth:`horovod_tpu.serve.fleet.ServeFleet.submit` (router-side —
+    admission control must keep answering while every replica is
+    mid-relaunch)."""
+    return (prompt_len >= 1 and max_new_tokens >= 1
+            and prompt_len + max_new_tokens <= max_len
+            and pages_needed(prompt_len, max_new_tokens, page_size)
+            <= capacity)
+
+
 class PageAllocator:
     """Free-list allocator over physical page ids.
 
@@ -127,26 +169,25 @@ class PagedKVCache:
         #: tools/hvdverify registers the invariant as forbid_donation).
         self.pages = [{"k": mk(), "v": mk()}
                       for _ in range(self.num_layers)]
-        self.allocator = PageAllocator(config.num_pages, reserved=1)
+        self.allocator = PageAllocator(config.num_pages,
+                                       reserved=RESERVED_NULL_PAGES)
 
     # ------------------------------------------------------- page math
 
     def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
-        """Worst-case pages for a request: cache positions
-        ``0..prompt_len + max_new_tokens - 2`` are written (the final
-        sampled token is never fed back), so the last page slot touched
-        is ``(prompt_len + max_new_tokens - 2) // page_size``."""
-        positions = prompt_len + max_new_tokens - 1
-        return max(1, math.ceil(positions / self.config.page_size))
+        """Worst-case pages for a request — :func:`pages_needed` over
+        this cache's page size."""
+        return pages_needed(prompt_len, max_new_tokens,
+                            self.config.page_size)
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
-        """Whether the request can EVER run: position bound (the decode
-        lane's ``prompt + steps <= Lmax`` contract) and total-capacity
-        bound. Failing this is a hard reject, not a queue."""
-        return (prompt_len >= 1 and max_new_tokens >= 1
-                and prompt_len + max_new_tokens <= self.max_len
-                and self.pages_needed(prompt_len, max_new_tokens)
-                <= self.allocator.capacity)
+        """Whether the request can EVER run — :func:`fits_geometry`
+        over this cache's geometry. Failing this is a hard reject, not
+        a queue."""
+        return fits_geometry(prompt_len, max_new_tokens,
+                             max_len=self.max_len,
+                             page_size=self.config.page_size,
+                             capacity=self.allocator.capacity)
 
     def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Admission control (reserve discipline): admit only when the

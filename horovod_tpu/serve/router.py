@@ -1,0 +1,100 @@
+"""Fleet routing policy: least-loaded replica selection + the overload
+hint.
+
+Pure functions over the fleet's replica objects — the router never
+touches device arrays and holds no state of its own, so
+:class:`~horovod_tpu.serve.fleet.ServeFleet` (which owns the admission
+queue and the replica lifecycle) is the single writer and these
+policies are unit-testable in isolation.
+
+Routing is least-loaded with a deliberate key order:
+
+1. **free decode slots** (desc) — the resource a new request occupies
+   first; a replica with idle lanes finishes new work soonest;
+2. **page occupancy** (asc) — the eviction-pressure tiebreak: between
+   two replicas with equal lanes, the one with more free KV pages is
+   less likely to evict-recompute;
+3. **dispatched-but-unfinished count** (asc) — breaks cold-start ties
+   (all replicas idle) so a burst spreads round-robin instead of
+   piling onto replica 0;
+4. **replica id** — total order, so routing is deterministic for the
+   bit-exactness pins.
+
+A replica is only *eligible* when healthy and when the request fits
+under its in-flight limit right now — the router holds backlog at the
+FLEET level (one queue to shed from, cheaper redispatch, better
+balancing) instead of deep-queueing inside replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def replica_load(rep) -> dict:
+    """One replica's routing-relevant load (also the ``stats()``
+    per-replica cell): free decode slots, page occupancy, and the
+    dispatched-but-unfinished request count."""
+    eng = rep.engine
+    if eng is None:
+        return {"free_slots": 0, "occupancy": 1.0,
+                "in_flight": len(rep.assigned)}
+    return {
+        "free_slots": eng._free_slots(),
+        "occupancy": eng.cache.occupancy(),
+        "in_flight": len(rep.assigned),
+    }
+
+
+def eligible(rep, req) -> bool:
+    """May ``req`` be dispatched to ``rep`` right now? Healthy, the
+    geometry admits the request at all, there is in-flight headroom
+    (dispatched-but-unfinished stays under the engine's in-flight
+    limit, so the router never deep-queues into a replica), and the
+    engine's OWN bounded queue — a standalone-engine knob the fleet
+    config may still carry — has room. The last check matters: an
+    engine-side queue reject is TERMINAL, while the router's contract
+    is that a backlogged request WAITS at the fleet head until a
+    replica frees up."""
+    if not rep.healthy or rep.engine is None:
+        return False
+    eng = rep.engine
+    if not eng.cache.fits(req.prompt_len, req.max_new_tokens):
+        return False
+    if len(rep.assigned) >= eng.config.in_flight_limit:
+        return False
+    c = eng.config
+    return not c.max_queue or len(eng.scheduler.queue) < c.max_queue
+
+
+def pick_replica(replicas: Sequence, req) -> Optional[object]:
+    """The least-loaded eligible replica for ``req`` (None = every
+    replica is down or saturated; the fleet queue's head WAITS — no
+    skip — preserving arrival order the same way the scheduler's
+    reserve admission does)."""
+    candidates = [r for r in replicas if eligible(r, req)]
+    if not candidates:
+        return None
+    loads = {r.id: replica_load(r) for r in candidates}
+    return min(candidates, key=lambda r: (
+        -loads[r.id]["free_slots"],
+        loads[r.id]["occupancy"],
+        loads[r.id]["in_flight"],
+        r.id,
+    ))
+
+
+def retry_after_hint(backlog: int, healthy_slots: int,
+                     service_samples: Sequence[float],
+                     floor: float) -> float:
+    """Advisory seconds-until-retry for an overloaded rejection.
+
+    Little's-law flavored: the backlog ahead of the client, divided by
+    the fleet's current parallel service capacity, times the observed
+    mean request service time (admit -> finish). With no finished
+    requests yet (cold start) the floor alone is returned — an honest
+    "soon" rather than a made-up number."""
+    if not service_samples or healthy_slots < 1:
+        return floor
+    mean_service = sum(service_samples) / len(service_samples)
+    return max(floor, (backlog + 1) * mean_service / healthy_slots)

@@ -84,6 +84,18 @@ class Request:                     # tracked by `is` in slot lists
     ttl: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
 
+    #: why a REJECTED request was rejected: ``"infeasible"`` (can never
+    #: run on this geometry — retrying is pointless) or ``"overloaded"``
+    #: (the bounded queue/fleet is full — retry after ``retry_after``).
+    reject_reason: Optional[str] = None
+    #: advisory seconds-until-retry for overloaded rejections (the
+    #: fleet router's load-shedding hint; None = no estimate).
+    retry_after: Optional[float] = None
+    #: times this request was drained off a dead replica and
+    #: redispatched to a survivor (fleet bookkeeping; eviction-recompute
+    #: within one engine counts in ``evictions``).
+    redispatches: int = 0
+
     state: str = RequestState.QUEUED
     #: prompt tokens already prefilled (chunk progress).
     prefill_pos: int = 0
@@ -176,11 +188,18 @@ class Scheduler:
 
     def submit(self, req: Request) -> bool:
         """Queue a request; False = hard-rejected (can never run, or
-        the bounded queue is full). Rejection is terminal."""
+        the bounded queue is full). Rejection is terminal; the request
+        carries ``reject_reason`` so clients can tell "never retry"
+        (infeasible) from "retry later" (overloaded)."""
         c = self.config
-        if not self.cache.fits(req.prompt_len, req.max_new_tokens) or \
-                (c.max_queue and len(self.queue) >= c.max_queue):
+        if not self.cache.fits(req.prompt_len, req.max_new_tokens):
             req.state = RequestState.REJECTED
+            req.reject_reason = "infeasible"
+            self.rejected.append(req)
+            return False
+        if c.max_queue and len(self.queue) >= c.max_queue:
+            req.state = RequestState.REJECTED
+            req.reject_reason = "overloaded"
             self.rejected.append(req)
             return False
         req.state = RequestState.QUEUED
@@ -190,13 +209,7 @@ class Scheduler:
     def requeue(self, req: Request) -> bool:
         """Re-admit an evicted request: its generated tokens extend the
         prompt (recompute path) and its budget shrinks accordingly."""
-        if req.generated:
-            req.prompt = np.concatenate(
-                [req.prompt, np.asarray(req.generated, np.int32)])
-            req.max_new_tokens -= len(req.generated)
-            req.generated = []
-        req.prefill_pos = 0
-        if req.max_new_tokens < 1:
+        if not rebase_for_recompute(req):
             # Nothing left to generate — it was evicted on its last
             # token; treat as finished (engine stamps the clock).
             req.state = RequestState.FINISHED
@@ -315,6 +328,46 @@ class Scheduler:
         waiting). Queue membership is this module's invariant — callers
         must not rebuild ``queue`` themselves."""
         self.queue = [r for r in self.queue if r is not req]
+
+
+def make_request(config, clock, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_token=None, seed: int = 0, arrival=None,
+                 ttl=None) -> Request:
+    """Build one :class:`Request` with the config/clock defaulting both
+    submit surfaces share (``ServeEngine.submit`` and
+    ``ServeFleet.submit``): ``eos_token`` falls back to the config's,
+    ``arrival`` to now, ``ttl`` to ``config.default_ttl``. One helper
+    so a future per-request knob or default change cannot silently
+    apply to one surface and not the other."""
+    return Request(
+        prompt=prompt, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k,
+        eos_token=eos_token if eos_token is not None
+        else config.eos_token,
+        seed=seed,
+        arrival=arrival if arrival is not None else clock(),
+        ttl=ttl if ttl is not None else config.default_ttl)
+
+
+def rebase_for_recompute(req: Request) -> bool:
+    """Fold the generated-so-far tokens into the prompt — the
+    recompute arithmetic shared by eviction-requeue (within one engine)
+    and dead-replica redispatch (the fleet router): the prompt grows by
+    the generated prefix, the generation budget shrinks by it, and
+    prefill restarts from 0. ``output`` is untouched — tokens already
+    emitted are NEVER re-emitted (the at-most-once guarantee) — and
+    ``sample_index`` stays position-stable, so greedy recompute is
+    bit-identical and temperature>0 requests re-draw their exact
+    stream. Returns False when nothing is left to generate (the
+    request died on its very last token; the caller finishes it)."""
+    if req.generated:
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)])
+        req.max_new_tokens -= len(req.generated)
+        req.generated = []
+    req.prefill_pos = 0
+    return req.max_new_tokens >= 1
 
 
 def pick_victim(candidates: Sequence[Request],

@@ -168,6 +168,24 @@ _SLOW_TESTS = {
     # which runs BOTH attention modes end-to-end).
     "test_serve_bench.py::TestServeBenchContract::test_attention_paged_record_contract",
     "test_serve_bench.py::TestServeBenchContract::test_ab_attention_record_carries_both_sides",
+    # 25s + 10s fleet-bench subprocess wrappers (each runs whole
+    # clean/faulted fleets): stand-ins are the in-process
+    # TestKillRedispatch::test_greedy_bit_identical_to_fault_free_run
+    # pin (fast) and the check.sh fleet smoke, which runs the exact
+    # acceptance command end-to-end. Arg-validation stays fast.
+    "test_serve_bench.py::TestFleetBenchContract::test_fleet_fault_ab_record_contract",
+    "test_serve_bench.py::TestFleetBenchContract::test_fleet_clean_record_contract",
+    # 11s + 8s + 7s fleet composition depth: the fast greedy kill pin
+    # already runs a clean fleet (== lm_decode per request) AND a
+    # faulted fleet on the same submissions; the sampled variant
+    # re-runs the same machinery at temperature>0 (engine-level
+    # sampling recompute exactness is pinned fast in
+    # test_serve_engine), and the stall e2e needs real wall-clock
+    # heartbeat aging (watchdog unit pins + TestRestartPolicy stay
+    # fast).
+    "test_serve_fleet.py::TestFleetBasics::test_all_finish_and_match_lm_decode",
+    "test_serve_fleet.py::TestKillRedispatch::test_sampled_requests_resume_exact_stream",
+    "test_serve_fleet.py::TestStallWatchdog::test_stall_watchdog_classified_relaunch",
     # 14s whole-CLI launch wrapper; the TestRunFn in-process launcher
     # tests (identity env, collectives through the launcher) stay fast,
     # and the restart-path CLI tests were already slow-marked.

@@ -1,0 +1,22 @@
+"""HVD010 negative: the supervised-relaunch discipline — an attempt
+counter compared against a budget AND a backoff sleep between
+attempts (the elastic supervisor / serving fleet shape). Either signal
+alone silences the rule; this fixture carries both."""
+
+import time
+
+
+def supervise(cmd, max_restarts):
+    attempts = 0
+    while True:
+        result = relaunch_worker(cmd)
+        if result.code == 0:
+            return 0
+        if attempts >= max_restarts:
+            return result.code
+        attempts += 1
+        time.sleep(0.5 * (2 ** attempts))
+
+
+def relaunch_worker(cmd):
+    raise NotImplementedError

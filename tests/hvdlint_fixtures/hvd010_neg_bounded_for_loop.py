@@ -1,0 +1,26 @@
+"""HVD010 negative: a bounded ``for`` retry loop, and a ``while True``
+that loops over non-retry work (an event pump draining a queue). A
+``for`` over a finite attempt range is already budgeted by
+construction; a drain loop calling get()/process() retries nothing."""
+
+
+def submit_with_retries(router, request, attempts=3):
+    last = None
+    for _ in range(attempts):
+        try:
+            return router.submit(request)
+        except OSError as e:
+            last = e
+    raise last
+
+
+def drain_events(queue):
+    while True:
+        event = queue.get()
+        if event is None:
+            return
+        process(event)
+
+
+def process(event):
+    raise NotImplementedError

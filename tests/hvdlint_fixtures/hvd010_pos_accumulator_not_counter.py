@@ -1,0 +1,20 @@
+"""HVD010 positive: an ACCUMULATOR is not an attempt counter. The
+``data += chunk`` concatenation (and the non-literal ``total =
+total + n`` byte tally) bound nothing — the reconnect still retries
+at full speed forever, so the rule must fire through them."""
+
+
+def read_forever(sock):
+    data = b""
+    total = 0
+    while True:
+        chunk = sock.recv(4096)
+        data += chunk
+        n = len(chunk)
+        total = total + n
+        if not chunk:
+            reconnect(sock)  # EXPECT: HVD010
+
+
+def reconnect(sock):
+    raise NotImplementedError

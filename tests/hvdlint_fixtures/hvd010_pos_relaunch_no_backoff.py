@@ -1,0 +1,15 @@
+"""HVD010 positive: a supervisor that relaunches a dead worker in a
+bare ``while True:`` — no sleep between attempts, no attempt counter.
+A worker that crash-loops (bad binary, poisoned checkpoint) re-crashes
+instantly, so this loop spins at full speed forever."""
+
+
+def supervise_forever(cmd):
+    while True:
+        result = relaunch_worker(cmd)  # EXPECT: HVD010
+        if result.code == 0:
+            return 0
+
+
+def relaunch_worker(cmd):
+    raise NotImplementedError

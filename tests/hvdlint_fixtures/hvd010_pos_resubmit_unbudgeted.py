@@ -1,0 +1,16 @@
+"""HVD010 positive: an unbudgeted request-resubmit loop. The except
+arm swallows the overload error and immediately resubmits — the retry
+storm shape: every rejected client hammers the service harder, and
+nothing bounds or spaces the attempts."""
+
+
+def send_until_accepted(router, request):
+    while True:
+        try:
+            return router.resubmit(request)  # EXPECT: HVD010
+        except OverloadedError:
+            continue
+
+
+class OverloadedError(Exception):
+    pass

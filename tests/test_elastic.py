@@ -647,6 +647,55 @@ class TestSupervisor:
         cell = elastic_cell(rec)
         assert "r1" in cell and "2→1" in cell
 
+    def test_heartbeat_dir_namespaced_per_supervisor(self, tmp_path):
+        """Regression (round-12 satellite): HOROVOD_HEARTBEAT_DIR is
+        exported to workers, so two supervisors sharing one base dir on
+        one host used to watch EACH OTHER's hb-<rank> files — a foreign
+        rank's touches keep a stalled local rank 'alive' forever. Each
+        supervise() must export a unique per-instance subdirectory."""
+        base = str(tmp_path / "hb")
+        exported = []
+        for _ in range(2):
+            envs = []
+            rc = elastic.supervise(
+                ["prog"], np=1, watchdog_timeout=30.0,
+                heartbeat_dir=base,
+                _launch=self._fake_launch([_result({0: 0})], envs))
+            assert rc == 0
+            exported.append(envs[0]["HOROVOD_HEARTBEAT_DIR"])
+        assert exported[0] != exported[1]
+        for d in exported:
+            assert os.path.dirname(d) == base
+            # ...and each call removed ITS dir on exit: looping over
+            # supervise() must not accumulate orphan dirs in the base.
+            assert not os.path.exists(d)
+        assert os.listdir(base) == []
+
+    def test_disabled_watchdog_drops_inherited_heartbeat_dir(self):
+        """With the watchdog off, an INHERITED heartbeat dir (e.g. from
+        an outer supervisor) must not be forwarded: this job's workers
+        would otherwise touch the outer watchdog's files and mask its
+        stall detection."""
+        envs = []
+        rc = elastic.supervise(
+            ["prog"], np=1, watchdog_timeout=0.0,
+            env={"HOROVOD_HEARTBEAT_DIR": "/tmp/outer-supervisor-hb"},
+            _launch=self._fake_launch([_result({0: 0})], envs))
+        assert rc == 0
+        assert "HOROVOD_HEARTBEAT_DIR" not in envs[0]
+
+    def test_namespaced_heartbeat_dir_helper_unique(self, tmp_path):
+        from horovod_tpu.elastic.signals import namespaced_heartbeat_dir
+
+        a = namespaced_heartbeat_dir(str(tmp_path))
+        b = namespaced_heartbeat_dir(str(tmp_path))
+        assert a != b and os.path.isdir(a) and os.path.isdir(b)
+        assert os.path.dirname(a) == str(tmp_path)
+        # no base: a fresh private tempdir, still unique
+        c = namespaced_heartbeat_dir(None)
+        d = namespaced_heartbeat_dir(None)
+        assert c != d and os.path.isdir(c) and os.path.isdir(d)
+
 
 # ------------------------------------------------------------ resize remap
 

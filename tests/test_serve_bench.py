@@ -114,6 +114,94 @@ class TestServeBenchContract:
             assert p.returncode == 2, (bad, p.stderr[-300:])
 
 
+class TestFleetBenchContract:
+    def test_fleet_fault_ab_record_contract(self):
+        """The round-12 acceptance e2e: --fleet 2 with a mid-run
+        replica kill runs clean THEN faulted on the identical workload,
+        pins every both-finished greedy stream bit-identical, classes
+        the incident, and stamps the recovery metrics."""
+        p = _run("serve_bench.py", *TINY, "--rate", "200",
+                 "--fleet", "2", "--fault-plan", "kill:replica=1,at=50%",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == \
+            "serve_fleet_fault_ab_tokens_per_sec_per_chip"
+        s = rec["serve"]
+        assert s["mode"] == "fleet_fault_ab"
+        assert s["by_state"] == {"finished": 6}
+        f = s["fleet"]
+        assert f["incidents_by_class"] == {"crashed": 1}
+        assert f["replicas"] == 2
+        # never FAILED (budget 2); whether the relaunch landed before
+        # the fleet drained is timing, so only pin the invariant
+        assert f["failed"] == 0
+        inc = f["incidents"][0]
+        assert inc["category"] == "crashed" and inc["code"] == -9
+        ab = s["fleet_ab"]
+        assert ab["redispatch_pin"]["identical"] is True
+        assert ab["redispatch_pin"]["compared"] == 6
+        assert ab["clean"]["by_state"] == {"finished": 6}
+        assert ab["p99_ttft_clean_ms"] is not None
+        assert ab["p99_ttft_faulted_ms"] is not None
+        assert rec["config"]["fleet"]["replicas"] == 2
+        assert rec["config"]["fleet"]["fault_plan"] == \
+            "kill:replica=1,at=50%"
+        # the perf_summary fleet column renders this record
+        from tools.perf_summary import fleet_cell
+
+        cell = fleet_cell(rec)
+        assert cell.startswith("2r") and "crashed1" in cell
+
+    def test_fleet_clean_record_contract(self):
+        p = _run("serve_bench.py", *TINY, "--fleet", "2",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_fleet_tokens_per_sec_per_chip"
+        s = rec["serve"]
+        assert s["mode"] == "fleet"
+        assert s["by_state"] == {"finished": 6}
+        f = s["fleet"]
+        assert f["incidents"] == [] and f["redispatched"] == 0
+        assert f["healthy"] == 2
+        assert "fleet_ab" not in s
+
+    def test_fleet_arg_validation(self):
+        cases = [
+            # faults address replicas: need --fleet
+            ["--fault-plan", "kill:replica=0,at=1s"],
+            # replica outside the fleet
+            ["--fleet", "2", "--fault-plan", "kill:replica=5,at=1s"],
+            # malformed plan dies in argparse, not mid-run
+            ["--fleet", "2", "--fault-plan", "explode:replica=0,at=1s"],
+            # a stall with no watchdog would hang the lane forever
+            ["--fleet", "2", "--fault-plan", "stall:replica=0,at=1s"],
+            # one A/B per record
+            ["--fleet", "2", "--ab"],
+            ["--fleet", "2", "--ab-attention"],
+            ["--fleet", "2", "--static"],
+        ]
+        for bad in cases:
+            p = _run("serve_bench.py", *TINY, *bad, check=False)
+            assert p.returncode == 2, (bad, p.stderr[-300:])
+
+
+def test_fleet_cell_renders_synthetic_record():
+    """tools/perf_summary.py fleet column (fast, no subprocess)."""
+    from tools.perf_summary import fleet_cell
+
+    assert fleet_cell({}) == "—"
+    assert fleet_cell({"serve": {"ttft_ms": {}}}) == "—"
+    rec = {"serve": {
+        "fleet": {"replicas": 2,
+                  "incidents_by_class": {"crashed": 1, "stalled": 2},
+                  "redispatched": 3, "tokens_recomputed": 10,
+                  "detect_s": 0.8, "shed": 2},
+        "fleet_ab": {"faulted_over_clean_p99_ttft": 2.07},
+    }}
+    cell = fleet_cell(rec)
+    assert cell == "2r crashed1,stalled2 rd3/10tok det 0.8s shed2 f/c 2.07"
+
+
 class TestDecodeBenchSatellites:
     def test_steps_zero_is_an_argparse_error(self):
         """The satellite fix: --steps 0 must die in argparse, not as a

@@ -1,0 +1,725 @@
+"""Fault-tolerant serving fleet (horovod_tpu/serve/fleet.py + router.py).
+
+The acceptance pins:
+
+* a replica KILLED mid-decode has its in-flight requests drained and
+  redispatched to survivors, and every greedy stream stays
+  BIT-IDENTICAL to the fault-free run (at-most-once: emitted tokens are
+  never re-emitted — the generated-so-far prefix rides back as prompt
+  through the eviction-recompute arithmetic);
+* a silent STALL becomes a classified incident: heartbeat goes stale,
+  the (real, PR-9) HealthWatchdog kills the replica, the incident
+  classes ``stalled`` via the WorkerExit taxonomy, and the fleet
+  finishes everything after the budgeted relaunch (slow-marked: real
+  wall clock);
+* load shedding tells the truth: the bounded router queue rejects
+  overflow terminally as ``overloaded`` with a retry-after hint,
+  infeasible requests as ``infeasible``, and REJECTED requests never
+  allocate a single KV page (allocator conservation).
+
+Everything except the watchdog lane runs on an injectable fake clock.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.faults import (FaultPlanError, ServeFaultAction,
+                                        parse_serve_fault_plan)
+from horovod_tpu.models import parallel_lm as plm
+from horovod_tpu.serve import (FleetConfig, Request, ServeConfig,
+                               ServeFleet)
+from horovod_tpu.serve.router import (eligible, pick_replica,
+                                      retry_after_hint)
+from horovod_tpu.serve.scheduler import rebase_for_recompute
+
+V, LMAX, LAYERS, H, DH, FFN = 64, 64, 2, 2, 8, 32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX, LAYERS, H,
+                              DH, FFN)
+
+
+def _prompt(i, lp):
+    key = jax.random.fold_in(jax.random.PRNGKey(100), i)
+    return np.asarray(jax.random.randint(key, (lp,), 0, V), np.int32)
+
+
+def _ref(params, prompt, steps):
+    return list(np.asarray(
+        plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _cfg(**kw):
+    base = dict(page_size=8, num_pages=32, decode_slots=2,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _fleet(params, clk=None, cfg=None, **fleet_kw):
+    fleet_kw.setdefault("replicas", 2)
+    fleet_kw.setdefault("backoff_base", 0.01)
+    kw = {}
+    if clk is not None:
+        kw = {"clock": clk, "sleep": clk.sleep}
+    return ServeFleet(params, cfg or _cfg(), FleetConfig(**fleet_kw),
+                      **kw)
+
+
+# ------------------------------------------------------ fault grammar
+
+
+class TestServeFaultGrammar:
+    def test_parses_the_issue_example(self):
+        acts = parse_serve_fault_plan(
+            "kill:replica=1,at=2.5s; stall:replica=0,at=4s; "
+            "slow:replica=2,at=1s,factor=3")
+        assert [a.kind for a in acts] == ["kill", "stall", "slow"]
+        assert [a.replica for a in acts] == [1, 0, 2]
+        assert [a.at for a in acts] == [2.5, 4.0, 1.0]
+        assert acts[2].factor == 3.0
+
+    def test_percent_form_resolves_against_horizon(self):
+        (a,) = parse_serve_fault_plan("kill:replica=0,at=40%")
+        assert a.at is None and a.at_frac == pytest.approx(0.4)
+        assert a.resolve_at(10.0) == pytest.approx(4.0)
+        with pytest.raises(FaultPlanError, match="horizon"):
+            a.resolve_at(None)
+
+    def test_plain_seconds_and_empty_plan(self):
+        (a,) = parse_serve_fault_plan("stall:replica=1,at=0.25,secs=2")
+        assert a.at == 0.25 and a.secs == 2.0
+        assert parse_serve_fault_plan("") == []
+        assert parse_serve_fault_plan("  ;  ") == []
+
+    @pytest.mark.parametrize("plan, match", [
+        ("boom:replica=0,at=1s", "kind"),
+        ("kill:replica=0", "at= are required"),
+        ("kill:at=1s", "replica= and at="),
+        ("kill:replica=-1,at=1s", ">= 0"),
+        ("kill:replica=0,at=eventually", "not a time"),
+        ("kill:replica=0,at=nan", "finite"),
+        ("kill:replica=0,at=1e999", "finite"),
+        ("kill:replica=0,at=150%", "0%..100%"),
+        ("stall:replica=0,at=1s,secs=nan", "> 0"),
+        ("slow:replica=0,at=1s,factor=nan", "finite"),
+        ("slow:replica=0,at=1s", "factor"),
+        ("slow:replica=0,at=1s,factor=0.5", ">= 1"),
+        ("kill:replica=0,at=1s,factor=2", "only applies to"),
+        ("kill:replica=0,at=1s,secs=2", "only applies to"),
+        ("stall:replica=0,at=1s,secs=0", "> 0"),
+    ])
+    def test_malformed_plans_fail_fast(self, plan, match):
+        with pytest.raises(FaultPlanError, match=match):
+            parse_serve_fault_plan(plan)
+
+    def test_fleet_validates_replica_ids_at_arm_time(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        with pytest.raises(FaultPlanError, match="outside this fleet"):
+            fl.arm_fault_plan("kill:replica=7,at=1s")
+
+    def test_hand_built_actions_validated_at_arm_time(self, params):
+        """Actions built in code (the documented Sequence input path)
+        get the parser's fail-fast contract: a malformed one raises
+        FaultPlanError at ARM time, never a TypeError out of the
+        fleet loop at fire time."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        with pytest.raises(FaultPlanError, match="finite factor"):
+            fl.arm_fault_plan(
+                [ServeFaultAction(kind="slow", replica=0, at=1.0)])
+        with pytest.raises(FaultPlanError, match="exactly one"):
+            fl.arm_fault_plan(
+                [ServeFaultAction(kind="kill", replica=0)])
+        # a valid hand-built action arms fine
+        fl.arm_fault_plan(
+            [ServeFaultAction(kind="kill", replica=0, at=1.0)])
+
+
+# ------------------------------------------------------------- router
+
+
+class _StubEngine:
+    def __init__(self, free, occ, slots=2):
+        self._free, self._occ = free, occ
+        self.config = ServeConfig(decode_slots=slots, page_size=8,
+                                  num_pages=32)
+
+        class _Cache:
+            def __init__(self, occ):
+                self._occ = occ
+
+            def occupancy(self):
+                return self._occ
+
+            def fits(self, lp, mn):
+                return lp + mn <= 64
+
+        self.cache = _Cache(occ)
+
+    def _free_slots(self):
+        return self._free
+
+
+class _StubReplica:
+    def __init__(self, rid, free, occ, state="healthy", assigned=0):
+        self.id = rid
+        self.state = state
+        self.engine = _StubEngine(free, occ)
+        self.assigned = [object()] * assigned
+
+    @property
+    def healthy(self):
+        return self.state == "healthy"
+
+
+class TestRouter:
+    def _req(self):
+        return Request(prompt=np.arange(4, dtype=np.int32), max_new_tokens=4)
+
+    def test_most_free_slots_wins(self):
+        reps = [_StubReplica(0, 0, 0.2), _StubReplica(1, 2, 0.9)]
+        assert pick_replica(reps, self._req()).id == 1
+
+    def test_occupancy_breaks_slot_ties(self):
+        reps = [_StubReplica(0, 1, 0.8), _StubReplica(1, 1, 0.1)]
+        assert pick_replica(reps, self._req()).id == 1
+
+    def test_in_flight_breaks_cold_start_ties(self):
+        reps = [_StubReplica(0, 2, 0.0, assigned=1),
+                _StubReplica(1, 2, 0.0, assigned=0)]
+        assert pick_replica(reps, self._req()).id == 1
+
+    def test_dead_and_saturated_replicas_ineligible(self):
+        dead = _StubReplica(0, 2, 0.0, state="dead")
+        # in_flight_limit = decode_slots + 1 = 3
+        full = _StubReplica(1, 0, 0.5, assigned=3)
+        assert not eligible(dead, self._req())
+        assert not eligible(full, self._req())
+        assert pick_replica([dead, full], self._req()) is None
+
+    def test_retry_after_hint(self):
+        assert retry_after_hint(5, 4, [], 0.05) == 0.05
+        hint = retry_after_hint(3, 2, [1.0, 3.0], 0.05)
+        assert hint == pytest.approx((3 + 1) * 2.0 / 2)
+        assert retry_after_hint(0, 0, [1.0], 0.25) == 0.25
+
+
+# ------------------------------------------------- rebase (recompute)
+
+
+class TestRebase:
+    def test_folds_generated_into_prompt_output_untouched(self):
+        req = Request(prompt=np.arange(5, dtype=np.int32),
+                      max_new_tokens=6)
+        req.generated = [7, 8, 9]
+        req.output = [7, 8, 9]
+        req.prefill_pos = 5
+        assert rebase_for_recompute(req)
+        assert list(req.prompt) == [0, 1, 2, 3, 4, 7, 8, 9]
+        assert req.max_new_tokens == 3
+        assert req.generated == [] and req.output == [7, 8, 9]
+        assert req.prefill_pos == 0
+        # the sampling fold position only ever counts ORIGINAL prompt
+        # + emitted tokens: stable across any number of rebases.
+        assert req.sample_index == 5 + 3
+
+    def test_nothing_left_to_generate(self):
+        req = Request(prompt=np.arange(3, dtype=np.int32),
+                      max_new_tokens=2)
+        req.generated = [1, 2]
+        req.output = [1, 2]
+        assert not rebase_for_recompute(req)
+
+
+# ------------------------------------------------------ fleet basics
+
+
+class TestFleetBasics:
+    def test_all_finish_and_match_lm_decode(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        spec = [(5, 6), (9, 4), (3, 8), (7, 5)]
+        reqs = [fl.submit(_prompt(i, lp), n)
+                for i, (lp, n) in enumerate(spec)]
+        while not fl.idle:
+            fl.step()
+            clk.t += 0.001
+        for i, ((lp, n), req) in enumerate(zip(spec, reqs)):
+            assert req.state == "finished"
+            assert req.output == _ref(params, _prompt(i, lp), n)
+        st = fl.stats()
+        assert st["by_state"] == {"finished": 4}
+        f = st["fleet"]
+        assert f["replicas"] == 2 and f["healthy"] == 2
+        assert f["incidents"] == [] and f["redispatched"] == 0
+        assert len(f["per_replica"]) == 2
+        for cell in f["per_replica"]:
+            assert {"id", "state", "free_slots", "occupancy",
+                    "in_flight", "steps", "restarts"} <= set(cell)
+        # both replicas actually served (the router spread the load)
+        assert all(c["steps"] > 0 for c in f["per_replica"])
+
+    def test_heartbeat_dirs_namespaced_per_fleet(self, params, tmp_path):
+        base = str(tmp_path / "hb")
+        f1 = _fleet(params, FakeClock(), heartbeat_dir=base,
+                    watchdog_timeout=30.0)
+        f2 = _fleet(params, FakeClock(), heartbeat_dir=base,
+                    watchdog_timeout=30.0)
+        assert f1.heartbeat_dir != f2.heartbeat_dir
+        assert os.path.dirname(f1.heartbeat_dir) == base
+        assert os.path.dirname(f2.heartbeat_dir) == base
+
+    def test_close_removes_heartbeat_dir_and_is_idempotent(
+            self, params, tmp_path):
+        base = str(tmp_path / "hb")
+        with _fleet(params, FakeClock(), heartbeat_dir=base) as fl:
+            hb = fl.heartbeat_dir
+            assert os.path.isdir(hb)
+        assert not os.path.exists(hb)   # context exit closed it
+        fl.close()                       # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            fl.step()
+
+    def test_reset_metrics_requires_idle_and_clears(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        req = fl.submit(_prompt(0, 5), 3)
+        with pytest.raises(RuntimeError, match="in flight"):
+            fl.reset_metrics()
+        while not fl.idle:
+            fl.step()
+            clk.t += 0.001
+        assert req.state == "finished"
+        fl.reset_metrics()
+        st = fl.stats()
+        assert st["requests"] == 0 and st["fleet"]["redispatched"] == 0
+
+
+# ------------------------------------------- drain/redispatch (kill)
+
+
+class TestKillRedispatch:
+    def _run_with_kill(self, params, spec, temps=None, kill_after=6):
+        """Clean + faulted fleet over identical submissions; the
+        faulted one loses replica 1 after ``kill_after`` warm steps.
+        Returns (clean_reqs, faulted_reqs, faulted_fleet)."""
+        outs = []
+        for faulted in (False, True):
+            clk = FakeClock()
+            fl = _fleet(params, clk, max_restarts=2)
+            reqs = [fl.submit(_prompt(10 + i, lp), n,
+                              temperature=(temps[i] if temps else 0.0),
+                              seed=17 + i)
+                    for i, (lp, n) in enumerate(spec)]
+            if faulted:
+                for _ in range(kill_after):
+                    fl.step()
+                    clk.t += 0.001
+                victims = list(fl.replicas[1].assigned)
+                assert victims, "kill must catch in-flight work"
+                assert any(len(r.generated) > 0 for r in victims), \
+                    "kill must catch a request mid-DECODE"
+                fl.arm_fault_plan("kill:replica=1,at=0s")
+            while not fl.idle:
+                fl.step()
+                clk.t += 0.001
+            outs.append((reqs, fl))
+        (clean_reqs, _), (faulted_reqs, fl) = outs
+        return clean_reqs, faulted_reqs, fl
+
+    def test_greedy_bit_identical_to_fault_free_run(self, params):
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7), (4, 9), (6, 5)]
+        clean, faulted, fl = self._run_with_kill(params, spec)
+        f = fl.stats()["fleet"]
+        assert f["incidents_by_class"] == {"crashed": 1}
+        assert f["redispatched"] >= 1
+        assert f["tokens_recomputed"] > 0
+        assert f["restarts_used"] == 1
+        inc = f["incidents"][0]
+        assert inc["category"] == "crashed" and inc["code"] == -9
+        for i, (rc, rf) in enumerate(zip(clean, faulted)):
+            assert rf.state == "finished", (i, rf.state)
+            # the at-most-once + bit-exactness acceptance pin
+            assert rf.output == rc.output, i
+            # and the clean run itself equals lm_decode
+            assert rc.output == _ref(params, _prompt(10 + i, spec[i][0]),
+                                     spec[i][1])
+        assert any(r.redispatches > 0 for r in faulted)
+        # redispatched requests carry NO page bookkeeping from the dead
+        # engine (its allocator died with it)
+        for r in faulted:
+            if r.redispatches:
+                assert r.pages == []
+
+    def test_sampled_requests_resume_exact_stream(self, params):
+        """temperature>0: the position-folded sampling keys make even
+        stochastic streams redispatch-exact (the fleet preserves
+        orig_prompt_len/output, so sample_index never drifts)."""
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7)]
+        temps = [0.0, 0.9, 0.7, 0.0]
+        clean, faulted, fl = self._run_with_kill(params, spec,
+                                                temps=temps)
+        assert fl.stats()["fleet"]["redispatched"] >= 1
+        for i, (rc, rf) in enumerate(zip(clean, faulted)):
+            assert rf.state == "finished"
+            assert rf.output == rc.output, i
+
+    def test_drain_routes_uncollected_terminal_requests(self, params):
+        """A request that reached a terminal state in the very step
+        that killed its replica (engine raised after finishing it,
+        before the end-of-tick collect) must land in the matching
+        FLEET list — never be dropped from stats."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        rep = fl.replicas[0]
+        fin = Request(prompt=np.arange(4, dtype=np.int32),
+                      max_new_tokens=2)
+        fin.state = "finished"
+        fin.output = [1, 2]
+        out = Request(prompt=np.arange(4, dtype=np.int32),
+                      max_new_tokens=2)
+        out.state = "timeout"
+        rep.assigned = [fin, out]
+        moved, _ = fl._drain(rep, clk())
+        assert moved == 0
+        assert fin in fl.finished and out in fl.timed_out
+        # and never double-appended on a second defensive pass
+        rep.assigned = [fin]
+        fl._drain(rep, clk())
+        assert sum(1 for r in fl.finished if r is fin) == 1
+
+    def test_engine_exception_is_a_classified_crash(self, params):
+        """A REAL exception escaping one replica's engine step (engine
+        bug, allocator error, OOM) is a replica incident — classified
+        ``crashed``, drained, relaunched — never a fleet-wide abort
+        (one replica is one failure domain)."""
+        clk = FakeClock()
+        fl = _fleet(params, clk, max_restarts=2)
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7)]
+        reqs = [fl.submit(_prompt(10 + i, lp), n)
+                for i, (lp, n) in enumerate(spec)]
+        refs = [_ref(params, _prompt(10 + i, lp), n)
+                for i, (lp, n) in enumerate(spec)]
+        for _ in range(4):
+            fl.step()
+            clk.t += 0.001
+        assert fl.replicas[1].assigned
+
+        def boom():
+            raise RuntimeError("device OOM")
+
+        fl.replicas[1].engine.step = boom
+        fl.step()           # must NOT raise
+        clk.t += 0.001
+        assert fl.replicas[1].state == "dead"
+        while not fl.idle:
+            fl.step()
+            clk.t += 0.001
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+        f = fl.stats()["fleet"]
+        assert f["incidents_by_class"] == {"crashed": 1}
+        assert f["incidents"][0]["code"] == 1
+
+    def test_killed_on_last_token_finishes_without_reemit(self, params):
+        """A request drained with nothing left to generate (its last
+        token was already emitted) must FINISH with exactly its emitted
+        stream — the at-most-once guarantee's edge case: never a
+        re-queue that would re-emit."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        req = Request(prompt=np.arange(5, dtype=np.int32),
+                      max_new_tokens=2)
+        req.generated = [3, 4]
+        req.output = [3, 4]
+        req.state = "decode"
+        rep = fl.replicas[0]
+        rep.assigned.append(req)
+        moved, recomputed = fl._drain(rep, clk())
+        assert moved == 0 and recomputed == 2
+        assert req.state == "finished"
+        assert req.output == [3, 4]
+        assert req in fl.finished
+        assert req not in fl.queue
+
+
+# ------------------------------------------------- stall -> watchdog
+
+
+class TestStallWatchdog:
+    def test_stall_watchdog_classified_relaunch(self, params):
+        """e2e on the REAL clock: a stalled replica stops heartbeating,
+        the PR-9 HealthWatchdog kills it, the incident classes
+        ``stalled`` (not a hang, not a generic crash), and the fleet
+        still finishes every request bit-exact."""
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7)]
+        refs = [_ref(params, _prompt(10 + i, lp), n)
+                for i, (lp, n) in enumerate(spec)]
+        fl = ServeFleet(params, _cfg(), FleetConfig(
+            replicas=2, max_restarts=2, backoff_base=0.01,
+            watchdog_timeout=0.4))
+        reqs = [fl.submit(_prompt(10 + i, lp), n)
+                for i, (lp, n) in enumerate(spec)]
+        for _ in range(5):
+            fl.step()
+        assert fl.replicas[0].assigned, "stall must strand work"
+        fl.arm_fault_plan("stall:replica=0,at=0s")
+        fl.run(max_steps=100000)
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+        f = fl.stats()["fleet"]
+        assert f["incidents_by_class"] == {"stalled": 1}
+        assert f["incidents"][0]["category"] == "stalled"
+        assert f["detect_s"] is not None and f["detect_s"] >= 0.4
+        assert f["restarts_used"] == 1
+
+    def test_bounded_stall_resumes_without_watchdog(self, params):
+        """A stall SHORTER than any watchdog: the replica simply
+        resumes — no incident, no relaunch, everything finishes."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        reqs = [fl.submit(_prompt(i, 5), 4) for i in range(4)]
+        for _ in range(3):
+            fl.step()
+            clk.t += 0.001
+        fl.arm_fault_plan("stall:replica=0,at=0s,secs=0.05")
+        while not fl.idle:
+            fl.step()
+            clk.t += 0.01
+        assert all(r.state == "finished" for r in reqs)
+        f = fl.stats()["fleet"]
+        assert f["incidents"] == [] and f["restarts_used"] == 0
+
+
+# ------------------------------------------------------- slow faults
+
+
+class TestSlowFault:
+    def test_slow_replica_sleeps_factor_minus_one(self, params):
+        """A slow:factor=F replica pays (F-1) x its measured step time
+        as extra latency — the degraded-host shape the router's
+        least-loaded policy steers around."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        fl.submit(_prompt(0, 5), 4)
+        fl.arm_fault_plan("slow:replica=0,at=0s,factor=3")
+        sleeps = []
+
+        def spy_sleep(dt):
+            sleeps.append(dt)
+            clk.sleep(dt)
+
+        fl._sleep = spy_sleep
+        rep0 = fl.replicas[0]
+        real_step = rep0.engine.step
+
+        def timed_step():
+            out = real_step()
+            clk.t += 0.004          # the engine step "took" 4 ms
+            return out
+
+        rep0.engine.step = timed_step
+        fl.step()
+        assert rep0.slow_factor == 3.0
+        assert sleeps and sleeps[-1] == pytest.approx(0.008)
+
+    def test_slow_factor_applied_and_reset_on_kill(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        fl.arm_fault_plan("slow:replica=1,at=0s,factor=2; "
+                          "kill:replica=1,at=0.5s")
+        fl.submit(_prompt(0, 5), 3)
+        fl.step()
+        assert fl.replicas[1].slow_factor == 2.0
+        clk.t += 1.0
+        fl.step()
+        assert fl.replicas[1].state in ("dead", "failed")
+        assert fl.replicas[1].slow_factor == 1.0
+
+
+# ----------------------------------------------------- load shedding
+
+
+class TestLoadShedding:
+    def test_truth_table_and_allocator_conservation(self, params):
+        clk = FakeClock()
+        cfg = _cfg(decode_slots=1)
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=1, max_queue=2,
+                                    max_restarts=0),
+                        clock=clk, sleep=clk.sleep)
+        p = _prompt(0, 5)
+        rs = [fl.submit(p, 4) for _ in range(8)]
+        # bounded queue: 2 queued, the rest shed as overloaded
+        assert [r.state for r in rs] == ["queued"] * 2 + ["rejected"] * 6
+        for r in rs[2:]:
+            assert r.reject_reason == "overloaded"
+            assert r.retry_after is not None and r.retry_after > 0
+        # infeasible: can never run on this geometry; no retry hint
+        big = fl.submit(_prompt(1, LMAX), 10)
+        assert big.state == "rejected"
+        assert big.reject_reason == "infeasible"
+        assert big.retry_after is None
+        # the conservation pin: rejected requests never touched any
+        # replica, so not one KV page is held anywhere
+        for rep in fl.replicas:
+            assert rep.engine.cache.allocator.in_use == 0
+        st = fl.stats()["fleet"]
+        assert st["shed"] == 6
+        assert st["rejected_by_reason"] == {"overloaded": 6,
+                                            "infeasible": 1}
+
+    def test_rejected_is_terminal_and_counted_in_stats(self, params):
+        clk = FakeClock()
+        fl = ServeFleet(params, _cfg(),
+                        FleetConfig(replicas=1, max_queue=1,
+                                    max_restarts=0),
+                        clock=clk, sleep=clk.sleep)
+        a = fl.submit(_prompt(0, 5), 3)
+        b = fl.submit(_prompt(1, 5), 3)
+        assert a.state == "queued" and b.state == "rejected"
+        st = fl.stats()
+        assert st["by_state"]["rejected"] == 1
+        assert st["by_state"]["queued"] == 1
+
+    def test_engine_max_queue_holds_at_router_not_terminal(self, params):
+        """Regression (review finding): with the ENGINE's own bounded
+        queue configured (a standalone-engine knob), the router must
+        hold backlog at the fleet head until the replica frees up —
+        not dispatch into a full engine queue and terminally shed; and
+        no reject may ever be double-counted between the engine's and
+        the fleet's lists."""
+        clk = FakeClock()
+        cfg = _cfg(decode_slots=2, max_queue=1)
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=1, max_restarts=0),
+                        clock=clk, sleep=clk.sleep)
+        rs = [fl.submit(_prompt(i, 5), 3) for i in range(4)]
+        fl.step()
+        # nothing terminally rejected: the engine queue bound only
+        # slows dispatch, it never sheds
+        assert fl.rejected == []
+        assert fl.replicas[0].engine.scheduler.rejected == []
+        st = fl.stats()
+        assert st["requests"] == 4, st["by_state"]
+        while not fl.idle:
+            fl.step()
+            clk.t += 0.001
+        assert all(r.state == "finished" for r in rs)
+        st = fl.stats()
+        assert st["requests"] == 4
+        assert st["by_state"] == {"finished": 4}
+        assert st["fleet"]["shed"] == 0
+
+    def test_fleet_queue_ttl_expires_waiting_requests(self, params):
+        """A request can blow its deadline WAITING at the router —
+        before any replica ever saw it; the fleet-level sweep times it
+        out (each engine sweeps its own in-service requests)."""
+        clk = FakeClock()
+        fl = ServeFleet(params, _cfg(decode_slots=1),
+                        FleetConfig(replicas=1, max_restarts=0),
+                        clock=clk, sleep=clk.sleep)
+        # saturate the only replica's in-flight headroom (limit =
+        # decode_slots + 1 = 2) with long generations...
+        busy = [fl.submit(_prompt(i, 5), 20) for i in range(2)]
+        fl.step()
+        clk.t += 0.001
+        # ...so the TTL'd request is stuck in the FLEET queue
+        req = fl.submit(_prompt(7, 5), 3, ttl=0.5)
+        fl.step()
+        assert req.state == "queued" and req in fl.queue
+        clk.t += 1.0
+        fl.step()
+        assert req.state == "timeout"
+        assert req in fl.timed_out and req not in fl.queue
+        assert fl.stats()["fleet"]["timeout"] == 1
+        assert all(r.state != "timeout" for r in busy)
+
+
+# ------------------------------------------- budget, backoff, degrade
+
+
+class TestRestartPolicy:
+    def test_exponential_backoff_schedule(self, params):
+        clk = FakeClock(t=100.0)
+        fl = _fleet(params, clk, replicas=1, max_restarts=3,
+                    backoff_base=0.2, backoff_cap=10.0)
+        rep = fl.replicas[0]
+        fl.arm_fault_plan("kill:replica=0,at=0s")
+        fl.step()
+        assert rep.state == "dead"
+        assert rep.relaunch_at == pytest.approx(clk.t + 0.2)
+        # not due yet: no relaunch
+        clk.t += 0.1
+        fl.step()
+        assert rep.state == "dead"
+        clk.t += 0.2
+        fl.step()
+        assert rep.state == "healthy" and rep.restarts == 1
+        # second kill backs off twice as long
+        fl.arm_fault_plan("kill:replica=0,at=0s")
+        fl.step()
+        assert rep.relaunch_at == pytest.approx(clk.t + 0.4)
+
+    def test_budget_exhaustion_fails_replica_and_sheds(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk, replicas=1, max_restarts=0,
+                    max_queue=0)
+        rs = [fl.submit(_prompt(i, 5), 3) for i in range(3)]
+        fl.step()                      # dispatch
+        clk.t += 0.001
+        fl.arm_fault_plan("kill:replica=0,at=0s")
+        fl.step()                      # kill + drain
+        clk.t += 1.0
+        fl.step()                      # relaunch due -> budget gone
+        rep = fl.replicas[0]
+        assert rep.state == "failed"
+        assert not fl.alive
+        # everything unfinished was shed (never silently stranded)
+        assert all(r.state in ("rejected", "finished") for r in rs)
+        shed = [r for r in rs if r.state == "rejected"]
+        assert shed and all(r.reject_reason == "overloaded"
+                            for r in shed)
+        # and a post-mortem submit sheds immediately, no hint
+        late = fl.submit(_prompt(9, 5), 3)
+        assert late.state == "rejected"
+        assert late.reject_reason == "overloaded"
+        assert late.retry_after is None
+        assert fl.idle   # terminated, not hung
+
+    def test_watchdog_kill_record_cleared_on_relaunch(self, params):
+        """The watchdog's per-replica kill memo must not mute watching
+        the NEXT incarnation (the supervisor resets per attempt; the
+        fleet clears per relaunch)."""
+        clk = FakeClock()
+        fl = _fleet(params, clk, replicas=2, max_restarts=2,
+                    watchdog_timeout=30.0)
+        assert fl.watchdog is not None
+        fl.watchdog.kills[1] = 5.0     # as if the watchdog killed it
+        fl._kill_replica(fl.replicas[1], code=-9, stalled=True,
+                         now=clk.t, detect_age=5.0)
+        clk.t += 1.0
+        fl.step()
+        assert fl.replicas[1].state == "healthy"
+        assert 1 not in fl.watchdog.kills

@@ -184,6 +184,26 @@ class TestEviction:
         assert eng.evicted and all(r.pages == [] for r in eng.evicted)
 
 
+class TestRejectReasons:
+    """Rejection carries its reason (the fleet router's load-shedding
+    vocabulary, stamped engine-level too): ``infeasible`` = can never
+    run on this geometry, ``overloaded`` = bounded queue full."""
+
+    def test_infeasible_vs_overloaded(self):
+        cfg = ServeConfig(page_size=8, num_pages=8, max_queue=1)
+        sched = Scheduler(_cache(cfg), cfg)
+        never = _req(lp=30, n=10)       # lp + n > Lmax = 32
+        assert not sched.submit(never)
+        assert never.state == "rejected"
+        assert never.reject_reason == "infeasible"
+        ok = _req()
+        assert sched.submit(ok) and ok.reject_reason is None
+        overflow = _req()
+        assert not sched.submit(overflow)
+        assert overflow.state == "rejected"
+        assert overflow.reject_reason == "overloaded"
+
+
 class TestMetrics:
     def test_percentile_nearest_rank(self):
         xs = [10.0, 20.0, 30.0, 40.0]

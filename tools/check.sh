@@ -29,6 +29,15 @@
 #                               TWICE, once per decode-attention path —
 #                               the gather reference and the fused paged
 #                               kernel in interpret mode)
+#                               + the fleet smoke (tools/serve_bench.py
+#                               --fleet 2: Poisson workload through a
+#                               2-replica fleet with replica 1 KILLED
+#                               mid-run — the survivor finishes the dead
+#                               replica's in-flight requests with greedy
+#                               output bit-identical to the fault-free
+#                               fleet run, the incident classifies as
+#                               "crashed", and the record stamps the
+#                               recovery metrics)
 #                               + the hierarchical smoke (a 2x2 virtual
 #                               hybrid ICI x DCN mesh on CPU: the
 #                               hybrid_mesh factory builds, the bucket
@@ -43,6 +52,7 @@
 #                               lanes traced at zero unsuppressed findings
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --no-serve   skip the serving smoke
+#   tools/check.sh --no-fleet   skip the fleet smoke
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -56,6 +66,7 @@ cd "$(dirname "$0")/.."
 SANITIZE=0
 ELASTIC=1
 SERVE=1
+FLEET=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -63,9 +74,10 @@ for arg in "$@"; do
     --sanitize) SANITIZE=1 ;;
     --no-elastic) ELASTIC=0 ;;
     --no-serve) SERVE=0 ;;
+    --no-fleet) FLEET=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -115,6 +127,38 @@ print("serve smoke [%s]: all 8 finished, TTFT p50/p99 = %s/%s ms, "
                               a["kv_fetch_frac"]))
 '
   done
+fi
+
+if [[ "$FLEET" == "1" ]]; then
+  echo "== fleet smoke (2 CPU replicas, kill:replica=1 mid-run: survivors finish everything, redispatch pin-exact) =="
+  FLEET_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 200 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --fleet 2 --fault-plan "kill:replica=1,at=50%" \
+    --pin-exact --require-finished)
+  echo "$FLEET_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "fleet_fault_ab", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+f = s["fleet"]
+assert f["incidents_by_class"] == {"crashed": 1}, f["incidents_by_class"]
+assert f["redispatched"] >= 1, f
+# the replica is never FAILED (budget 2): it either relaunched already
+# or the fleet drained inside its backoff window and it is still "dead"
+assert f["failed"] == 0, f
+ab = s["fleet_ab"]
+assert ab["redispatch_pin"]["identical"] is True
+assert ab["redispatch_pin"]["compared"] == 8, ab["redispatch_pin"]
+assert ab["faulted_over_clean_p99_ttft"] is not None
+print("fleet smoke: kill mid-run -> %d request(s) redispatched "
+      "(%d KV tokens recomputed), all 8 finished pin-exact, "
+      "faulted/clean p99 TTFT %s" % (
+          f["redispatched"], f["tokens_recomputed"],
+          ab["faulted_over_clean_p99_ttft"]))
+'
 fi
 
 if [[ "$HIER" == "1" ]]; then

@@ -21,6 +21,16 @@ than generic style:
   where the bucketed fusion lane (``grouped_allreduce``/
   ``fused_reduce``) should amortize it — one latency + dispatch per
   tensor, and invisible to the HOROVOD_OVERLAP bucket scheduler.
+* **HVD007** collectives or filesystem writes inside a registered
+  signal handler (the elastic signals.py flag-only discipline).
+* **HVD008** hardcoded mesh-axis string literal outside the
+  mesh/config layer (the LogicalMesh refactor's work list).
+* **HVD009** non-taxonomy exit code from a signal/atexit handler (the
+  supervisor's relaunch policy reads the exit code).
+* **HVD010** ``while True:`` relaunch/resubmit loop with no backoff
+  and no attempt counter — the crash-loop / retry-storm shape the
+  elastic supervisor's budget + backoff (and the serving fleet's
+  exponential backoff) exist to prevent.
 
 Run as ``python -m tools.hvdlint <paths...>``; suppress a finding with
 a ``# hvdlint: disable=HVDxxx`` comment on (or immediately above) the

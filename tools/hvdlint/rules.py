@@ -752,6 +752,123 @@ def check_hvd009(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD010
+
+#: Call-name substrings that mark a loop iteration as a retry of
+#: external work: relaunching a worker/replica, resubmitting a request,
+#: reconnecting a channel. (Substring match: `_launch`, `relaunch`,
+#: `launch_job`, `resubmit`, `reconnect`, ... all register.)
+RETRY_CALL_MARKERS = (
+    "launch", "relaunch", "restart", "resubmit", "submit", "retry",
+    "reconnect", "respawn",
+)
+
+#: Calls that implement a backoff between attempts.
+BACKOFF_CALL_NAMES = {"sleep", "backoff", "wait_backoff"}
+
+
+def _is_number(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool))
+
+
+def _loop_has_counter(body_nodes: List[ast.AST]) -> bool:
+    """An attempt counter: an additive augmented assignment by a
+    NUMERIC literal (``attempts += 1``) or an explicit counter rebind
+    (``n = n + 1``) inside the loop body. The literal requirement is
+    deliberate: ``buf += chunk`` / ``data += sock.recv(n)`` are
+    accumulators that bound nothing — a retry loop hiding behind one
+    must still fire."""
+    for n in body_nodes:
+        if isinstance(n, ast.AugAssign) and isinstance(n.op, ast.Add) \
+                and _is_number(n.value):
+            return True
+        if (isinstance(n, ast.Assign) and isinstance(n.value, ast.BinOp)
+                and isinstance(n.value.op, ast.Add)):
+            # Both counter spellings count the same: bare names and
+            # attribute targets (self.attempts = self.attempts + 1 —
+            # the AugAssign branch already accepts any target).
+            tgt_names = set()
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    tgt_names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    tgt_names.add(t.attr)
+            operand_names = {s.id for s in ast.walk(n.value)
+                             if isinstance(s, ast.Name)}
+            operand_names |= {s.attr for s in ast.walk(n.value)
+                              if isinstance(s, ast.Attribute)}
+            if (tgt_names & operand_names) and (
+                    _is_number(n.value.left)
+                    or _is_number(n.value.right)):
+                return True
+    return False
+
+
+def check_hvd010(tree: ast.AST) -> List[RawFinding]:
+    """Retry loop with no backoff and no budget: a ``while True:``
+    (or ``while 1:``) whose body re-launches/re-submits/re-connects
+    external work but contains neither a sleep/backoff call nor an
+    attempt counter.
+
+    A worker that crash-loops instantly re-crashes: an unbudgeted,
+    backoff-less relaunch loop turns one bad host into a busy-looping
+    supervisor and one overloaded service into a retry storm (the
+    thundering-herd failure mode). The supervised patterns in this repo
+    — the elastic supervisor's ``max_restarts`` budget with
+    ``restart_delay``, the serving fleet's fleet-wide budget with
+    exponential backoff — always bound attempts AND space them out.
+    Either signal silences the rule (a counted loop is assumed to be
+    compared against a budget somewhere; a sleeping loop at least
+    cannot spin); bounded ``for`` loops never fire.
+    """
+    findings: List[RawFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Constant) and test.value in (True, 1)):
+            continue
+        # The loop's OWN scope only: a nested def/lambda in the body
+        # neither retries per-iteration (its launch() call runs
+        # elsewhere) nor backs the loop off (its sleep() never runs
+        # here) — descending into it would mis-attribute both.
+        body: List[ast.AST] = []
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            n = stack.pop()
+            body.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        retry_calls = [
+            c for c in body
+            if isinstance(c, ast.Call)
+            and any(m in (trailing_name(c.func) or "").lower()
+                    for m in RETRY_CALL_MARKERS)
+        ]
+        if not retry_calls:
+            continue
+        has_backoff = any(
+            isinstance(c, ast.Call)
+            and trailing_name(c.func) in BACKOFF_CALL_NAMES
+            for c in body)
+        if has_backoff or _loop_has_counter(body):
+            continue
+        call = retry_calls[0]
+        findings.append(RawFinding(
+            call.lineno, call.col_offset, "HVD010", "warning",
+            f"'{trailing_name(call.func)}' retried in a 'while True:' "
+            "loop with no backoff call and no attempt counter: a "
+            "failing relaunch/resubmit spins at full speed forever "
+            "(crash loop / retry storm); bound the attempts against a "
+            "budget and back off between them (the elastic "
+            "supervisor's max_restarts + restart_delay discipline)"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -762,4 +879,5 @@ RULES = {
     "HVD007": check_hvd007,
     "HVD008": check_hvd008,
     "HVD009": check_hvd009,
+    "HVD010": check_hvd010,
 }

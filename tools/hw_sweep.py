@@ -111,6 +111,21 @@ LANES = [
     ("serve_paged_ab", ["tools/serve_bench.py", "--requests", "64",
                         "--rate", "8", "--new-min", "16",
                         "--new-max", "256", "--ab-attention"]),
+    # Fleet fault A/B (round-12 tentpole, horovod_tpu/serve/fleet.py):
+    # the SAME Poisson workload through a 2-replica fleet twice —
+    # clean, then with replica 1 killed at 40% of the arrival horizon —
+    # so one record carries the whole reliability story: the killed
+    # replica's in-flight requests drain to the survivor and finish
+    # BIT-IDENTICAL to the clean run (the bench aborts otherwise), the
+    # incident is classified (crashed, not a hang), and
+    # serve.fleet/serve.fleet_ab stamp redispatched count, KV tokens
+    # recomputed, and the faulted-over-clean p99 TTFT the relaunch +
+    # recompute cost shows up as.
+    ("serve_fleet_fault_ab", ["tools/serve_bench.py", "--requests", "64",
+                              "--rate", "8", "--new-min", "16",
+                              "--new-max", "256", "--fleet", "2",
+                              "--fault-plan", "kill:replica=1,at=40%",
+                              "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

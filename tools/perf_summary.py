@@ -185,6 +185,37 @@ def serve_cell(rec):
     return cell
 
 
+def fleet_cell(rec):
+    """Compact render of the record's fleet stamps (tools/serve_bench.py
+    --fleet; horovod_tpu/serve/fleet.py): "2r crashed1 rd3/10tok det
+    0.8s shed2 f/c 2.07" = 2 replicas, one crashed incident, 3 requests
+    redispatched (10 KV tokens recomputed), worst stale-heartbeat
+    time-to-detect, 2 requests shed, faulted-over-clean p99 TTFT from
+    the fault A/B. Non-fleet records render as em-dash."""
+    s = rec.get("serve")
+    if not isinstance(s, dict):
+        return "—"
+    f = s.get("fleet")
+    if not isinstance(f, dict):
+        return "—"
+    cell = f"{f.get('replicas', '?')}r"
+    classes = f.get("incidents_by_class") or {}
+    if classes:
+        cell += " " + ",".join(f"{k}{v}" for k, v in sorted(
+            classes.items()))
+    if f.get("redispatched"):
+        cell += (f" rd{f['redispatched']}/"
+                 f"{f.get('tokens_recomputed', '?')}tok")
+    if f.get("detect_s") is not None:
+        cell += f" det {f['detect_s']:g}s"
+    if f.get("shed"):
+        cell += f" shed{f['shed']}"
+    ab = s.get("fleet_ab") or {}
+    if ab.get("faulted_over_clean_p99_ttft") is not None:
+        cell += f" f/c {ab['faulted_over_clean_p99_ttft']:g}"
+    return cell
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
@@ -192,9 +223,9 @@ def main():
     args = ap.parse_args()
     ok, err = load(args.today)
     print("| lane | value | unit | window | overlap | wire | collectives "
-          "| flash grid | snapshot | elastic | serve | peak | probe TF "
-          "| stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "| flash grid | snapshot | elastic | serve | fleet | peak "
+          "| probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -211,6 +242,7 @@ def main():
               f"| {snapshot_cell(rec)} "
               f"| {elastic_cell(rec)} "
               f"| {serve_cell(rec)} "
+              f"| {fleet_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")

@@ -33,11 +33,27 @@ Modes:
               ``paged_over_gather`` throughput ratio (the
               gather-vs-paged A/B as one record; exclusive with
               --ab/--static)
+  --fleet N   drive a fault-tolerant N-replica fleet
+              (horovod_tpu/serve/fleet.py: least-loaded router,
+              classified replica incidents, drain/redispatch, load
+              shedding) instead of one engine. With ``--fault-plan``
+              (the serving dialect of the elastic fault grammar, e.g.
+              ``"kill:replica=1,at=40%"`` — percent resolves against
+              the last workload arrival) the bench runs the CLEAN
+              fleet first, then the FAULTED fleet on the IDENTICAL
+              workload, asserts every request finished on both sides
+              emitted the bit-identical greedy stream (the
+              drain/redispatch exactness pin), and stamps recovery
+              metrics (incidents by class, time-to-detect,
+              redispatched count, KV tokens recomputed, faulted-vs-
+              clean p99 TTFT) in ``serve.fleet`` / ``serve.fleet_ab``.
+              Exclusive with --ab/--static/--ab-attention.
 
 ``--pin-exact`` re-decodes every finished request through
 ``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
 tokens — the engine/decode-lane exactness gate CI runs on a tiny model
-(tools/check.sh serve smoke lane).
+(tools/check.sh serve smoke lane; the fleet smoke adds a mid-run
+replica kill).
 """
 
 import argparse
@@ -135,6 +151,65 @@ def run_static(params, cfg, workload, warm=True):
     return eng
 
 
+def run_fleet(params, cfg, fleet_cfg, workload, fault_plan="", warm=True):
+    """Open-loop Poisson load over a :class:`ServeFleet`; returns the
+    drained fleet plus its requests in arrival order (the stable index
+    the clean-vs-faulted redispatch pin compares by). ``fault_plan``
+    (serving dialect) is armed AFTER warmup so fire offsets are
+    measured from the first measured step; percent ``at=`` forms
+    resolve against the last workload arrival."""
+    from horovod_tpu.serve import ServeFleet
+
+    fl = ServeFleet(params, cfg, fleet_cfg)
+    if warm:
+        # One dummy per replica: the least-loaded router spreads them,
+        # so every replica compiles+warms its step programs before the
+        # measured window (a relaunch mid-measurement still pays its
+        # own honest recompile).
+        for _ in range(fleet_cfg.replicas):
+            fl.submit(workload[0][1][:2], 2)
+        fl.run()
+        fl.reset_metrics()
+    if fault_plan:
+        fl.arm_fault_plan(fault_plan,
+                          horizon=max(w[0] for w in workload))
+    pending = sorted(workload, key=lambda w: w[0])
+    reqs = []
+    t0 = fl.clock()
+    fl._t_start = t0
+    while pending or not fl.idle:
+        while pending and pending[0][0] <= fl.clock() - t0:
+            arrival, prompt, n = pending.pop(0)
+            reqs.append(fl.submit(prompt, n, arrival=t0 + arrival))
+        if not fl.step():
+            if pending:
+                time.sleep(min(0.001, max(0.0, pending[0][0]
+                                          - (fl.clock() - t0))))
+            elif not fl.idle:
+                time.sleep(0.001)   # stall/backoff: let wall time pass
+    return fl, reqs
+
+
+def pin_redispatch_exact(clean_reqs, faulted_reqs):
+    """The drain/redispatch acceptance pin: every request finished on
+    BOTH the clean and the faulted fleet (same workload index) must
+    have emitted the bit-identical greedy token stream — tokens
+    generated before the kill were never re-emitted nor diverged from.
+    Returns how many pairs were compared."""
+    compared = 0
+    for i, (rc, rf) in enumerate(zip(clean_reqs, faulted_reqs)):
+        if rc.temperature > 0:
+            continue
+        if rc.state != "finished" or rf.state != "finished":
+            continue
+        if rc.output != rf.output:
+            raise SystemExit(
+                f"REDISPATCH PIN FAILED: request #{i} clean={rc.output} "
+                f"faulted={rf.output}")
+        compared += 1
+    return compared
+
+
 def pin_exact(params, eng):
     """Every finished greedy request must match its own lm_decode."""
     import jax.numpy as jnp
@@ -194,6 +269,24 @@ def main() -> int:
     ap.add_argument("--ab", action="store_true",
                     help="continuous AND static on the same workload; "
                          "stamp both + the ratio")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="run a fault-tolerant N-replica fleet behind "
+                         "the least-loaded router (0 = single engine)")
+    ap.add_argument("--fault-plan", default="",
+                    help="serving fault plan for the fleet (e.g. "
+                         "'kill:replica=1,at=40%%'); runs clean THEN "
+                         "faulted on the identical workload and pins "
+                         "redispatched greedy output bit-identical")
+    ap.add_argument("--fleet-max-restarts", type=int, default=2,
+                    help="fleet-wide replica relaunch budget")
+    ap.add_argument("--fleet-watchdog-timeout", type=float, default=0.0,
+                    help="stale-heartbeat watchdog timeout in seconds "
+                         "(0 = off; required > 0 for stall: plans)")
+    ap.add_argument("--fleet-max-queue", type=int, default=0,
+                    help="router admission-queue bound (load shedding; "
+                         "0 = unbounded)")
+    ap.add_argument("--fleet-backoff", type=float, default=0.05,
+                    help="relaunch backoff base (doubles per attempt)")
     ap.add_argument("--pin-exact", action="store_true",
                     help="assert greedy engine output == lm_decode "
                          "for every finished request")
@@ -210,6 +303,31 @@ def main() -> int:
     if args.ab_attention and (args.ab or args.static):
         ap.error("--ab-attention is exclusive with --ab/--static (one "
                  "A/B per record)")
+    if args.fleet < 0:
+        ap.error("--fleet must be >= 0 (0 = single engine)")
+    if args.fleet and (args.ab or args.static or args.ab_attention):
+        ap.error("--fleet is exclusive with --ab/--static/"
+                 "--ab-attention (one A/B per record)")
+    if args.fault_plan and not args.fleet:
+        ap.error("--fault-plan requires --fleet N (faults address "
+                 "fleet replicas)")
+    if args.fault_plan:
+        from horovod_tpu.elastic.faults import (FaultPlanError,
+                                                parse_serve_fault_plan)
+
+        try:
+            plan_actions = parse_serve_fault_plan(args.fault_plan)
+        except FaultPlanError as e:
+            ap.error(str(e))
+        for a in plan_actions:
+            if a.replica >= args.fleet:
+                ap.error(f"fault action {a}: replica {a.replica} is "
+                         f"outside --fleet {args.fleet}")
+        if any(a.kind == "stall" for a in plan_actions) and \
+                args.fleet_watchdog_timeout <= 0:
+            ap.error("stall: fault plans need --fleet-watchdog-timeout "
+                     "> 0 — an unwatched stall hangs the lane forever "
+                     "(which is the bug the watchdog exists to class)")
 
     from horovod_tpu.serve import ServeConfig
 
@@ -249,7 +367,70 @@ def main() -> int:
         return stats
 
     serve: dict
-    if args.ab_attention:
+    if args.fleet:
+        from horovod_tpu.serve import FleetConfig
+
+        fleet_cfg = FleetConfig(
+            replicas=args.fleet, max_queue=args.fleet_max_queue,
+            max_restarts=args.fleet_max_restarts,
+            backoff_base=args.fleet_backoff,
+            watchdog_timeout=args.fleet_watchdog_timeout)
+
+        def fleet_lane(tag, fault_plan=""):
+            fl, reqs = run_fleet(params, cfg, fleet_cfg, workload,
+                                 fault_plan)
+            try:
+                stats = fl.stats()
+                f = stats["fleet"]
+                print(f"[serve_bench] {tag}: "
+                      f"{stats['tokens_per_sec_per_chip']} tok/s/chip, "
+                      f"ttft p50/p99 {stats['ttft_ms']['p50']}/"
+                      f"{stats['ttft_ms']['p99']} ms, "
+                      f"{stats['by_state']}, "
+                      f"incidents {f['incidents_by_class']}, "
+                      f"redispatched {f['redispatched']} "
+                      f"({f['tokens_recomputed']} KV tokens recomputed), "
+                      f"shed {f['shed']}", file=sys.stderr, flush=True)
+                if args.pin_exact:
+                    pin_exact(params, fl)
+                if args.require_finished:
+                    finished = stats["by_state"].get("finished", 0)
+                    rejected = stats["by_state"].get("rejected", 0)
+                    if finished + rejected != args.requests \
+                            or not finished:
+                        raise SystemExit(
+                            f"not every non-rejected request finished: "
+                            f"{stats['by_state']}")
+            finally:
+                fl.close()   # one namespaced heartbeat dir per fleet
+            return stats, reqs
+
+        clean, clean_reqs = fleet_lane(f"fleet x{args.fleet} clean")
+        if args.fault_plan:
+            faulted, faulted_reqs = fleet_lane(
+                f"fleet x{args.fleet} faulted [{args.fault_plan}]",
+                args.fault_plan)
+            compared = pin_redispatch_exact(clean_reqs, faulted_reqs)
+            print(f"[serve_bench] redispatch pin: {compared} greedy "
+                  "streams bit-identical clean vs faulted",
+                  file=sys.stderr, flush=True)
+            c99 = (clean.get("ttft_ms") or {}).get("p99")
+            f99 = (faulted.get("ttft_ms") or {}).get("p99")
+            ratio = round(f99 / c99, 3) if c99 and f99 else None
+            mode, headline = "fleet_fault_ab", faulted
+            serve = dict(faulted, mode=mode, fleet_ab={
+                "clean": clean,
+                "fault_plan": args.fault_plan,
+                "redispatch_pin": {"compared": compared,
+                                   "identical": True},
+                "p99_ttft_clean_ms": c99,
+                "p99_ttft_faulted_ms": f99,
+                "faulted_over_clean_p99_ttft": ratio,
+            })
+        else:
+            mode = "fleet"
+            headline = serve = dict(clean, mode="fleet")
+    elif args.ab_attention:
         import dataclasses
 
         gat = lane(run_continuous, "attention=gather",
@@ -301,6 +482,14 @@ def main() -> int:
                           else args.attention),
             "rate": args.rate,
             "requests": args.requests,
+            "fleet": ({
+                "replicas": args.fleet,
+                "max_restarts": args.fleet_max_restarts,
+                "watchdog_timeout": args.fleet_watchdog_timeout,
+                "max_queue": args.fleet_max_queue,
+                "backoff_base": args.fleet_backoff,
+                "fault_plan": args.fault_plan or None,
+            } if args.fleet else None),
         },
     }), flush=True)
     return 0
