@@ -241,6 +241,58 @@ def _kill_all(procs: List[subprocess.Popen]) -> None:
                       file=sys.stderr, flush=True)
 
 
+def spawn_worker(cmd: Sequence[str],
+                 env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    """Spawn ONE supervised worker process — the single-process lane of
+    :func:`launch_job`'s placement discipline (its own session/process
+    group, so one ``killpg`` reaps the worker's whole tree). The caller
+    owns supervision and classification; the serving fleet
+    (:mod:`horovod_tpu.serve.fleet`, ``transport="process"``) pairs
+    this with :class:`~horovod_tpu.run.driver.WorkerExit` /
+    :func:`~horovod_tpu.run.driver.classify_exit` so replica and
+    training incidents speak one taxonomy."""
+    return _spawn_local(cmd, dict(env if env is not None
+                                  else os.environ))
+
+
+def kill_worker(proc: subprocess.Popen,
+                timeout: float = 5.0) -> Optional[int]:
+    """SIGKILL one worker's process group and reap it (bounded — a
+    D-state wait must not hang the caller; see :func:`_kill_all`).
+    Returns the observed exit code, or None when the process could not
+    be reaped within ``timeout``."""
+    if proc.poll() is None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+    return proc.returncode
+
+
+def terminate_worker(proc: subprocess.Popen,
+                     term_timeout: float = 2.0,
+                     kill_timeout: float = 5.0) -> Optional[int]:
+    """Graceful-teardown escalation for one worker: SIGTERM the process
+    group, wait ``term_timeout``, SIGKILL stragglers, reap — the
+    :func:`_kill_all` ladder, single-process edition (the fleet's
+    ``close()`` uses it after the shutdown RPC so a wedged replica can
+    never zombie). Returns the exit code, or None if unreapable."""
+    if proc.poll() is None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(term_timeout)
+        except subprocess.TimeoutExpired:
+            return kill_worker(proc, kill_timeout)
+    return proc.returncode
+
+
 def launch_command(cmd: Sequence[str], np: int,
                    hosts: Optional[str] = None,
                    env: Optional[Dict[str, str]] = None,
@@ -440,4 +492,5 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
 
 __all__ = ["run", "launch_command", "launch_job", "JobResult",
            "WorkerExit", "classify_exit", "LaunchError",
+           "spawn_worker", "kill_worker", "terminate_worker",
            "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_RESIZED", "EXIT_USAGE"]

@@ -21,6 +21,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import cloudpickle
@@ -79,13 +80,20 @@ class Wire:
         digest = hmac.new(self._key, payload, hashlib.sha256).digest()
         sock.sendall(struct.pack("<Q", len(payload)) + digest + payload)
 
-    def read(self, sock: socket.socket) -> Any:
-        header = self._read_exact(sock, 8 + DIGEST_LEN)
+    def read(self, sock: socket.socket,
+             timeout: Optional[float] = None) -> Any:
+        """Read one authenticated frame. ``timeout`` bounds time
+        WITHOUT PROGRESS — a peer that stops mid-frame raises
+        ``socket.timeout`` instead of hanging the reader forever (the
+        HVD011 shape), while a large frame (MAX_FRAME is 1 GiB —
+        cloudpickled functions and results ride this wire) that keeps
+        trickling within the budget still completes."""
+        header = self._read_exact(sock, 8 + DIGEST_LEN, timeout)
         (length,) = struct.unpack("<Q", header[:8])
         if length > MAX_FRAME:
             raise IntegrityError("oversized frame")
         digest = header[8:]
-        payload = self._read_exact(sock, length)
+        payload = self._read_exact(sock, length, timeout)
         expected = hmac.new(self._key, payload, hashlib.sha256).digest()
         if not hmac.compare_digest(digest, expected):
             # Never unpickle unauthenticated bytes (reference
@@ -94,13 +102,28 @@ class Wire:
         return cloudpickle.loads(payload)
 
     @staticmethod
-    def _read_exact(sock: socket.socket, n: int) -> bytes:
+    def _read_exact(sock: socket.socket, n: int,
+                    timeout: Optional[float] = None) -> bytes:
+        """``timeout`` is a no-progress bound: the deadline re-arms on
+        every received chunk, so only a STALLED peer trips it — never
+        a slow link moving a legitimately large frame."""
         buf = b""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
         while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout(
+                        f"no progress for {timeout:g}s after "
+                        f"{len(buf)}/{n} frame bytes")
+                sock.settimeout(remaining)
             chunk = sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("peer closed connection")
             buf += chunk
+            if deadline is not None:
+                deadline = time.monotonic() + timeout
         return buf
 
 
@@ -118,8 +141,11 @@ class BasicService:
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 try:
-                    req = outer._wire.read(self.request)
-                except (IntegrityError, ConnectionError):
+                    # Bounded: a half-open client that never finishes
+                    # its frame must release this handler thread, not
+                    # hold it forever.
+                    req = outer._wire.read(self.request, timeout=60.0)
+                except (IntegrityError, ConnectionError, socket.timeout):
                     return  # drop unauthenticated/broken connections
                 try:
                     resp = outer._handler(req)
@@ -171,7 +197,10 @@ class BasicClient:
         with socket.create_connection(self._addr,
                                       timeout=self._timeout) as sock:
             self._wire.write(sock, obj)
-            resp = self._wire.read(sock)
+            # The connection timeout bounds each recv(); the explicit
+            # frame timeout bounds the WHOLE reply (a trickling peer
+            # resets per-recv timeouts forever otherwise).
+            resp = self._wire.read(sock, timeout=self._timeout)
         if isinstance(resp, RemoteError):
             raise RuntimeError(f"remote error: {resp.message}")
         return resp

@@ -28,22 +28,39 @@ steps without ever recompiling.
   heartbeat watchdog + exit taxonomy), drain/redispatch of a dead
   replica's in-flight requests (at-most-once, greedy bit-identical),
   budgeted exponential-backoff relaunches, and bounded-queue load
-  shedding ("rejected: overloaded" + retry-after).
+  shedding ("rejected: overloaded" + retry-after);
+* :mod:`~horovod_tpu.serve.transport` +
+  :mod:`~horovod_tpu.serve.worker` — the cross-process fleet lane
+  (``FleetConfig.transport="process"``): each replica its own worker
+  process behind a length-prefixed, checksummed, deadline-checked
+  frame protocol over a Unix socket — real crash isolation, with
+  every transport failure converted into the fleet's replica-death
+  path (typed :class:`~horovod_tpu.serve.transport.TransportError`
+  taxonomy, never an RPC-level retry).
 
 Architecture, page math, and the SLO tuning runbook: docs/serving.md.
 """
 
 from horovod_tpu.serve.config import FleetConfig, ServeConfig
 from horovod_tpu.serve.engine import ServeEngine
-from horovod_tpu.serve.fleet import Replica, ServeFleet
+from horovod_tpu.serve.fleet import ProcessReplica, Replica, ServeFleet
 from horovod_tpu.serve.kvcache import OutOfPages, PageAllocator, PagedKVCache
 from horovod_tpu.serve.scheduler import Request, RequestState, Scheduler
+from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
+                                         DeadlineExceeded, FrameError,
+                                         RemoteCallError, TransportError)
 
 __all__ = [
+    "ChecksumError",
+    "ConnectionLost",
+    "DeadlineExceeded",
     "FleetConfig",
+    "FrameError",
     "OutOfPages",
     "PageAllocator",
     "PagedKVCache",
+    "ProcessReplica",
+    "RemoteCallError",
     "Replica",
     "Request",
     "RequestState",
@@ -51,4 +68,5 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "ServeFleet",
+    "TransportError",
 ]

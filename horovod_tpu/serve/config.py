@@ -28,6 +28,13 @@ ADMISSIONS = ("reserve", "lazy")
 #: reference); ``paged`` streams only each slot's live pages through
 #: the fused Pallas kernel (:mod:`horovod_tpu.ops.paged_attention`).
 ATTENTIONS = ("gather", "paged")
+#: Fleet replica placements: ``inproc`` runs every engine in the
+#: router's process (the CI fast lane, zero transport overhead, NO
+#: crash isolation); ``process`` runs each replica as its own worker
+#: process (:mod:`horovod_tpu.serve.worker`) behind the deadline-
+#: checked framed RPC transport (:mod:`horovod_tpu.serve.transport`)
+#: — a replica crash is one SIGKILLed OS process, never the router.
+TRANSPORTS = ("inproc", "process")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +165,22 @@ class FleetConfig:
 
     ``retry_after_min`` floors the overload hint so clients never get
     told to hammer back immediately.
+
+    ``transport`` places the replicas: ``inproc`` (default — the fast,
+    CI-exercisable lane) keeps every engine in the router's process;
+    ``process`` spawns each replica as its own
+    ``python -m horovod_tpu.serve.worker`` OS process behind the
+    framed Unix-socket RPC transport, so a replica crash (a REAL
+    ``SIGKILL``, an OOM, a segfault) takes down exactly one worker.
+    Every RPC then carries ``rpc_deadline`` seconds of budget — size
+    it ABOVE the worker's one-off costs inside a call (the first
+    ``step`` poll after a (re)spawn waits out the engine build + jax
+    import behind the worker's lock) — and any transport failure is
+    converted into the replica-death path, never retried.
+    ``spawn_timeout`` bounds how long a (re)spawned worker may take to
+    start listening; ``shutdown_deadline`` is :meth:`ServeFleet.close
+    <horovod_tpu.serve.fleet.ServeFleet.close>`'s budget for the
+    graceful ``shutdown`` RPC before it escalates SIGTERM → SIGKILL.
     """
 
     replicas: int = 2
@@ -168,6 +191,10 @@ class FleetConfig:
     watchdog_timeout: float = 0.0  # 0 = watchdog disabled
     heartbeat_dir: Optional[str] = None   # base dir; namespaced per fleet
     retry_after_min: float = 0.05
+    transport: str = "inproc"
+    rpc_deadline: float = 60.0     # per-RPC budget (process transport)
+    spawn_timeout: float = 120.0   # worker must listen within this
+    shutdown_deadline: float = 2.0  # graceful-shutdown RPC budget
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -192,3 +219,18 @@ class FleetConfig:
             raise ValueError(
                 f"retry_after_min must be > 0, got "
                 f"{self.retry_after_min}")
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"transport {self.transport!r} not in {TRANSPORTS}")
+        if self.rpc_deadline <= 0:
+            raise ValueError(
+                f"rpc_deadline must be > 0 seconds (every RPC is "
+                f"deadline-checked), got {self.rpc_deadline}")
+        if self.spawn_timeout <= 0:
+            raise ValueError(
+                f"spawn_timeout must be > 0 seconds, got "
+                f"{self.spawn_timeout}")
+        if self.shutdown_deadline <= 0:
+            raise ValueError(
+                f"shutdown_deadline must be > 0 seconds, got "
+                f"{self.shutdown_deadline}")

@@ -36,17 +36,35 @@ failure mode is first-class:
   touch a replica, so they can never allocate KV pages (allocator
   conservation is pinned in tests).
 
-Replicas here are in-process engines with a process-shaped lifecycle
-(real heartbeat files, the real watchdog, the real exit taxonomy with
-synthetic ``-SIGKILL`` codes): that keeps the whole recovery story —
-including the bit-exact redispatch pin — CI-exercisable on CPU in
-seconds, with deterministic fault injection
-(:func:`~horovod_tpu.elastic.faults.parse_serve_fault_plan`) and an
-injectable clock. What stays honest about the real multi-process fleet:
-the router's drain uses only router-side bookkeeping (dispatched
-requests + streamed tokens), never the dead engine's internals, and a
-crash loses the replica's engine state wholesale. docs/serving.md "The
-fleet" covers the runbook.
+Replicas come in two placements (``FleetConfig.transport``):
+
+* ``inproc`` (default): engines in the router's process with a
+  process-shaped lifecycle (real heartbeat files, the real watchdog,
+  the real exit taxonomy with synthetic ``-SIGKILL`` codes) — the CI
+  fast lane: the whole recovery story, including the bit-exact
+  redispatch pin, exercisable on CPU in seconds with deterministic
+  fault injection and an injectable clock;
+* ``process``: each replica is its own ``python -m
+  horovod_tpu.serve.worker`` OS process (spawned/reaped through the
+  PR-9 :mod:`horovod_tpu.run` machinery) behind the deadline-checked
+  framed RPC transport (:mod:`~horovod_tpu.serve.transport`) — REAL
+  crash isolation. ``kill:`` faults become genuine
+  ``os.kill(pid, SIGKILL)``; a ``stall:`` fault genuinely wedges the
+  worker's engine thread so only the stale heartbeat (the worker
+  stamps its own file per served tick) and the
+  :class:`~horovod_tpu.elastic.supervisor.HealthWatchdog` catch it;
+  and ANY transport failure — connection refused, a frame torn by a
+  mid-write death, a checksum mismatch, a deadline expiry — is
+  converted into this same replica-death path, never retried at the
+  RPC layer (a blind resend could double-apply a submit and break
+  at-most-once).
+
+Either way the router's drain uses only router-side bookkeeping
+(dispatched requests + streamed tokens), never the dead engine's
+internals, and a crash loses the replica's engine state wholesale — in
+process mode that sentence is literally true of a SIGKILLed address
+space. docs/serving.md "The fleet" / "Process fleet" cover the
+runbook.
 """
 
 from __future__ import annotations
@@ -55,7 +73,7 @@ import os
 import signal as _signal
 import sys
 import time
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from horovod_tpu.elastic.faults import (FaultPlanError, ServeFaultAction,
                                         parse_serve_fault_plan)
@@ -68,6 +86,7 @@ from horovod_tpu.serve.router import (pick_replica, replica_load,
                                       retry_after_hint)
 from horovod_tpu.serve.scheduler import (Request, RequestState,
                                          rebase_for_recompute)
+from horovod_tpu.serve.transport import RpcClient, TransportError
 
 
 def _log(msg: str) -> None:
@@ -86,9 +105,17 @@ class Replica:
     gone).
     """
 
-    def __init__(self, rid: int, engine: ServeEngine, heartbeat: Heartbeat):
+    #: Which FleetConfig.transport shape this replica is.
+    transport = "inproc"
+    #: In-process replicas are heartbeat-stamped by the FLEET at the
+    #: end of each tick; process workers stamp their own file per
+    #: served tick (the fleet must never stamp for them — a wedged
+    #: worker would look alive forever).
+    stamps_own_heartbeat = False
+
+    def __init__(self, rid: int, engine, heartbeat: Heartbeat):
         self.id = rid
-        self.engine: Optional[ServeEngine] = engine
+        self.engine = engine
         self.heartbeat = heartbeat
         self.state = "healthy"
         self.assigned: List[Request] = []
@@ -102,6 +129,268 @@ class Replica:
     @property
     def healthy(self) -> bool:
         return self.state == "healthy"
+
+    def ensure_dead(self, code_hint: int) -> int:
+        """Make the replica's failure domain actually dead and return
+        the best-evidence exit code. In-process replicas have no OS
+        process — the synthetic hint IS the evidence; process replicas
+        SIGKILL + reap and return the real code."""
+        return code_hint
+
+    def shutdown(self, deadline: float) -> None:
+        """Graceful teardown hook for :meth:`ServeFleet.close` (base:
+        nothing to tear down — the engine dies with the router)."""
+
+    def adopt(self, fresh: "Replica") -> None:
+        """Take over a freshly-spawned incarnation's live half (the
+        relaunch path mutates the existing Replica object in place so
+        router bookkeeping and per-id metrics keep their identity)."""
+        self.engine = fresh.engine
+        self.heartbeat = fresh.heartbeat
+
+
+class ProcessReplica(Replica):
+    """One replica as its own OS process behind the RPC transport.
+
+    ``engine`` is an :class:`_EngineProxy` exposing the exact attribute
+    surface the router and fleet read on a live in-process engine
+    (free slots, occupancy, queue length, submit, step, the terminal
+    lists) — every PR-12 code path runs unchanged; only the transport
+    underneath differs. ``proc`` is the worker's ``Popen`` (its own
+    process group via :func:`horovod_tpu.run.spawn_worker`)."""
+
+    transport = "process"
+    stamps_own_heartbeat = True
+
+    def __init__(self, rid: int, engine: "_EngineProxy",
+                 heartbeat: Heartbeat, proc, client: RpcClient,
+                 sock_path: str):
+        super().__init__(rid, engine, heartbeat)
+        self.proc = proc
+        self.client = client
+        self.sock_path = sock_path
+
+    def _cleanup_ipc(self) -> None:
+        if self.client is not None:
+            self.client.close()
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+    def ensure_dead(self, code_hint: int) -> int:
+        """Genuine ``SIGKILL`` of the worker's process group + reap (no
+        zombies), returning the REAL exit code when reapable: a worker
+        that already died of its own fault (the ``kill:`` injection, an
+        OOM) reports that code; one we killed reports ``-SIGKILL``."""
+        from horovod_tpu.run import kill_worker
+
+        code = kill_worker(self.proc)
+        self._cleanup_ipc()
+        return code if code is not None else code_hint
+
+    def shutdown(self, deadline: float) -> None:
+        """close()'s graceful path: ``shutdown`` RPC under a short
+        deadline, then SIGTERM → SIGKILL escalation, then reap — a
+        stalled (wedged engine thread) worker still answers the RPC on
+        its control thread, and one whose RPC thread is gone too falls
+        through to the signals. Either way the process is REAPED."""
+        from horovod_tpu.run import terminate_worker
+
+        if self.proc.poll() is None and self.client is not None:
+            acked = True
+            try:
+                self.client.call("shutdown", timeout=deadline)
+            except TransportError:
+                acked = False   # already burned the deadline: escalate
+            if acked:
+                try:
+                    self.proc.wait(deadline)
+                except Exception:   # TimeoutExpired: escalate below
+                    pass
+        terminate_worker(self.proc)
+        self._cleanup_ipc()
+
+    def adopt(self, fresh: "Replica") -> None:
+        super().adopt(fresh)
+        self.proc = fresh.proc
+        self.client = fresh.client
+        self.sock_path = fresh.sock_path
+
+
+class _SizedQueueView:
+    """``len()``-only stand-in for a remote engine's queue (the router
+    checks ``len(eng.scheduler.queue)`` for the engine-side bound)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def __len__(self) -> int:
+        return self.n
+
+
+class _ProxyCache:
+    def __init__(self, fits_fn: Callable[[int, int], bool]):
+        self._fits = fits_fn
+        self._occ = 0.0
+
+    def occupancy(self) -> float:
+        return self._occ
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        return self._fits(prompt_len, max_new_tokens)
+
+
+class _ProxyScheduler:
+    def __init__(self, proxy: "_EngineProxy"):
+        self._proxy = proxy
+        self.queue = _SizedQueueView()
+        self.rejected: List[Request] = []
+
+    def submit(self, req: Request) -> bool:
+        return self._proxy.submit(req)
+
+
+class _EngineProxy:
+    """Router-side mirror of one worker's engine.
+
+    State the router reads between polls (free slots, occupancy, queue
+    length) is the last ``step`` RPC's snapshot; dispatch-limit
+    correctness never depends on it (the in-flight cap is checked
+    against ``Replica.assigned``, which is router-owned). Token
+    streams are mirrored via ``collect``: the router asks for
+    everything past what it has already applied per request
+    (``since``), so the mirror — which is what drain/redispatch and
+    the at-most-once guarantee read — is exactly the set of tokens the
+    router has observed. Latency stamps use the ROUTER's clock at
+    collect time: what a streaming client at the router actually
+    perceives (worker-side clock stamps never cross the wire, so no
+    skew to reconcile).
+
+    Any :class:`TransportError` out of these methods means the replica
+    must die; the fleet converts it (``_transport_death``) — the proxy
+    itself never retries or masks.
+    """
+
+    def __init__(self, client: RpcClient, config: ServeConfig,
+                 fits_fn: Callable[[int, int], bool], clock):
+        self.client = client
+        self.config = config
+        self.clock = clock
+        self.cache = _ProxyCache(fits_fn)
+        self.scheduler = _ProxyScheduler(self)
+        self.finished: List[Request] = []
+        self.timed_out: List[Request] = []
+        self.evicted: List[Request] = []
+        self._free = config.decode_slots
+        self._in_flight = 0
+        self._last_ticks = 0
+        #: rid -> worker-output tokens already applied to the mirror.
+        self._streamed: Dict[int, int] = {}
+        self._by_rid: Dict[int, Request] = {}
+
+    def _free_slots(self) -> int:
+        return self._free
+
+    def submit(self, req: Request) -> bool:
+        now = self.clock()
+        r = self.client.call("submit", {
+            "rid": req.rid,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "eos_token": req.eos_token,
+            "seed": int(req.seed),
+            "age": max(0.0, now - req.arrival),
+            "ttl": req.ttl,
+        })
+        if r.get("accepted"):
+            self._streamed[req.rid] = 0
+            self._by_rid[req.rid] = req
+            req.state = RequestState.QUEUED
+            if req.t_admit is None:
+                req.t_admit = now
+            # Keep the snapshot honest WITHIN a tick: an accepted
+            # submit sits in the worker's queue until picked, so a
+            # second dispatch this tick must see the occupancy (an
+            # engine-side max_queue would otherwise terminally reject
+            # a request the router's contract says should WAIT at the
+            # fleet head). The next step RPC overwrites with truth.
+            self.scheduler.queue.n += 1
+            return True
+        req.state = RequestState.REJECTED
+        req.reject_reason = r.get("reject_reason")
+        req.retry_after = r.get("retry_after")
+        self.scheduler.rejected.append(req)
+        return False
+
+    def step(self) -> bool:
+        s = self.client.call("step")
+        self._free = int(s["free_slots"])
+        self.cache._occ = float(s["occupancy"])
+        self.scheduler.queue.n = int(s["queue_len"])
+        self._in_flight = int(s["in_flight"])
+        stepped = int(s["ticks"]) > self._last_ticks
+        self._last_ticks = int(s["ticks"])
+        if not self._by_rid:
+            # No router-owned request is outstanding, so no event or
+            # progress can exist (rids are born in submit and live in
+            # _by_rid until their terminal applies): skip the collect
+            # round trip — idle fleets pay one RPC per tick, not two,
+            # and rpc_ms isn't flooded with empty collects.
+            return stepped
+        c = self.client.call("collect", {
+            "since": {str(r): n for r, n in self._streamed.items()}})
+        now = self.clock()
+        for pr in c.get("progress", ()):
+            req = self._by_rid.get(int(pr["rid"]))
+            if req is None:
+                continue
+            self._apply_tokens(req, pr.get("tokens") or [], now)
+            req.prefill_pos = int(pr.get("prefill_pos", req.prefill_pos))
+        for ev in c.get("events", ()):
+            rid = int(ev["rid"])
+            req = self._by_rid.pop(rid, None)
+            if req is None:
+                continue
+            done = self._streamed.pop(rid, 0)
+            self._apply_tokens(req, ev.get("output", [])[done:], now)
+            req.prefill_pos = int(ev.get("prefill_pos", 0))
+            req.evictions = int(ev.get("evictions", req.evictions))
+            req.state = ev["state"]
+            if req.state == RequestState.REJECTED:
+                req.reject_reason = ev.get("reject_reason")
+                req.retry_after = ev.get("retry_after")
+                self.scheduler.rejected.append(req)
+            elif req.state == RequestState.TIMEOUT:
+                req.t_finish = now
+                self.timed_out.append(req)
+            elif req.state == RequestState.EVICTED:
+                self.evicted.append(req)
+            else:
+                req.t_finish = now
+                self.finished.append(req)
+        return stepped
+
+    def _apply_tokens(self, req: Request, tokens, now: float) -> None:
+        if not tokens:
+            return
+        req.output.extend(int(t) for t in tokens)
+        req.generated.extend(int(t) for t in tokens)
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.token_times.extend([now] * len(tokens))
+        if req.rid in self._streamed:
+            self._streamed[req.rid] += len(tokens)
+
+    def reset_metrics(self) -> None:
+        self.client.call("reset_metrics")
+        self._last_ticks = 0
+        self.finished = []
+        self.timed_out = []
+        self.evicted = []
+        self.scheduler.rejected = []
 
 
 class ServeFleet:
@@ -123,7 +412,9 @@ class ServeFleet:
     def __init__(self, params: Dict, config: ServeConfig,
                  fleet: Optional[FleetConfig] = None, *,
                  chips_per_replica: int = 1,
-                 clock=time.perf_counter, sleep=time.sleep):
+                 clock=time.perf_counter, sleep=time.sleep,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 worker_cmd: Optional[Callable] = None):
         self.params = params
         self.config = config
         self.fleet = fleet if fleet is not None else FleetConfig()
@@ -182,23 +473,83 @@ class ServeFleet:
                 self.heartbeat_dir, self.fleet.watchdog_timeout,
                 interval=min(0.5, self.fleet.watchdog_timeout / 2))
 
+        # Process-transport plumbing: one workdir per fleet INSTANCE
+        # (sockets + the params/config files every worker incarnation
+        # loads — written ONCE, so all replicas decode with
+        # bit-identical weights), per-call RPC wall samples (overhead
+        # evidence, shared across incarnations), and the transport-
+        # failure incident counters. ``worker_cmd(rid, sock_path,
+        # default) -> (argv, env)`` is the spawn injection point
+        # (custom containers, the protocol-stub test worker); it
+        # receives the default ``(argv, env)`` to tweak or replace.
+        # ``worker_env`` overlays the inherited environment of the
+        # default command.
+        self._workdir: Optional[str] = None
+        self._rpc_samples: List[float] = []
+        self.transport_incidents: Dict[str, int] = {}
+        self._incarnations: Dict[int, int] = {}
+        self._worker_env = dict(worker_env or {})
+        self._worker_cmd = worker_cmd
+        if self.fleet.transport == "process":
+            import dataclasses as _dc
+            import json as _json
+            import tempfile
+
+            from horovod_tpu.serve.worker import save_params
+
+            self._workdir = tempfile.mkdtemp(prefix="hvd-fleet-")
+            self._params_path = os.path.join(self._workdir,
+                                             "params.npz")
+            save_params(params, self._params_path)
+            self._config_path = os.path.join(self._workdir,
+                                             "config.json")
+            with open(self._config_path, "w") as f:
+                _json.dump(_dc.asdict(config), f)
+
         self._closed = False
-        self.replicas: List[Replica] = [
-            self._spawn(i) for i in range(self.fleet.replicas)]
+        self.replicas: List[Replica] = []
+        try:
+            for i in range(self.fleet.replicas):
+                self.replicas.append(self._spawn(i))
+        except BaseException:
+            # A failed spawn mid-constructor must not orphan the
+            # replicas (real OS processes!) already running — close()
+            # is unreachable when __init__ raises.
+            for rep in self.replicas:
+                rep.ensure_dead(0)
+            import shutil
+
+            shutil.rmtree(self.heartbeat_dir, ignore_errors=True)
+            if self._workdir:
+                shutil.rmtree(self._workdir, ignore_errors=True)
+            raise
 
     def close(self) -> None:
-        """Release the fleet's host-side footprint — the per-instance
-        heartbeat directory (uniquely named by construction, so a
-        long-lived service or bench loop constructing fleets repeatedly
-        would otherwise accumulate one directory per instance under the
-        base/tempdir forever). Idempotent; a closed fleet can no longer
-        step. Context-manager form closes on exit."""
+        """Tear the fleet down and release its host-side footprint.
+        Idempotent; a closed fleet can no longer step.
+
+        For REAL children (``transport="process"``) this is the no-
+        zombies contract: every worker gets a graceful ``shutdown``
+        RPC under ``FleetConfig.shutdown_deadline``, then the SIGTERM →
+        SIGKILL escalation, and is REAPED — including replicas whose
+        engine thread is wedged by a ``stall:`` fault (their RPC
+        thread still answers, and a worker dead on both planes falls
+        through to the signals; regression-pinned in tests). Then the
+        per-instance heartbeat directory and process-transport workdir
+        (sockets, params/config files) are removed — uniquely named by
+        construction, so a long-lived service or bench loop
+        constructing fleets repeatedly never accumulates orphans.
+        Context-manager form closes on exit."""
         if self._closed:
             return
         self._closed = True
+        for rep in self.replicas:
+            rep.shutdown(self.fleet.shutdown_deadline)
         import shutil
 
         shutil.rmtree(self.heartbeat_dir, ignore_errors=True)
+        if self._workdir:
+            shutil.rmtree(self._workdir, ignore_errors=True)
 
     def __enter__(self) -> "ServeFleet":
         return self
@@ -210,9 +561,6 @@ class ServeFleet:
     # ------------------------------------------------------- lifecycle
 
     def _spawn(self, rid: int) -> Replica:
-        engine = ServeEngine(self.params, self.config,
-                             chips=self.chips_per_replica,
-                             clock=self.clock)
         hb = Heartbeat(self.heartbeat_dir, rank=rid)
         # A (re)spawned replica is unwatched until its first completed
         # step: no stale file from a previous incarnation may insta-kill
@@ -221,7 +569,46 @@ class ServeFleet:
             os.unlink(hb.path)
         except OSError:
             pass
+        if self.fleet.transport == "process":
+            return self._spawn_process(rid, hb)
+        engine = ServeEngine(self.params, self.config,
+                             chips=self.chips_per_replica,
+                             clock=self.clock)
         return Replica(rid, engine, hb)
+
+    def _default_worker_cmd(self, rid: int, sock_path: str):
+        cmd = [sys.executable, "-m", "horovod_tpu.serve.worker",
+               "--socket", sock_path,
+               "--params", self._params_path,
+               "--config", self._config_path,
+               "--rank", str(rid),
+               "--heartbeat-dir", self.heartbeat_dir]
+        env = dict(os.environ)
+        env.update(self._worker_env)
+        return cmd, env
+
+    def _spawn_process(self, rid: int, hb: Heartbeat) -> ProcessReplica:
+        from horovod_tpu.run import spawn_worker
+
+        # Per-incarnation socket path: a relaunch must never race the
+        # dead incarnation's stale socket file.
+        inc = self._incarnations.get(rid, 0) + 1
+        self._incarnations[rid] = inc
+        sock_path = os.path.join(self._workdir, f"r{rid}-{inc}.sock")
+        default = self._default_worker_cmd(rid, sock_path)
+        cmd, env = (self._worker_cmd(rid, sock_path, default)
+                    if self._worker_cmd is not None else default)
+        proc = spawn_worker(cmd, env)
+        client = RpcClient(
+            sock_path, default_timeout=self.fleet.rpc_deadline,
+            connect_timeout=self.fleet.spawn_timeout,
+            proc_alive=lambda: proc.poll() is None,
+            call_ms=self._rpc_samples)
+        proxy = _EngineProxy(client, self.config, self._fits,
+                             self.clock)
+        _log(f"replica {rid}: spawned worker pid {proc.pid} "
+             f"(incarnation {inc}) on {sock_path}")
+        return ProcessReplica(rid, proxy, hb, proc, client, sock_path)
 
     @property
     def in_flight(self) -> int:
@@ -276,19 +663,45 @@ class ServeFleet:
                  f"{rep.state})")
             if action.kind == "kill":
                 if rep.healthy:
+                    # ensure_dead (inside _kill_replica) makes this a
+                    # GENUINE os.kill(pgid, SIGKILL) on a process
+                    # replica — the observed exit code is the real -9.
                     self._kill_replica(rep, code=-int(_signal.SIGKILL),
                                        stalled=False, now=now)
             elif action.kind == "stall":
                 if rep.healthy:
-                    rep.stall_until = (now + action.secs
-                                       if action.secs is not None
-                                       else float("inf"))
+                    self._arm_replica_fault(
+                        rep, now, "stall", {"secs": action.secs},
+                        lambda: setattr(
+                            rep, "stall_until",
+                            now + action.secs
+                            if action.secs is not None
+                            else float("inf")))
             elif action.kind == "slow":
                 # Like kill/stall: a fault addressed to a dead replica
                 # is a no-op — it must not brand the NEXT incarnation
                 # (kill resets slow_factor to 1.0 for the same reason).
                 if rep.healthy:
-                    rep.slow_factor = float(action.factor)
+                    self._arm_replica_fault(
+                        rep, now, "slow", {"factor": action.factor},
+                        lambda: setattr(rep, "slow_factor",
+                                        float(action.factor)))
+
+    def _arm_replica_fault(self, rep: Replica, now: float, kind: str,
+                           payload: Dict, inproc_apply) -> None:
+        """Route one stall/slow fault to where the replica actually
+        lives: in-process replicas flip the fleet-side flags; a process
+        worker is told over RPC and wedges/slows ITSELF (a stalled
+        process is then genuinely silent — only its stale heartbeat
+        gives it away). A transport failure while arming is, as
+        always, replica death."""
+        if rep.transport != "process":
+            inproc_apply()
+            return
+        try:
+            rep.engine.client.call("fault", dict(payload, kind=kind))
+        except TransportError as e:
+            self._transport_death(rep, e, now)
 
     # ------------------------------------------------------ submission
 
@@ -354,11 +767,32 @@ class ServeFleet:
 
     # ---------------------------------------------------- supervision
 
+    def _transport_death(self, rep: Replica, err: Exception,
+                         now: float) -> None:
+        """The tentpole's one rule: ANY transport failure — refused
+        connect, torn frame, checksum mismatch, deadline expiry,
+        remote raise — is the replica-death path, never an RPC retry
+        (a blind resend could double-apply a submit and break
+        at-most-once). ``ensure_dead`` inside the kill path turns the
+        maybe-still-running worker into a definitely-dead one and
+        recovers its real exit code for classification."""
+        kind = type(err).__name__
+        self.transport_incidents[kind] = \
+            self.transport_incidents.get(kind, 0) + 1
+        _log(f"replica {rep.id}: transport failure {kind}: {err} — "
+             "routing into the replica-death path (no retry)")
+        self._kill_replica(rep, code=1, stalled=False, now=now,
+                           transport_error=kind)
+
     def _kill_replica(self, rep: Replica, *, code: int, stalled: bool,
-                      now: float, detect_age: Optional[float] = None
-                      ) -> None:
+                      now: float, detect_age: Optional[float] = None,
+                      transport_error: Optional[str] = None) -> None:
         """Classify + drain + schedule relaunch: the fleet edition of
         the supervisor's per-incident policy."""
+        # Make the failure domain REALLY dead first (process replicas:
+        # SIGKILL the worker's process group + reap — no zombies, and
+        # the reaped code beats the synthetic hint as evidence).
+        code = rep.ensure_dead(code)
         rep.exit = WorkerExit(rank=rep.id, code=code, stalled=stalled)
         category = rep.exit.category
         self.incidents_by_class[category] = \
@@ -382,6 +816,7 @@ class ServeFleet:
             "replica": rep.id,
             "category": category,
             "code": code,
+            "transport_error": transport_error,
             "t_s": round(now - self._t_start, 4),
             # Watchdog kills carry the observed heartbeat age (real
             # detection latency). In-process crashes are observed
@@ -475,9 +910,7 @@ class ServeFleet:
                 continue
             self.restarts_used += 1
             rep.restarts += 1
-            fresh = self._spawn(rep.id)
-            rep.engine = fresh.engine
-            rep.heartbeat = fresh.heartbeat
+            rep.adopt(self._spawn(rep.id))
             rep.state = "healthy"
             rep.exit = None
             if self.watchdog is not None:
@@ -517,7 +950,19 @@ class ServeFleet:
             if rep is None:
                 break   # head waits; order (and requeue priority) holds
             self.queue.pop(0)
-            if not rep.engine.scheduler.submit(req):
+            try:
+                accepted = rep.engine.scheduler.submit(req)
+            except TransportError as e:
+                # The request never reached the replica (or we cannot
+                # know that it did — same thing under at-most-once: it
+                # was never ACKed, so it is safe to hand to a
+                # survivor). Back to the head, replica into the death
+                # path, keep dispatching.
+                self.queue.insert(0, req)
+                req.state = RequestState.QUEUED
+                self._transport_death(rep, e, self.clock())
+                continue
+            if not accepted:
                 # Defensive only: eligible() mirrors every admission
                 # check (geometry, in-flight headroom, the engine's own
                 # bounded queue), so a failure here means drift the
@@ -594,6 +1039,14 @@ class ServeFleet:
             t0 = self.clock()
             try:
                 stepped = rep.engine.step()
+            except TransportError as e:
+                # The wire to a process worker failed (torn frame from
+                # a kill mid-write, deadline expiry, connection lost):
+                # replica death, by the tentpole rule. Caught BEFORE
+                # the generic handler so the incident records the
+                # transport evidence and the real reaped exit code.
+                self._transport_death(rep, e, now)
+                continue
             except Exception as e:
                 # A REAL replica crash (engine bug, allocator error,
                 # device OOM) — the docstring's contract: one replica
@@ -625,9 +1078,12 @@ class ServeFleet:
         # age for every replica that completed this tick; only
         # genuinely skipped replicas — stalled or dead — go stale. An
         # idle-but-healthy replica still stamps (engine.step() False is
-        # "nothing to do", not "wedged").
+        # "nothing to do", not "wedged"). Process workers stamp their
+        # OWN file per served tick — the fleet must never stamp for
+        # them, or a wedged worker would look alive forever.
         for rep in ticked:
-            rep.heartbeat.touch(rep.steps)
+            if not rep.stamps_own_heartbeat:
+                rep.heartbeat.touch(rep.steps)
         if occ:
             self.occupancy_samples.append(sum(occ) / len(occ))
         self.steps += 1
@@ -669,9 +1125,18 @@ class ServeFleet:
         self.shed_total = 0
         self.occupancy_samples = []
         self.steps = 0
+        self._rpc_samples.clear()
+        self.transport_incidents = {}
         for rep in self.replicas:
             if rep.healthy and rep.engine is not None:
-                rep.engine.reset_metrics()
+                try:
+                    rep.engine.reset_metrics()
+                except TransportError as e:
+                    # A reset is the one RPC issued outside step();
+                    # the death rule is the same (the replica will be
+                    # relaunched with fresh metrics anyway).
+                    self._transport_death(rep, e, self.clock())
+                    continue
                 rep.steps = 0
         self._fault_t0 = None
         self._t_start = self.clock()
@@ -696,8 +1161,21 @@ class ServeFleet:
             by_reason[key] = by_reason.get(key, 0) + 1
         detect = [i["detect_s"] for i in self.incidents
                   if i["category"] == "stalled"]
+        from horovod_tpu.serve.metrics import percentile
+
+        rpc_ms = None
+        if self.fleet.transport == "process":
+            s = self._rpc_samples
+            rpc_ms = {
+                "calls": len(s),
+                "p50": round(percentile(s, 50), 4) if s else None,
+                "p99": round(percentile(s, 99), 4) if s else None,
+            }
         out["fleet"] = {
             "replicas": len(self.replicas),
+            "transport": self.fleet.transport,
+            "rpc_ms": rpc_ms,
+            "transport_incidents": dict(self.transport_incidents),
             "healthy": sum(1 for r in self.replicas if r.healthy),
             "dead": sum(1 for r in self.replicas if r.state == "dead"),
             "failed": sum(1 for r in self.replicas

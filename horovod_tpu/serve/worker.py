@@ -1,0 +1,427 @@
+"""Replica worker process: one ServeEngine behind the fleet transport.
+
+``python -m horovod_tpu.serve.worker --socket S --params P --config C
+--rank R --heartbeat-dir D`` runs ONE
+:class:`~horovod_tpu.serve.engine.ServeEngine` as its own OS process —
+the crash-isolation boundary the in-process fleet honestly lacked: a
+replica that segfaults, OOMs, or is SIGKILLed takes down exactly one
+worker, never the router or its peers.
+
+Two threads, one failure story:
+
+* the **engine loop** (main thread) steps the engine whenever it has
+  work, harvests terminal requests into the collect outbox, and
+  touches the replica's heartbeat file at the END of each served tick
+  (idle ticks included — ``step() == False`` is "nothing to do", not
+  "wedged") — exactly the PR-12 liveness contract, now fed by a real
+  process so a ``stall:`` fault genuinely wedges this thread and ONLY
+  the stale heartbeat + the supervisor-side
+  :class:`~horovod_tpu.elastic.supervisor.HealthWatchdog` can catch it;
+* the **RPC thread** serves the router's calls (``submit`` / ``step`` /
+  ``collect`` / ``stats`` / ``drain`` / ``reset_metrics`` / ``fault`` /
+  ``shutdown`` / ``ping``) over the framed Unix-socket protocol
+  (:mod:`~horovod_tpu.serve.transport`), sharing the engine under one
+  lock. It stays responsive through an engine-loop stall — which is
+  what routes a wedged replica to the watchdog (``stalled``) instead of
+  an RPC deadline (``crashed``): the control plane answers, the data
+  plane is silent.
+
+The socket is bound BEFORE the heavy jax import so the router's
+connect succeeds early; the first RPCs then wait (inside their
+deadline) for engine construction. A worker that dies during startup
+never binds, never heartbeats — the router observes the connect
+failure plus the reaped exit code and classifies ``crashed`` through
+the PR-9 taxonomy (it consumes restart budget; see
+docs/troubleshooting.md).
+
+Timestamps: the router stamps every request's latency trail with its
+OWN clock at collect time (what a streaming client at the router
+actually observes) — worker-side clock stamps never cross the process
+boundary, so there is no cross-process clock skew to reconcile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.run.driver import EXIT_CLEAN, EXIT_USAGE
+from horovod_tpu.serve.transport import serve_connection
+
+# ------------------------------------------------------------------ params
+
+_LEAF = "__leaf_{}__"
+
+
+def save_params(params, path: str) -> None:
+    """Serialize a dict/list pytree of arrays to one ``.npz`` (a JSON
+    structure spec plus one entry per leaf) — the fleet writes it once,
+    every worker incarnation loads it, so all replicas decode with
+    BIT-IDENTICAL weights (the redispatch exactness pin depends on
+    it)."""
+    leaves: List[np.ndarray] = []
+
+    def enc(x):
+        if isinstance(x, dict):
+            return {k: enc(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [enc(v) for v in x]
+        leaves.append(np.asarray(x))
+        return _LEAF.format(len(leaves) - 1)
+
+    spec = enc(params)
+    np.savez(path, __spec__=np.asarray(json.dumps(spec)),
+             **{f"leaf_{i}": a for i, a in enumerate(leaves)})
+
+
+def load_params(path: str, as_jax: bool = True):
+    """Inverse of :func:`save_params`; ``as_jax`` converts leaves once
+    so the engine's compiled steps don't re-upload host arrays every
+    call."""
+    with np.load(path, allow_pickle=False) as z:
+        spec = json.loads(str(z["__spec__"]))
+        leaves = {f"leaf_{i}": z[f"leaf_{i}"]
+                  for i in range(len(z.files) - 1)}
+    if as_jax:
+        import jax.numpy as jnp
+
+        leaves = {k: jnp.asarray(v) for k, v in leaves.items()}
+
+    def dec(x):
+        if isinstance(x, dict):
+            return {k: dec(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [dec(v) for v in x]
+        if isinstance(x, str) and x.startswith("__leaf_") \
+                and x.endswith("__"):
+            return leaves[f"leaf_{int(x[7:-2])}"]
+        return x
+
+    return dec(spec)
+
+
+def _jsonable(x: Any) -> Any:
+    """Stats payloads -> JSON-safe (numpy scalars/arrays demoted)."""
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    return x
+
+
+# ------------------------------------------------------------------- host
+
+
+class WorkerHost:
+    """The worker's two-thread engine host (see module docstring)."""
+
+    def __init__(self, engine, heartbeat=None):
+        self.engine = engine
+        self.heartbeat = heartbeat
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        #: router rid -> the ENGINE's Request (the worker's own rids
+        #: never cross the wire).
+        self._requests: Dict[int, Any] = {}
+        self._terminal: List[Dict] = []
+        self._ticks = 0
+        self._stall_pending: Optional[Dict] = None
+        self._slow = 1.0
+        self._collects = 0
+        self._last_hb = 0.0
+        torn = os.environ.get("HVD_SERVE_WORKER_TORN_COLLECT_AFTER")
+        #: test hook: after N collect responses, write HALF the next
+        #: collect reply frame and die — the deterministic
+        #: kill-mid-write shape the codec/fuzz pin exercises e2e.
+        self._torn_after = int(torn) if torn else None
+
+    # ------------------------------------------------- engine loop
+
+    def serve_loop(self) -> None:
+        while not self._shutdown.is_set():
+            with self._lock:
+                stall, self._stall_pending = self._stall_pending, None
+            if stall is not None:
+                secs = stall.get("secs")
+                if secs is None:
+                    # A genuine wedge: the engine thread stops stepping
+                    # and stops heartbeating, forever. Only SIGKILL (the
+                    # watchdog's, or close()'s escalation) — or an
+                    # explicit shutdown RPC — ends it.
+                    while not self._shutdown.is_set():
+                        time.sleep(1.0)
+                    break
+                time.sleep(float(secs))
+            t0 = time.perf_counter()
+            with self._lock:
+                progressed = self.engine.step()
+                if progressed:
+                    self._ticks += 1
+                self._harvest_locked()
+            if progressed and self._slow > 1.0:
+                dt = time.perf_counter() - t0
+                if dt > 0:
+                    time.sleep((self._slow - 1.0) * dt)
+            if self.heartbeat is not None:
+                # END of the served tick (idle ones included): the
+                # PR-12 liveness cadence, stamped by the worker
+                # itself — rate-limited to 50 ms so a fast/idle loop
+                # is not ~500 file writes/s for zero information (the
+                # watchdog only needs sub-timeout freshness; a long
+                # tick, e.g. a compile, always ends with a touch).
+                now = time.monotonic()
+                if now - self._last_hb >= 0.05:
+                    self.heartbeat.touch(self._ticks)
+                    self._last_hb = now
+            if not progressed:
+                time.sleep(0.002)
+
+    def _harvest_locked(self) -> None:
+        eng = self.engine
+        for lst in (eng.finished, eng.timed_out, eng.evicted,
+                    eng.scheduler.rejected):
+            for req in lst:
+                rid = getattr(req, "_router_rid", None)
+                if rid is None:
+                    continue   # not router-owned (defensive)
+                self._terminal.append(self._serialize(rid, req))
+                self._requests.pop(rid, None)
+            lst.clear()
+
+    @staticmethod
+    def _serialize(rid: int, req) -> Dict:
+        return {
+            "rid": int(rid),
+            "state": req.state,
+            "output": [int(t) for t in req.output],
+            "prefill_pos": int(req.prefill_pos),
+            "generated_len": len(req.generated),
+            "evictions": int(req.evictions),
+            "reject_reason": req.reject_reason,
+            "retry_after": req.retry_after,
+        }
+
+    # -------------------------------------------------- RPC thread
+
+    def handle(self, method: str, params: Dict) -> Any:
+        fn = getattr(self, "_rpc_" + method, None)
+        if fn is None or not method:
+            raise ValueError(f"unknown RPC method {method!r}")
+        return fn(params)
+
+    def _rpc_ping(self, p: Dict) -> Dict:
+        return {"pid": os.getpid(), "ticks": self._ticks}
+
+    def _rpc_submit(self, p: Dict) -> Dict:
+        from horovod_tpu.serve.scheduler import make_request
+
+        with self._lock:
+            eng = self.engine
+            req = make_request(
+                eng.config, eng.clock,
+                np.asarray(p["prompt"], np.int32),
+                int(p["max_new_tokens"]),
+                temperature=float(p.get("temperature", 0.0)),
+                top_k=int(p.get("top_k", 0)),
+                eos_token=p.get("eos_token"),
+                seed=int(p.get("seed", 0)),
+                # reconstruct arrival in THIS process's clock so the
+                # engine-side TTL sweep keeps the original deadline
+                arrival=eng.clock() - float(p.get("age", 0.0)),
+                ttl=p.get("ttl"))
+            req._router_rid = int(p["rid"])
+            if eng.scheduler.submit(req):
+                self._requests[int(p["rid"])] = req
+                return {"accepted": True}
+            # engine stamped the reject; report it inline (never also
+            # via the outbox — the router owns the single record)
+            if req in eng.scheduler.rejected:
+                eng.scheduler.rejected.remove(req)
+            return {"accepted": False,
+                    "reject_reason": req.reject_reason,
+                    "retry_after": req.retry_after}
+
+    def _rpc_step(self, p: Dict) -> Dict:
+        with self._lock:
+            eng = self.engine
+            return {"ticks": self._ticks,
+                    "free_slots": eng._free_slots(),
+                    "occupancy": float(eng.cache.occupancy()),
+                    "queue_len": len(eng.scheduler.queue),
+                    "in_flight": eng.in_flight,
+                    "idle": eng.idle}
+
+    def _rpc_collect(self, p: Dict) -> Dict:
+        since = p.get("since") or {}
+        with self._lock:
+            self._harvest_locked()
+            events, self._terminal = self._terminal, []
+            progress = []
+            for rid_s, n in since.items():
+                req = self._requests.get(int(rid_s))
+                if req is None:
+                    continue   # terminal event already covers it
+                progress.append({
+                    "rid": int(rid_s),
+                    "tokens": [int(t) for t in req.output[int(n):]],
+                    "prefill_pos": int(req.prefill_pos),
+                    "generated_len": len(req.generated),
+                })
+        self._collects += 1
+        return {"events": events, "progress": progress}
+
+    def _rpc_stats(self, p: Dict) -> Dict:
+        with self._lock:
+            return _jsonable(self.engine.stats())
+
+    def _rpc_drain(self, p: Dict) -> Dict:
+        deadline = time.monotonic() + float(p.get("timeout", 5.0))
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.engine.idle:
+                    return {"idle": True}
+            time.sleep(0.005)
+        return {"idle": False}
+
+    def _rpc_reset_metrics(self, p: Dict) -> Dict:
+        with self._lock:
+            self.engine.reset_metrics()   # raises if not idle
+            self._ticks = 0
+        return {"ticks": 0}
+
+    def _rpc_fault(self, p: Dict) -> Dict:
+        kind = p.get("kind")
+        with self._lock:
+            if kind == "stall":
+                self._stall_pending = {"secs": p.get("secs")}
+            elif kind == "slow":
+                self._slow = float(p["factor"])
+            else:
+                raise ValueError(f"unknown fault kind {kind!r} (the "
+                                 "kill edition is a real signal)")
+        return {}
+
+    def _rpc_shutdown(self, p: Dict) -> Dict:
+        self._shutdown.set()
+        # The engine thread may be genuinely wedged (a bounded stall
+        # mid-sleep): guarantee exit shortly after the reply flushes,
+        # through the taxonomy's clean code either way.
+        timer = threading.Timer(0.5, os._exit, args=(EXIT_CLEAN,))
+        timer.daemon = True
+        timer.start()
+        return {"pid": os.getpid()}
+
+    # ---------------------------------------------- plumbing
+
+    def _send_hook(self, sock: socket.socket, frame: bytes) -> bool:
+        if self._torn_after is not None \
+                and self._collects >= self._torn_after:
+            sock.settimeout(5.0)
+            sock.sendall(frame[:max(1, len(frame) // 2)])
+            os._exit(1)   # die mid-write: the torn-frame crash shape
+        return False
+
+    def rpc_loop(self, server_sock: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            server_sock.settimeout(0.25)
+            try:
+                conn, _ = server_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                serve_connection(conn, self.handle,
+                                 should_stop=self._shutdown.is_set,
+                                 send_hook=self._send_hook)
+
+
+# ------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    # Startup-failure test hook: before ANY heavy work, so the fleet
+    # sees a worker that dies pre-bind, pre-heartbeat (classified
+    # crashed, consumes restart budget — docs/troubleshooting.md).
+    fail = os.environ.get("HVD_SERVE_WORKER_FAIL_START")
+    if fail:
+        print("serve.worker: HVD_SERVE_WORKER_FAIL_START set — "
+              "exiting before startup", file=sys.stderr, flush=True)
+        return int(fail)
+
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serve.worker",
+        description="One serving-fleet replica worker process.")
+    ap.add_argument("--socket", required=True,
+                    help="Unix-domain socket path to serve RPCs on")
+    ap.add_argument("--params", required=True,
+                    help="npz of model params (worker.save_params)")
+    ap.add_argument("--config", required=True,
+                    help="path to the ServeConfig JSON")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="replica id (heartbeat file + logs)")
+    ap.add_argument("--heartbeat-dir", default="",
+                    help="fleet heartbeat directory ('' = no beacon)")
+    args = ap.parse_args(argv)
+
+    # Bind BEFORE the heavy init: the router's connect succeeds as soon
+    # as the process is alive; its first RPCs wait inside their own
+    # deadline for the engine to finish constructing.
+    try:
+        os.unlink(args.socket)
+    except OSError:
+        pass
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        srv.bind(args.socket)
+    except OSError as e:
+        print(f"serve.worker[{args.rank}]: cannot bind {args.socket}: "
+              f"{e}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+    srv.listen(2)
+
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # This image's sitecustomize imports jax at interpreter startup
+        # (the conftest note): config.update is the reliable override.
+        jax.config.update("jax_platforms", plat.split(",")[0])
+
+    from horovod_tpu.elastic.signals import Heartbeat
+    from horovod_tpu.serve.config import ServeConfig
+    from horovod_tpu.serve.engine import ServeEngine
+
+    with open(args.config) as f:
+        cfg = ServeConfig(**json.load(f))
+    params = load_params(args.params)
+    engine = ServeEngine(params, cfg)
+    hb = Heartbeat(args.heartbeat_dir, rank=args.rank) \
+        if args.heartbeat_dir else None
+
+    host = WorkerHost(engine, hb)
+    rpc = threading.Thread(target=host.rpc_loop, args=(srv,),
+                           daemon=True,
+                           name=f"serve-worker-rpc-{args.rank}")
+    rpc.start()
+    print(f"serve.worker[{args.rank}]: serving on {args.socket} "
+          f"(pid {os.getpid()})", file=sys.stderr, flush=True)
+    host.serve_loop()
+    srv.close()
+    return EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -175,6 +175,12 @@ _SLOW_TESTS = {
     # acceptance command end-to-end. Arg-validation stays fast.
     "test_serve_bench.py::TestFleetBenchContract::test_fleet_fault_ab_record_contract",
     "test_serve_bench.py::TestFleetBenchContract::test_fleet_clean_record_contract",
+    # ~90s: whole clean+faulted PROCESS fleets (4 real worker spawns,
+    # each paying the jax import + compile). Stand-ins: the fast
+    # test_serve_worker.py::TestStubFleet matrix + the synthetic
+    # fleet_cell pin; the check.sh process-fleet smoke runs this exact
+    # command end-to-end.
+    "test_serve_bench.py::TestFleetBenchContract::test_fleet_process_transport_record_contract",
     # 11s + 8s + 7s fleet composition depth: the fast greedy kill pin
     # already runs a clean fleet (== lm_decode per request) AND a
     # faulted fleet on the same submissions; the sampled variant
@@ -211,6 +217,18 @@ _SLOW_TESTS = {
     # fast, auth is covered by TestTransportAuth.
     "test_native_core.py::TestHierarchical::test_hierarchical_authenticated",
     "test_native_core.py::TestHierarchical::test_group_size_defaults_to_local_size",
+    # ~20s each: real `python -m horovod_tpu.serve.worker` processes
+    # (every spawn pays the sitecustomize jax import + first-step
+    # compile). Fast stand-ins: test_serve_worker.py::TestStubFleet
+    # runs the SAME fleet/transport code paths against real OS
+    # processes via the no-jax protocol stub (~4s for the whole
+    # recovery matrix incl. SIGKILL-classify, torn-frame, watchdog
+    # stall, close-escalation), test_serve_transport.py pins the codec,
+    # and the tools/check.sh process-fleet smoke runs the real-worker
+    # kill e2e end to end.
+    "test_serve_worker.py::TestRealWorkerE2E::test_kill_redispatch_bit_exact_vs_lm_decode",
+    "test_serve_worker.py::TestRealWorkerE2E::test_stall_watchdog_classified_relaunch",
+    "test_serve_worker.py::TestRealWorkerE2E::test_kill_mid_write_torn_frame_redispatch_exact",
 }
 
 

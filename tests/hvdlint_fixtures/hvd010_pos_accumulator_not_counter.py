@@ -8,7 +8,7 @@ def read_forever(sock):
     data = b""
     total = 0
     while True:
-        chunk = sock.recv(4096)
+        chunk = sock.recv(4096)  # EXPECT: HVD011 (unbounded too)
         data += chunk
         n = len(chunk)
         total = total + n

@@ -1,0 +1,18 @@
+"""HVD011 negative: the transport discipline — every recv bounded.
+
+A deadline parameter governs the whole frame and each recv runs under
+an explicit socket timeout; a dead peer raises instead of hanging.
+"""
+
+import time
+
+
+def read_exact(sock, n, deadline):
+    buf = b""
+    while len(buf) < n:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"{len(buf)}/{n} bytes")
+        sock.settimeout(remaining)
+        buf += sock.recv(n - len(buf))
+    return buf

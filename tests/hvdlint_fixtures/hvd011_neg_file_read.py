@@ -1,0 +1,16 @@
+"""HVD011 negative: ordinary file reads and timeout-scoped sockets.
+
+``f.read()`` on a local file cannot hang on a dead peer (no peer), and
+a socket read inside a function that threads a ``timeout`` argument
+has the deadline discipline in scope.
+"""
+
+
+def load_manifest(path):
+    with open(path) as f:
+        return f.read()
+
+
+def fetch(sock, nbytes, timeout=5.0):
+    sock.settimeout(timeout)
+    return sock.recv(nbytes)

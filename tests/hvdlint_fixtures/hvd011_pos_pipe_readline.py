@@ -1,0 +1,17 @@
+"""HVD011 positive: waiting on a worker's pipe with no bound.
+
+A supervisor that readline()s a child's stdout for a readiness marker
+hangs forever when the child dies before printing it — the
+supervision loop never runs, the job never fails, the operator sees
+nothing. The launcher's real pump threads are daemons that may block
+by design (and say so); a control-path read like this must be bounded.
+"""
+
+
+def wait_for_ready(proc):
+    while True:
+        line = proc.stdout.readline()  # EXPECT: HVD011
+        if b"READY" in line:
+            return True
+        if not line:
+            return False

@@ -1,0 +1,18 @@
+"""HVD011 positive: a length-prefixed frame read that blocks forever.
+
+The reader recv()s with no socket timeout and no deadline anywhere in
+scope: a peer killed mid-write (the exact crash the fleet transport
+exists to survive) leaves this thread blocked in the kernel forever —
+no exception, no heartbeat, nothing for a watchdog to classify.
+"""
+
+import struct
+
+
+def read_frame(sock):
+    header = sock.recv(8)  # EXPECT: HVD011
+    (length,) = struct.unpack("<Q", header)
+    payload = b""
+    while len(payload) < length:
+        payload += sock.recv(length - len(payload))  # EXPECT: HVD011
+    return payload

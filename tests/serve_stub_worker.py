@@ -1,0 +1,300 @@
+"""Protocol-faithful serving-fleet stub worker — no jax, ~30 ms start.
+
+The FAST stand-in for the real ``python -m horovod_tpu.serve.worker``
+(which pays a multi-second jax import per spawn, so its end-to-end
+tests are slow-marked): this stub speaks the exact same framed RPC
+protocol (``submit``/``step``/``collect``/``stats``/``drain``/
+``reset_metrics``/``fault``/``shutdown``/``ping``), stamps the same
+per-tick heartbeat file, honors the same fault and test hooks
+(``HVD_SERVE_WORKER_TORN_COLLECT_AFTER``,
+``HVD_SERVE_WORKER_FAIL_START``), and is launched with ``python -S``
+so it never even imports site-packages — letting the whole
+process-fleet recovery matrix (transport death paths, watchdog stalls,
+close escalation, startup crashes) run in the fast test lane against
+real OS processes.
+
+Its "model" is a deterministic context hash: the next token depends on
+the FULL context (prompt + everything generated), exactly like greedy
+LM decoding — so a redispatch that folds the generated-so-far prefix
+into the prompt (``rebase_for_recompute``) continues the identical
+stream, and the at-most-once/bit-exact pins hold for the same reason
+they hold on the real engine.
+
+Loaded as a module by tests for :func:`expected_stream`; run as a
+script by the fleet's ``worker_cmd`` hook.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+VOCAB = 97
+
+
+def next_token(context):
+    h = 0
+    for t in context:
+        h = (h * 31 + int(t) + 1) % 1000003
+    return h % VOCAB
+
+
+def expected_stream(prompt, n):
+    """The stream an uninterrupted greedy 'decode' of ``prompt`` emits
+    — and, because each token depends on the full context, the stream
+    any rebased redispatch must continue bit-identically."""
+    ctx = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = next_token(ctx)
+        ctx.append(t)
+        out.append(t)
+    return out
+
+
+def _load_transport():
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "horovod_tpu", "serve",
+                        "transport.py")
+    spec = importlib.util.spec_from_file_location("_stub_transport", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class StubHost:
+    def __init__(self, transport, slots, heartbeat_path, tick_s):
+        self.T = transport
+        self.slots = slots
+        self.heartbeat_path = heartbeat_path
+        self.tick_s = tick_s
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._requests = {}    # rid -> dict(prompt, max_new, output)
+        self._order = []       # fcfs admission order
+        self._terminal = []
+        self._ticks = 0
+        self._last_hb = 0.0
+        self._stall_pending = None
+        self._slow = 1.0
+        self._collects = 0
+        torn = os.environ.get("HVD_SERVE_WORKER_TORN_COLLECT_AFTER")
+        self._torn_after = int(torn) if torn else None
+
+    # ------------------------------------------------ engine loop
+
+    def serve_loop(self):
+        while not self._shutdown.is_set():
+            with self._lock:
+                stall, self._stall_pending = self._stall_pending, None
+            if stall is not None:
+                secs = stall.get("secs")
+                if secs is None:
+                    while not self._shutdown.is_set():
+                        time.sleep(0.2)
+                    break
+                time.sleep(float(secs))
+            t0 = time.monotonic()
+            with self._lock:
+                progressed = self._tick_locked()
+                if progressed:
+                    self._ticks += 1
+            if progressed and self._slow > 1.0:
+                time.sleep((self._slow - 1.0)
+                           * max(time.monotonic() - t0, self.tick_s))
+            if self.heartbeat_path:
+                # same 50 ms rate limit as the real worker
+                now = time.monotonic()
+                if now - self._last_hb >= 0.05:
+                    with open(self.heartbeat_path, "w") as f:
+                        f.write(f"{self._ticks}\n")
+                    self._last_hb = now
+            time.sleep(self.tick_s if progressed else 0.002)
+
+    def _tick_locked(self):
+        active = [r for r in self._order
+                  if r in self._requests][:self.slots]
+        progressed = False
+        for rid in active:
+            req = self._requests[rid]
+            ctx = req["prompt"] + req["output"]
+            req["output"].append(next_token(ctx))
+            progressed = True
+            if len(req["output"]) >= req["max_new"]:
+                self._terminal.append({
+                    "rid": rid, "state": "finished",
+                    "output": list(req["output"]),
+                    "prefill_pos": len(req["prompt"]),
+                    "generated_len": len(req["output"]),
+                    "evictions": 0,
+                    "reject_reason": None, "retry_after": None,
+                })
+                del self._requests[rid]
+        self._order = [r for r in self._order if r in self._requests]
+        return progressed
+
+    # ------------------------------------------------ RPC handlers
+
+    def handle(self, method, params):
+        fn = getattr(self, "_rpc_" + method, None)
+        if fn is None or not method:
+            raise ValueError(f"unknown RPC method {method!r}")
+        return fn(params)
+
+    def _rpc_ping(self, p):
+        return {"pid": os.getpid(), "ticks": self._ticks}
+
+    def _rpc_submit(self, p):
+        with self._lock:
+            rid = int(p["rid"])
+            self._requests[rid] = {
+                "prompt": [int(t) for t in p["prompt"]],
+                "max_new": int(p["max_new_tokens"]),
+                "output": [],
+            }
+            self._order.append(rid)
+            return {"accepted": True}
+
+    def _rpc_step(self, p):
+        with self._lock:
+            return {"ticks": self._ticks,
+                    "free_slots": max(0, self.slots
+                                      - len(self._requests)),
+                    "occupancy": 0.0,
+                    "queue_len": 0,
+                    "in_flight": len(self._requests),
+                    "idle": not self._requests}
+
+    def _rpc_collect(self, p):
+        since = p.get("since") or {}
+        with self._lock:
+            events, self._terminal = self._terminal, []
+            progress = []
+            for rid_s, n in since.items():
+                req = self._requests.get(int(rid_s))
+                if req is None:
+                    continue
+                progress.append({
+                    "rid": int(rid_s),
+                    "tokens": req["output"][int(n):],
+                    "prefill_pos": len(req["prompt"]),
+                    "generated_len": len(req["output"]),
+                })
+        self._collects += 1
+        return {"events": events, "progress": progress}
+
+    def _rpc_stats(self, p):
+        with self._lock:
+            return {"in_flight": len(self._requests),
+                    "ticks": self._ticks}
+
+    def _rpc_drain(self, p):
+        deadline = time.monotonic() + float(p.get("timeout", 5.0))
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._requests:
+                    return {"idle": True}
+            time.sleep(0.002)
+        return {"idle": False}
+
+    def _rpc_reset_metrics(self, p):
+        with self._lock:
+            self._ticks = 0
+        return {"ticks": 0}
+
+    def _rpc_fault(self, p):
+        kind = p.get("kind")
+        with self._lock:
+            if kind == "stall":
+                self._stall_pending = {"secs": p.get("secs")}
+            elif kind == "slow":
+                self._slow = float(p["factor"])
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        return {}
+
+    def _rpc_shutdown(self, p):
+        self._shutdown.set()
+        timer = threading.Timer(0.5, os._exit, args=(0,))
+        timer.daemon = True
+        timer.start()
+        return {"pid": os.getpid()}
+
+    # ------------------------------------------------ plumbing
+
+    def _send_hook(self, sock, frame):
+        if self._torn_after is not None \
+                and self._collects >= self._torn_after:
+            sock.settimeout(5.0)
+            sock.sendall(frame[:max(1, len(frame) // 2)])
+            os._exit(1)
+        return False
+
+    def rpc_loop(self, server_sock):
+        import socket as _socket
+
+        while not self._shutdown.is_set():
+            server_sock.settimeout(0.25)
+            try:
+                conn, _ = server_sock.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                self.T.serve_connection(conn, self.handle,
+                                        should_stop=self._shutdown.is_set,
+                                        send_hook=self._send_hook)
+
+
+def main(argv=None):
+    fail = os.environ.get("HVD_SERVE_WORKER_FAIL_START")
+    if fail:
+        print("serve_stub_worker: HVD_SERVE_WORKER_FAIL_START set",
+              file=sys.stderr, flush=True)
+        return int(fail)
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--heartbeat-dir", default="")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--tick-s", type=float, default=0.001,
+                    help="artificial per-tick service time")
+    ap.add_argument("--startup-delay", type=float, default=0.0,
+                    help="sleep before binding (spawn-race tests)")
+    args = ap.parse_args(argv)
+
+    if args.startup_delay > 0:
+        time.sleep(args.startup_delay)
+
+    T = _load_transport()
+    import socket as _socket
+
+    try:
+        os.unlink(args.socket)
+    except OSError:
+        pass
+    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    srv.bind(args.socket)
+    srv.listen(2)
+
+    hb_path = ""
+    if args.heartbeat_dir:
+        os.makedirs(args.heartbeat_dir, exist_ok=True)
+        hb_path = os.path.join(args.heartbeat_dir, f"hb-{args.rank}")
+
+    host = StubHost(T, args.slots, hb_path, args.tick_s)
+    rpc = threading.Thread(target=host.rpc_loop, args=(srv,),
+                           daemon=True)
+    rpc.start()
+    host.serve_loop()
+    srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

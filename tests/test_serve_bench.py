@@ -152,6 +152,52 @@ class TestFleetBenchContract:
         cell = fleet_cell(rec)
         assert cell.startswith("2r") and "crashed1" in cell
 
+    def test_fleet_process_transport_record_contract(self):
+        """The round-13 acceptance e2e: the same fault A/B with one
+        worker OS process per replica — the kill SIGKILLs a REAL
+        process (incident code -9 from the reaped exit), the record
+        stamps transport='process' + per-RPC overhead + transport
+        incident counts, and no worker process survives the bench."""
+        def worker_pids():
+            ps = subprocess.run(
+                ["pgrep", "-f", "horovod_tpu.serve.worker"],
+                capture_output=True, text=True)
+            return set(ps.stdout.split())
+
+        pre = worker_pids()   # other jobs' workers are not ours to judge
+        p = _run("serve_bench.py", *TINY, "--rate", "200",
+                 "--fleet", "2", "--fleet-transport", "process",
+                 "--fault-plan", "kill:replica=1,at=50%",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        s = rec["serve"]
+        assert s["mode"] == "fleet_fault_ab"
+        assert s["by_state"] == {"finished": 6}
+        f = s["fleet"]
+        assert f["transport"] == "process"
+        assert f["rpc_ms"]["calls"] > 0
+        assert f["rpc_ms"]["p50"] is not None
+        assert f["rpc_ms"]["p99"] is not None
+        assert f["incidents_by_class"] == {"crashed": 1}
+        inc = f["incidents"][0]
+        assert inc["category"] == "crashed" and inc["code"] == -9
+        ab = s["fleet_ab"]
+        assert ab["redispatch_pin"]["identical"] is True
+        assert ab["redispatch_pin"]["compared"] == 6
+        # both A/B sides stamp the transport evidence
+        assert ab["clean"]["fleet"]["transport"] == "process"
+        assert ab["clean"]["fleet"]["rpc_ms"]["calls"] > 0
+        assert rec["config"]["fleet"]["transport"] == "process"
+        from tools.perf_summary import fleet_cell
+
+        cell = fleet_cell(rec)
+        assert "proc" in cell and "rpc" in cell
+        # no zombie/orphan workers survive the bench process (scoped:
+        # only NEW pids count — a concurrent job's workers are not
+        # this bench's leak)
+        leaked = worker_pids() - pre
+        assert not leaked, leaked
+
     def test_fleet_clean_record_contract(self):
         p = _run("serve_bench.py", *TINY, "--fleet", "2",
                  "--pin-exact", "--require-finished")
@@ -200,6 +246,17 @@ def test_fleet_cell_renders_synthetic_record():
     }}
     cell = fleet_cell(rec)
     assert cell == "2r crashed1,stalled2 rd3/10tok det 0.8s shed2 f/c 2.07"
+    # process-transport records grow the proc tag + rpc overhead pair;
+    # inproc records tag without rpc; pre-transport records (above)
+    # stay untagged.
+    proc = {"serve": {"fleet": {
+        "replicas": 2, "transport": "process",
+        "rpc_ms": {"calls": 10, "p50": 0.3, "p99": 2.1},
+        "incidents_by_class": {"crashed": 1}, "redispatched": 1,
+        "tokens_recomputed": 4}}}
+    assert fleet_cell(proc) == "2r proc rpc 0.3/2.1ms crashed1 rd1/4tok"
+    inp = {"serve": {"fleet": {"replicas": 2, "transport": "inproc"}}}
+    assert fleet_cell(inp) == "2r inproc"
 
 
 class TestDecodeBenchSatellites:

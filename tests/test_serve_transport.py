@@ -1,0 +1,285 @@
+"""Frame codec + RPC client property/fuzz tests (serve/transport.py).
+
+The contract under test: EVERY way the wire can be corrupted —
+truncated frames (kill mid-write), bit flips, duplicated replies,
+interleaved streams, garbage lengths, silent peers — resolves as a
+typed :class:`TransportError` subclass within the deadline. Never a
+hang (every receive is deadline-bounded — the HVD011 shape), never a
+mis-parsed payload (magic + length bound + CRC32 + strict JSON).
+
+All in-process over socketpairs / thread-served Unix sockets: this is
+the FAST half of the transport story; tests/test_serve_worker.py
+drives the same codec through real worker processes.
+"""
+
+import json
+import os
+import random
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
+                                         DeadlineExceeded, FrameError,
+                                         HEADER_LEN, MAX_FRAME,
+                                         RemoteCallError, RpcClient,
+                                         TransportError, encode_frame,
+                                         recv_frame, send_frame,
+                                         serve_connection)
+
+
+def _pair():
+    return socket.socketpair()
+
+
+def _deadline(s=0.5):
+    return time.monotonic() + s
+
+
+class TestFrameCodec:
+    def test_roundtrip_property(self):
+        rng = random.Random(0)
+        payloads = [
+            {}, [], 0, "x", None, True,
+            {"tokens": list(range(500)), "nested": {"a": [1.5, None]}},
+            {"s": "ué€" * 100},
+            [rng.randint(-2**31, 2**31) for _ in range(200)],
+        ]
+        a, b = _pair()
+        for obj in payloads:
+            send_frame(a, obj, _deadline())
+            out = recv_frame(b, _deadline())
+            assert out == json.loads(json.dumps(obj))
+
+    def test_every_truncation_is_typed_never_a_value(self):
+        """Kill-mid-write, exhaustively: every proper prefix of a valid
+        frame must raise a typed TransportError (torn frame, or
+        deadline while waiting for the missing tail) — never parse."""
+        frame = encode_frame({"req": list(range(40))})
+        for cut in range(len(frame)):
+            a, b = _pair()
+            a.sendall(frame[:cut])
+            a.close()   # writer died mid-write
+            with pytest.raises((FrameError, ConnectionLost)) as ei:
+                recv_frame(b, _deadline(0.2))
+            if cut == 0:
+                assert isinstance(ei.value, ConnectionLost)
+            else:
+                assert isinstance(ei.value, FrameError)
+            b.close()
+
+    def test_every_header_bit_flip_is_typed(self):
+        frame = bytearray(encode_frame({"x": 1}))
+        for byte in range(HEADER_LEN):
+            for bit in range(8):
+                mutated = bytearray(frame)
+                mutated[byte] ^= 1 << bit
+                a, b = _pair()
+                a.sendall(bytes(mutated))
+                a.close()
+                with pytest.raises(TransportError):
+                    recv_frame(b, _deadline(0.15))
+                b.close()
+
+    def test_payload_bit_flips_fail_checksum(self):
+        frame = bytearray(encode_frame({"tokens": list(range(64))}))
+        rng = random.Random(1)
+        for _ in range(32):
+            pos = rng.randrange(HEADER_LEN, len(frame))
+            mutated = bytearray(frame)
+            mutated[pos] ^= 1 << rng.randrange(8)
+            a, b = _pair()
+            a.sendall(bytes(mutated))
+            with pytest.raises(ChecksumError):
+                recv_frame(b, _deadline(0.2))
+            a.close()
+            b.close()
+
+    def test_interleaved_frames_are_typed(self):
+        """Two frames' bytes interleaved (a half-duplex writer bug, or
+        two writers on one socket) desynchronize the stream — bad
+        magic, never a silent mis-parse."""
+        f1, f2 = encode_frame({"a": 1}), encode_frame({"b": 2})
+        mixed = b"".join(bytes([x, y]) for x, y in zip(f1, f2))
+        a, b = _pair()
+        a.sendall(mixed)
+        with pytest.raises(FrameError, match="magic"):
+            recv_frame(b, _deadline(0.2))
+        a.close()
+        b.close()
+
+    def test_oversized_length_is_rejected_not_allocated(self):
+        import struct
+        import zlib
+
+        from horovod_tpu.serve import transport as T
+
+        bad = T._HEADER.pack(T.MAGIC, MAX_FRAME + 1, zlib.crc32(b""))
+        a, b = _pair()
+        a.sendall(bad)
+        with pytest.raises(FrameError, match="MAX_FRAME"):
+            recv_frame(b, _deadline(0.2))
+        a.close()
+        b.close()
+        assert struct is not None   # keep the import explicit
+
+    def test_oversized_payload_refused_at_encode(self):
+        with pytest.raises(FrameError, match="MAX_FRAME"):
+            encode_frame({"x": "a" * (MAX_FRAME + 1)})
+
+    def test_slow_trickle_inside_deadline_succeeds(self):
+        """Deadline-sliced reads must still assemble a frame that
+        arrives in dribs within the budget."""
+        frame = encode_frame({"ok": True})
+        a, b = _pair()
+
+        def trickle():
+            for i in range(0, len(frame), 5):
+                a.sendall(frame[i:i + 5])
+                time.sleep(0.01)
+
+        t = threading.Thread(target=trickle)
+        t.start()
+        assert recv_frame(b, _deadline(2.0)) == {"ok": True}
+        t.join()
+        a.close()
+        b.close()
+
+    def test_mid_frame_silence_hits_deadline(self):
+        frame = encode_frame({"x": list(range(100))})
+        a, b = _pair()
+        a.sendall(frame[:HEADER_LEN + 3])   # header + a dribble, then silence
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            recv_frame(b, _deadline(0.3))
+        assert time.monotonic() - t0 < 2.0   # bounded, no hang
+        a.close()
+        b.close()
+
+
+class _FakeServer:
+    """Thread-served Unix socket with a scriptable reply behavior."""
+
+    def __init__(self, behavior):
+        self.path = os.path.join(tempfile.mkdtemp(prefix="hvd-tsp-"),
+                                 "srv.sock")
+        self._behavior = behavior
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(self.path)
+        self._srv.listen(1)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            conn, _ = self._srv.accept()
+        except OSError:
+            return
+        with conn:
+            self._behavior(conn)
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TestRpcClient:
+    def test_duplicated_reply_id_mismatch_is_typed(self):
+        """A duplicated (stale) reply frame must be rejected by the id
+        check — the RPC layer's defense for corruption the codec can't
+        see (the bytes themselves are valid frames)."""
+
+        def behavior(conn):
+            req = recv_frame(conn, time.monotonic() + 2)
+            stale = encode_frame({"id": req["id"] + 41, "ok": True,
+                                  "result": None})
+            conn.sendall(stale)
+
+        srv = _FakeServer(behavior)
+        c = RpcClient(srv.path, default_timeout=2.0)
+        with pytest.raises(FrameError, match="interleaved|duplicated"):
+            c.call("ping")
+        assert not c.connected   # client closed itself: no reuse
+        srv.close()
+
+    def test_silent_server_hits_deadline(self):
+        def behavior(conn):
+            recv_frame(conn, time.monotonic() + 5)
+            time.sleep(5)   # accept, read, never answer
+
+        srv = _FakeServer(behavior)
+        c = RpcClient(srv.path, default_timeout=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            c.call("step")
+        assert time.monotonic() - t0 < 2.0
+        srv.close()
+
+    def test_half_written_reply_is_torn_frame(self):
+        def behavior(conn):
+            req = recv_frame(conn, time.monotonic() + 2)
+            frame = encode_frame({"id": req["id"], "ok": True,
+                                  "result": {"big": list(range(100))}})
+            conn.sendall(frame[:len(frame) // 2])
+            # die mid-write
+
+        srv = _FakeServer(behavior)
+        c = RpcClient(srv.path, default_timeout=1.0)
+        with pytest.raises(FrameError, match="torn"):
+            c.call("collect")
+        srv.close()
+
+    def test_no_listener_dead_proc_fails_fast(self):
+        c = RpcClient("/tmp/does-not-exist-hvd.sock",
+                      default_timeout=5.0, proc_alive=lambda: False)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionLost, match="startup"):
+            c.call("ping")
+        assert time.monotonic() - t0 < 1.0   # no 5 s retry spin
+
+    def test_no_listener_live_proc_waits_out_deadline(self):
+        c = RpcClient("/tmp/does-not-exist-hvd.sock",
+                      default_timeout=0.2, proc_alive=lambda: True)
+        with pytest.raises(DeadlineExceeded):
+            c.call("ping")
+
+    def test_connect_timeout_caps_first_connect(self):
+        """FleetConfig.spawn_timeout's wire: a worker that never binds
+        fails at min(connect_timeout, rpc_deadline), not after the
+        full generous per-RPC budget."""
+        c = RpcClient("/tmp/does-not-exist-hvd.sock",
+                      default_timeout=60.0, connect_timeout=0.2,
+                      proc_alive=lambda: True)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            c.call("ping")
+        assert time.monotonic() - t0 < 2.0
+
+    def test_remote_handler_error_is_typed(self):
+        def behavior(conn):
+            serve_connection(conn, lambda m, p: (_ for _ in ()).throw(
+                ValueError("engine exploded")), idle_timeout=2.0)
+
+        srv = _FakeServer(behavior)
+        c = RpcClient(srv.path, default_timeout=2.0)
+        with pytest.raises(RemoteCallError, match="engine exploded"):
+            c.call("step")
+        srv.close()
+
+    def test_call_ms_samples_accumulate(self):
+        def behavior(conn):
+            serve_connection(conn, lambda m, p: {"pong": True},
+                             idle_timeout=2.0)
+
+        srv = _FakeServer(behavior)
+        samples = []
+        c = RpcClient(srv.path, default_timeout=2.0, call_ms=samples)
+        for _ in range(3):
+            assert c.call("ping") == {"pong": True}
+        assert len(samples) == 3 and all(s >= 0 for s in samples)
+        srv.close()

@@ -1,0 +1,443 @@
+"""Cross-process serving fleet (serve/worker.py + fleet transport=process).
+
+Two lanes over the SAME fleet code paths:
+
+* **stub lane (fast)** — real OS processes speaking the real framed
+  protocol, but the worker is tests/serve_stub_worker.py (launched
+  ``python -S``, ~30 ms start, no jax): covers the whole recovery
+  matrix — genuine SIGKILL + reap + classification, torn-frame
+  kill-mid-write, RPC deadline expiry, watchdog-caught stalls,
+  close() escalation on a wedged worker, startup crashes — with the
+  stub's context-hash "model" standing in for greedy decoding (next
+  token depends on the full context, so redispatch continuation is
+  bit-exact for the same reason it is on the real engine);
+* **real-worker lane (slow)** — ``python -m horovod_tpu.serve.worker``
+  end to end: greedy streams pinned BIT-IDENTICAL to ``lm_decode``
+  across a real mid-run SIGKILL, a watchdog-classified stall, and a
+  worker killed mid-write of a collect reply.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serve import (FleetConfig, ProcessReplica, ServeConfig,
+                               ServeFleet)
+from tests.serve_stub_worker import VOCAB, expected_stream
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STUB = os.path.join(HERE, "serve_stub_worker.py")
+
+#: The stub never touches the params/engine; the fleet only reads
+#: Lmax (admission geometry) off this.
+STUB_PARAMS = {"pos": np.zeros((64, 4), np.float32)}
+
+
+def _stub_cmd(extra_env=None, extra_args=(), per_rid_env=None):
+    """worker_cmd hook launching the protocol stub with ``python -S``
+    (no site-packages, no sitecustomize jax import — ~30 ms).
+    ``per_rid_env`` applies to a replica's FIRST incarnation only —
+    fault hooks must not re-fire on the relaunched worker."""
+
+    def cmd(rid, sock_path, default):
+        dcmd, denv = default
+        hb_dir = dcmd[dcmd.index("--heartbeat-dir") + 1]
+        argv = [sys.executable, "-S", STUB, "--socket", sock_path,
+                "--rank", str(rid), "--heartbeat-dir", hb_dir,
+                "--slots", "2"] + list(extra_args)
+        env = dict(denv)
+        env.update(extra_env or {})
+        if f"r{rid}-1.sock" in sock_path:
+            env.update((per_rid_env or {}).get(rid, {}))
+        return argv, env
+
+    return cmd
+
+
+def _stub_fleet(worker_cmd=None, **fleet_kw):
+    fleet_kw.setdefault("replicas", 2)
+    fleet_kw.setdefault("transport", "process")
+    fleet_kw.setdefault("backoff_base", 0.01)
+    fleet_kw.setdefault("rpc_deadline", 10.0)
+    return ServeFleet(STUB_PARAMS,
+                      ServeConfig(page_size=8, num_pages=32,
+                                  decode_slots=2, prefill_chunk=4),
+                      FleetConfig(**fleet_kw),
+                      worker_cmd=worker_cmd or _stub_cmd())
+
+
+def _prompts(n, base=3):
+    return [list(range(base + i, base + i + 4 + i % 3)) for i in range(n)]
+
+
+def _assert_reaped(fl):
+    for rep in fl.replicas:
+        assert isinstance(rep, ProcessReplica)
+        assert rep.proc.poll() is not None, (
+            f"replica {rep.id} pid {rep.proc.pid} not reaped (zombie)")
+
+
+def _run_until(fl, reqs, timeout=30.0):
+    t0 = time.monotonic()
+    while not fl.idle and time.monotonic() - t0 < timeout:
+        fl.run(max_steps=fl.steps + 50)
+        if not fl.idle:
+            time.sleep(0.01)
+    assert fl.idle, [r.state for r in reqs]
+
+
+class TestStubFleet:
+    def test_clean_run_streams_exact_and_close_reaps(self):
+        fl = _stub_fleet()
+        try:
+            prompts = _prompts(5)
+            reqs = [fl.submit(np.asarray(p, np.int32), 4 + i % 3)
+                    for i, p in enumerate(prompts)]
+            _run_until(fl, reqs)
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, r.orig_max_new)
+            f = fl.stats()["fleet"]
+            assert f["transport"] == "process"
+            assert f["rpc_ms"]["calls"] > 0
+            assert f["rpc_ms"]["p50"] is not None
+            assert f["transport_incidents"] == {}
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+        fl.close()   # idempotent
+
+    def test_real_sigkill_classified_and_redispatched_exact(self):
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"]))   # slow ticks: kill mid-run
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 8)
+                    for p in prompts]
+            for _ in range(4):
+                fl.step()
+            victim = fl.replicas[1]
+            pid = victim.proc.pid
+            fl.arm_fault_plan("kill:replica=1,at=0s")
+            _run_until(fl, reqs)
+            # the fault was a GENUINE SIGKILL of a real OS process
+            assert victim.proc.poll() == -signal.SIGKILL or \
+                fl.incidents[0]["code"] == -signal.SIGKILL
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"crashed": 1}
+            assert f["incidents"][0]["code"] == -signal.SIGKILL
+            assert f["redispatched"] >= 1
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                # at-most-once + bit-exact continuation across the kill
+                assert r.output == expected_stream(p, 8), (
+                    pid, r.redispatches, r.output)
+            assert any(r.redispatches for r in reqs)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_torn_frame_mid_write_routed_to_drain(self):
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"],
+            per_rid_env={1: {"HVD_SERVE_WORKER_TORN_COLLECT_AFTER": "4"}}))
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 8)
+                    for p in prompts]
+            _run_until(fl, reqs)
+            f = fl.stats()["fleet"]
+            # exactly one torn-frame incident, classified through the
+            # real reaped exit code (the stub os._exit(1)s mid-write)
+            assert f["transport_incidents"].get("FrameError") == 1, f
+            assert f["incidents_by_class"] == {"crashed": 1}
+            assert f["incidents"][0]["transport_error"] == "FrameError"
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, 8)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_rpc_deadline_expiry_is_replica_death(self):
+        """A worker that never comes up (startup sleep >> deadline)
+        resolves as DeadlineExceeded -> death path -> budget -> failed
+        fleet sheds, inside the deadline budget — never a hang."""
+        fl = _stub_fleet(replicas=1, max_restarts=0, rpc_deadline=0.4,
+                         spawn_timeout=0.4,
+                         worker_cmd=_stub_cmd(
+                             extra_args=["--startup-delay", "30"]))
+        try:
+            r = fl.submit(np.asarray([1, 2, 3], np.int32), 4)
+            t0 = time.monotonic()
+            while fl.alive and time.monotonic() - t0 < 10:
+                fl.step()
+                time.sleep(0.01)
+            assert not fl.alive
+            assert time.monotonic() - t0 < 10
+            f = fl.stats()["fleet"]
+            assert f["transport_incidents"].get("DeadlineExceeded") == 1
+            assert r.state == "rejected" and \
+                r.reject_reason == "overloaded"
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_startup_crash_classified_before_first_heartbeat(self):
+        """The troubleshooting-entry shape: a worker that dies on
+        startup (before bind, before any heartbeat) is classified
+        crashed via its real exit code and consumes restart budget."""
+        fl = _stub_fleet(replicas=1, max_restarts=1,
+                         worker_cmd=_stub_cmd(
+                             extra_env={"HVD_SERVE_WORKER_FAIL_START":
+                                        "3"}))
+        try:
+            r = fl.submit(np.asarray([1, 2, 3], np.int32), 4)
+            t0 = time.monotonic()
+            while fl.alive and time.monotonic() - t0 < 20:
+                fl.step()
+                time.sleep(0.01)
+            f = fl.stats()["fleet"]
+            # the initial spawn AND the budgeted relaunch both crash
+            assert f["incidents_by_class"] == {"crashed": 2}, f
+            assert all(i["code"] == 3 for i in f["incidents"])
+            assert f["failed"] == 1
+            assert f["restarts_used"] == 1
+            assert r.state == "rejected"
+            # no heartbeat was ever written for the dead incarnations
+            assert not any(n.startswith("hb-") for n in
+                           os.listdir(fl.heartbeat_dir))
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_stall_watchdog_kills_and_relaunches(self):
+        """A stalled WORKER PROCESS stops stepping and heartbeating
+        while its RPC thread stays up: only the stale heartbeat — the
+        real PR-9 HealthWatchdog — catches it, classified stalled."""
+        fl = _stub_fleet(watchdog_timeout=0.6,
+                         worker_cmd=_stub_cmd(
+                             extra_args=["--tick-s", "0.01"]))
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 12)
+                    for p in prompts]
+            for _ in range(3):
+                fl.step()
+            fl.arm_fault_plan("stall:replica=0,at=0s")
+            _run_until(fl, reqs, timeout=30.0)
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"stalled": 1}, f
+            assert f["detect_s"] is not None and f["detect_s"] >= 0.6
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, 12)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_close_reaps_a_wedged_worker(self):
+        """The shutdown-hardening satellite: close() must reap a
+        replica whose engine loop is genuinely wedged by a stall fault
+        (graceful RPC first, SIGTERM -> SIGKILL escalation if needed),
+        leave no zombies, and be idempotent."""
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.01"]))
+        try:
+            reqs = [fl.submit(np.asarray([1, 2, 3], np.int32), 50)]
+            for _ in range(3):
+                fl.step()
+            fl.arm_fault_plan("stall:replica=0,at=0s")
+            for _ in range(3):
+                fl.step()
+            time.sleep(0.1)   # let the wedge take hold
+            assert reqs[0].state != "finished"
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+        fl.close()   # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            fl.step()
+
+    def test_constructor_spawn_failure_reaps_partial_fleet(self):
+        """A failed spawn mid-__init__ must not orphan the worker
+        processes already running (close() is unreachable when the
+        constructor raises)."""
+        spawned = []
+        base = _stub_cmd()
+
+        def cmd(rid, sock_path, default):
+            if rid == 1:
+                raise OSError("no such worker binary")
+            argv, env = base(rid, sock_path, default)
+            spawned.append(sock_path)
+            return argv, env
+
+        with pytest.raises(OSError, match="no such worker binary"):
+            _stub_fleet(worker_cmd=cmd)
+        assert spawned   # replica 0 really was launched first
+        # ...and its process did not outlive the failed constructor
+        import subprocess
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            # exec form: pgrep excludes itself (a shell wrapper would
+            # self-match on the pattern in its own cmdline)
+            ps = subprocess.run(["pgrep", "-f", "serve_stub_worker.py"],
+                                capture_output=True, text=True)
+            live = ps.stdout.split()
+            if not live:
+                break
+            time.sleep(0.05)
+        assert not live, live
+
+    def test_slow_fault_rides_the_rpc(self):
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.01"]))
+        try:
+            fl.arm_fault_plan("slow:replica=0,at=0s,factor=3")
+            reqs = [fl.submit(np.asarray([5, 6, 7], np.int32), 4)]
+            _run_until(fl, reqs)
+            assert reqs[0].output == expected_stream([5, 6, 7], 4)
+            assert fl.stats()["fleet"]["incidents_by_class"] == {}
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+
+# ---------------------------------------------------------------- real
+
+
+def _lm_setup():
+    import jax
+
+    from horovod_tpu.models import parallel_lm as plm
+
+    V, LMAX = 64, 64
+    params = plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX, 2, 2,
+                                8, 32)
+    cfg = ServeConfig(page_size=8, num_pages=32, decode_slots=2,
+                      prefill_chunk=4)
+    return params, cfg, V
+
+
+def _lm_ref(params, prompt, steps):
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import parallel_lm as plm
+
+    return list(np.asarray(
+        plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
+
+
+def _lm_prompts(v, n):
+    import jax
+
+    return [np.asarray(jax.random.randint(
+        jax.random.fold_in(jax.random.PRNGKey(100), i), (8 + i,), 0, v),
+        np.int32) for i in range(n)]
+
+
+def _warm(fl):
+    for _ in range(len(fl.replicas)):
+        fl.submit(np.asarray([1, 2], np.int32), 2)
+    fl.run()
+    fl.reset_metrics()
+
+
+class TestRealWorkerE2E:
+    """python -m horovod_tpu.serve.worker end to end (slow: each worker
+    spawn pays the sitecustomize jax import + first-step compile)."""
+
+    def test_kill_redispatch_bit_exact_vs_lm_decode(self):
+        params, cfg, V = _lm_setup()
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=2, transport="process",
+                                    backoff_base=0.01),
+                        worker_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            _warm(fl)
+            prompts = _lm_prompts(V, 6)
+            reqs = [fl.submit(p, 10) for p in prompts]
+            for _ in range(4):
+                fl.step()
+            fl.arm_fault_plan("kill:replica=1,at=0s")
+            fl.run()
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"crashed": 1}
+            assert f["incidents"][0]["code"] == -signal.SIGKILL
+            assert f["transport"] == "process"
+            assert f["rpc_ms"]["p50"] is not None
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == _lm_ref(params, p, 10)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_stall_watchdog_classified_relaunch(self):
+        params, cfg, V = _lm_setup()
+        # The watchdog timeout must exceed the worst single worker
+        # tick INCLUDING a compile (docs/serving.md "Process fleet").
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=2, transport="process",
+                                    backoff_base=0.01,
+                                    watchdog_timeout=8.0),
+                        worker_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            _warm(fl)
+            prompts = _lm_prompts(V, 4)
+            reqs = [fl.submit(p, 16) for p in prompts]
+            for _ in range(3):
+                fl.step()
+            fl.arm_fault_plan("stall:replica=0,at=0s")
+            fl.run()
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"stalled": 1}, f
+            assert f["detect_s"] >= 8.0
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == _lm_ref(params, p, 16)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_kill_mid_write_torn_frame_redispatch_exact(self):
+        """The satellite's e2e pin: a worker killed MID-WRITE of a
+        collect reply leaves half a frame on the wire; the codec
+        detects it (typed FrameError, no hang, no mis-parse), the
+        fleet drains + redispatches, and every greedy stream is still
+        bit-identical to lm_decode."""
+        params, cfg, V = _lm_setup()
+
+        def cmd(rid, sock_path, default):
+            argv, env = default
+            if rid == 1 and "r1-1" in sock_path:   # first incarnation
+                env = dict(env,
+                           HVD_SERVE_WORKER_TORN_COLLECT_AFTER="12")
+            return argv, env
+
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=2, transport="process",
+                                    backoff_base=0.01),
+                        worker_env={"JAX_PLATFORMS": "cpu"},
+                        worker_cmd=cmd)
+        try:
+            _warm(fl)
+            prompts = _lm_prompts(V, 6)
+            reqs = [fl.submit(p, 20) for p in prompts]
+            fl.run()
+            f = fl.stats()["fleet"]
+            assert f["transport_incidents"].get("FrameError") == 1, f
+            assert f["incidents_by_class"] == {"crashed": 1}
+            assert f["incidents"][0]["transport_error"] == "FrameError"
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == _lm_ref(params, p, 20)
+        finally:
+            fl.close()
+        _assert_reaped(fl)

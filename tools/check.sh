@@ -50,9 +50,19 @@
 #                               (`python -m tools.hvdverify --sweep`): all
 #                               registry programs incl. the 9 driver gate
 #                               lanes traced at zero unsuppressed findings
+#                               + the process-fleet smoke (the round-13
+#                               tentpole: the same 2-replica kill A/B
+#                               with --fleet-transport process — each
+#                               replica its own worker OS process behind
+#                               the deadline-checked RPC transport, the
+#                               kill a genuine SIGKILL classified from
+#                               the reaped exit code, per-RPC overhead
+#                               stamped, and ZERO surviving worker
+#                               processes asserted after exit)
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --no-serve   skip the serving smoke
 #   tools/check.sh --no-fleet   skip the fleet smoke
+#   tools/check.sh --no-fleet-proc  skip the process-fleet smoke
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -67,6 +77,7 @@ SANITIZE=0
 ELASTIC=1
 SERVE=1
 FLEET=1
+FLEET_PROC=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -75,9 +86,10 @@ for arg in "$@"; do
     --no-elastic) ELASTIC=0 ;;
     --no-serve) SERVE=0 ;;
     --no-fleet) FLEET=0 ;;
+    --no-fleet-proc) FLEET_PROC=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -159,6 +171,51 @@ print("fleet smoke: kill mid-run -> %d request(s) redispatched "
           f["redispatched"], f["tokens_recomputed"],
           ab["faulted_over_clean_p99_ttft"]))
 '
+fi
+
+if [[ "$FLEET_PROC" == "1" ]]; then
+  echo "== process-fleet smoke (2 worker OS processes, real SIGKILL of replica 1 mid-run: redispatch pin-exact, no zombies) =="
+  # Only NEW worker pids count as leaks — a concurrent job's fleet on
+  # this host is not this smoke's zombie.
+  PRE_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  FLEETP_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 200 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --fleet 2 --fleet-transport process \
+    --fault-plan "kill:replica=1,at=50%" \
+    --pin-exact --require-finished)
+  echo "$FLEETP_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "fleet_fault_ab", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+f = s["fleet"]
+assert f["transport"] == "process", f["transport"]
+# the fault was a REAL SIGKILL of a worker OS process, classified
+# through the PR-9 taxonomy from the reaped exit code
+assert f["incidents_by_class"] == {"crashed": 1}, f["incidents_by_class"]
+assert f["incidents"][0]["code"] == -9, f["incidents"]
+assert f["redispatched"] >= 1, f
+assert f["failed"] == 0, f
+assert f["rpc_ms"]["calls"] > 0 and f["rpc_ms"]["p50"] is not None, f
+ab = s["fleet_ab"]
+assert ab["redispatch_pin"]["identical"] is True
+assert ab["redispatch_pin"]["compared"] == 8, ab["redispatch_pin"]
+print("process-fleet smoke: real SIGKILL -> crashed(code -9), "
+      "%d redispatched, all 8 pin-exact, rpc p50/p99 %s/%s ms" % (
+          f["redispatched"], f["rpc_ms"]["p50"], f["rpc_ms"]["p99"]))
+'
+  # the no-zombie assert: ps must show zero NEW surviving workers
+  POST_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  LEAKED=$(comm -13 <(echo "$PRE_WORKERS" | sort) <(echo "$POST_WORKERS" | sort) | tr -d '[:space:]')
+  if [[ -n "$LEAKED" ]]; then
+    echo "process-fleet smoke: ORPHANED worker processes survive:" >&2
+    pgrep -af "horovod_tpu.serve.worker" >&2
+    exit 1
+  fi
+  echo "process-fleet smoke: zero surviving worker processes"
 fi
 
 if [[ "$HIER" == "1" ]]; then

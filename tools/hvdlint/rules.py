@@ -869,6 +869,121 @@ def check_hvd010(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD011
+
+#: Method names that are ALWAYS a blocking network receive (socket
+#: API); these fire regardless of what the receiver is called.
+RECEIVE_CALL_NAMES = {"recv", "recvfrom", "recv_into", "recvmsg"}
+
+#: Stream-read spellings that are only a hang risk on a socket/pipe —
+#: gated on the receiver's name so ordinary file ``f.read()`` stays
+#: silent.
+STREAM_READ_NAMES = {"read", "readline", "readlines"}
+
+#: Receiver-name substrings that mark a read target as a socket/pipe/
+#: stream (``sock.recv``, ``conn.makefile().readline``,
+#: ``proc.stdout.readline``, ...).
+STREAM_RECEIVER_MARKERS = (
+    "sock", "conn", "pipe", "chan", "stream", "fifo", "stdout", "stderr",
+)
+
+#: Identifier substrings that mark a deadline/timeout in scope.
+DEADLINE_NAME_MARKERS = ("timeout", "deadline")
+
+#: Calls that bound a read some other way (socket timeouts, readiness
+#: polling).
+DEADLINE_CALL_NAMES = {"settimeout", "setdefaulttimeout", "setblocking",
+                       "select", "poll"}
+
+
+def _own_scope_nodes(fn: ast.AST) -> List[ast.AST]:
+    """The function's OWN body nodes, excluding nested def/lambda
+    bodies (a nested function's reads block in ITS scope — each def is
+    judged on its own deadline discipline)."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def check_hvd011(tree: ast.AST) -> List[RawFinding]:
+    """Blocking ``recv``/``read``/``readline`` on a socket or pipe with
+    no timeout/deadline in scope — the silent-hang shape.
+
+    A receive with no bound hangs FOREVER when the peer dies mid-write
+    or simply stops: the reader blocks in the kernel, no exception, no
+    heartbeat, nothing for a watchdog to classify — the exact failure
+    the serving-fleet transport (horovod_tpu/serve/transport.py, every
+    recv deadline-sliced) and the launcher wire
+    (run/network.py ``Wire.read(timeout=)``) were built to never have.
+    Flagged: a call whose attribute name is a socket receive
+    (``recv``/``recvfrom``/...; always) or a stream read
+    (``read``/``readline`` on a receiver whose name says socket/pipe:
+    ``sock``, ``conn``, ``pipe``, ``stdout``, ...), inside a function
+    with NO deadline discipline in scope. Silencers (either): an
+    identifier containing ``timeout``/``deadline`` anywhere in the
+    function (parameter, local, attribute, keyword), or a bounding
+    call (``settimeout``/``select``/``poll``/...). A justified
+    unbounded read (a daemon pump thread draining a child's stdout)
+    suppresses with a comment explaining why it may block forever.
+    """
+    findings: List[RawFinding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        nodes = _own_scope_nodes(fn)
+        sig_names = [a.arg for a in fn.args.args
+                     + fn.args.kwonlyargs
+                     + ([fn.args.vararg] if fn.args.vararg else [])
+                     + ([fn.args.kwarg] if fn.args.kwarg else [])]
+        idents = set(sig_names)
+        bounded = False
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                idents.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr)
+            elif isinstance(n, ast.keyword) and n.arg:
+                idents.add(n.arg)
+            elif isinstance(n, ast.Call) and \
+                    trailing_name(n.func) in DEADLINE_CALL_NAMES:
+                bounded = True
+        if bounded or any(m in i.lower() for i in idents
+                          for m in DEADLINE_NAME_MARKERS):
+            continue
+        for call in nodes:
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)):
+                continue
+            name = call.func.attr
+            if name in RECEIVE_CALL_NAMES:
+                shape = f"socket {name}()"
+            elif name in STREAM_READ_NAMES:
+                recv_name = trailing_name(call.func.value) or ""
+                if not any(m in recv_name.lower()
+                           for m in STREAM_RECEIVER_MARKERS):
+                    continue
+                shape = f"{recv_name}.{name}()"
+            else:
+                continue
+            findings.append(RawFinding(
+                call.lineno, call.col_offset, "HVD011", "error",
+                f"blocking {shape} with no timeout/deadline in scope: "
+                "a peer that dies mid-write (or stops sending) hangs "
+                "this reader forever — silently, with nothing for a "
+                "watchdog to classify; bound every receive (the "
+                "serve/transport.py deadline discipline, or "
+                "settimeout/select) or suppress with the reason the "
+                "read may legitimately block forever"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -880,4 +995,5 @@ RULES = {
     "HVD008": check_hvd008,
     "HVD009": check_hvd009,
     "HVD010": check_hvd010,
+    "HVD011": check_hvd011,
 }

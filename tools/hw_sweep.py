@@ -126,6 +126,20 @@ LANES = [
                               "--new-max", "256", "--fleet", "2",
                               "--fault-plan", "kill:replica=1,at=40%",
                               "--require-finished"]),
+    # Process-transport fleet A/B (round-13 tentpole, horovod_tpu/
+    # serve/{transport,worker}.py): the SAME workload and fault plan,
+    # but each replica is its own worker OS process behind the framed
+    # RPC transport — the kill is a genuine SIGKILL of a real process,
+    # classified through its reaped exit code, and serve.fleet stamps
+    # transport="process" + per-RPC overhead p50/p99 + transport
+    # incident counts beside the inproc lane above, so the record pair
+    # prices exactly what crash isolation costs.
+    ("serve_fleet_proc_ab", ["tools/serve_bench.py", "--requests", "64",
+                             "--rate", "8", "--new-min", "16",
+                             "--new-max", "256", "--fleet", "2",
+                             "--fleet-transport", "process",
+                             "--fault-plan", "kill:replica=1,at=40%",
+                             "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

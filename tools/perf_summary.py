@@ -187,11 +187,14 @@ def serve_cell(rec):
 
 def fleet_cell(rec):
     """Compact render of the record's fleet stamps (tools/serve_bench.py
-    --fleet; horovod_tpu/serve/fleet.py): "2r crashed1 rd3/10tok det
-    0.8s shed2 f/c 2.07" = 2 replicas, one crashed incident, 3 requests
-    redispatched (10 KV tokens recomputed), worst stale-heartbeat
-    time-to-detect, 2 requests shed, faulted-over-clean p99 TTFT from
-    the fault A/B. Non-fleet records render as em-dash."""
+    --fleet; horovod_tpu/serve/fleet.py): "2r proc rpc 0.3/2.1ms
+    crashed1 rd3/10tok det 0.8s shed2 f/c 2.07" = 2 replicas on the
+    process transport (per-RPC overhead p50/p99), one crashed incident,
+    3 requests redispatched (10 KV tokens recomputed), worst
+    stale-heartbeat time-to-detect, 2 requests shed, faulted-over-clean
+    p99 TTFT from the fault A/B. Pre-transport records carry no
+    transport key and render untagged (they were inproc); non-fleet
+    records render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -199,6 +202,14 @@ def fleet_cell(rec):
     if not isinstance(f, dict):
         return "—"
     cell = f"{f.get('replicas', '?')}r"
+    transport = f.get("transport")
+    if transport:
+        cell += " " + ("proc" if transport == "process" else "inproc")
+    rpc = f.get("rpc_ms") or {}
+    if rpc.get("p50") is not None:
+        p99 = rpc.get("p99")
+        p99s = f"{p99:g}" if isinstance(p99, (int, float)) else "?"
+        cell += f" rpc {rpc['p50']:g}/{p99s}ms"
     classes = f.get("incidents_by_class") or {}
     if classes:
         cell += " " + ",".join(f"{k}{v}" for k, v in sorted(

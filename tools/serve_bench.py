@@ -48,6 +48,14 @@ Modes:
               redispatched count, KV tokens recomputed, faulted-vs-
               clean p99 TTFT) in ``serve.fleet`` / ``serve.fleet_ab``.
               Exclusive with --ab/--static/--ab-attention.
+  --fleet-transport inproc|process
+              replica placement for the fleet: in this process (fast
+              lane), or one worker OS process per replica behind the
+              deadline-checked framed RPC transport — kill: faults
+              then SIGKILL a REAL process, the incident classifies
+              through the reaped exit code, and ``serve.fleet`` stamps
+              ``transport``, per-RPC overhead p50/p99 (``rpc_ms``) and
+              ``transport_incidents`` on BOTH sides of the fault A/B.
 
 ``--pin-exact`` re-decodes every finished request through
 ``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
@@ -272,6 +280,20 @@ def main() -> int:
     ap.add_argument("--fleet", type=int, default=0,
                     help="run a fault-tolerant N-replica fleet behind "
                          "the least-loaded router (0 = single engine)")
+    ap.add_argument("--fleet-transport", choices=("inproc", "process"),
+                    default="inproc",
+                    help="replica placement: inproc = engines in this "
+                         "process (fast lane); process = one "
+                         "`python -m horovod_tpu.serve.worker` OS "
+                         "process per replica behind the deadline-"
+                         "checked RPC transport (real crash "
+                         "isolation; kill: faults become genuine "
+                         "SIGKILLs and the record stamps per-RPC "
+                         "overhead + transport incidents)")
+    ap.add_argument("--fleet-rpc-deadline", type=float, default=60.0,
+                    help="per-RPC deadline seconds (process transport; "
+                         "must exceed the worst single worker step "
+                         "incl. a relaunch compile)")
     ap.add_argument("--fault-plan", default="",
                     help="serving fault plan for the fleet (e.g. "
                          "'kill:replica=1,at=40%%'); runs clean THEN "
@@ -374,7 +396,9 @@ def main() -> int:
             replicas=args.fleet, max_queue=args.fleet_max_queue,
             max_restarts=args.fleet_max_restarts,
             backoff_base=args.fleet_backoff,
-            watchdog_timeout=args.fleet_watchdog_timeout)
+            watchdog_timeout=args.fleet_watchdog_timeout,
+            transport=args.fleet_transport,
+            rpc_deadline=args.fleet_rpc_deadline)
 
         def fleet_lane(tag, fault_plan=""):
             fl, reqs = run_fleet(params, cfg, fleet_cfg, workload,
@@ -390,7 +414,11 @@ def main() -> int:
                       f"incidents {f['incidents_by_class']}, "
                       f"redispatched {f['redispatched']} "
                       f"({f['tokens_recomputed']} KV tokens recomputed), "
-                      f"shed {f['shed']}", file=sys.stderr, flush=True)
+                      f"shed {f['shed']}, transport {f['transport']}"
+                      + (f" rpc p50/p99 {f['rpc_ms']['p50']}/"
+                         f"{f['rpc_ms']['p99']} ms"
+                         if f.get("rpc_ms") else ""),
+                      file=sys.stderr, flush=True)
                 if args.pin_exact:
                     pin_exact(params, fl)
                 if args.require_finished:
@@ -484,6 +512,7 @@ def main() -> int:
             "requests": args.requests,
             "fleet": ({
                 "replicas": args.fleet,
+                "transport": args.fleet_transport,
                 "max_restarts": args.fleet_max_restarts,
                 "watchdog_timeout": args.fleet_watchdog_timeout,
                 "max_queue": args.fleet_max_queue,
