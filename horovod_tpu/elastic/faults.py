@@ -283,18 +283,37 @@ class FaultInjector:
 # `at` accepts `2.5`, `2.5s`, or `40%` — the percent form resolves
 # against a caller-supplied horizon (tools/serve_bench.py uses the last
 # workload arrival) so one CI plan scales with any bench size.
+#
+# The multi-host fleet (FleetConfig(transport="tcp", hosts=...)) adds
+# HOST addressing — a whole machine as the failure domain:
+#
+#     kill:host=0,at=2.5s          SIGKILL every worker on host 0 (the
+#                                  host-OOM / machine-loss shape): all
+#                                  its replicas drain + redispatch as
+#                                  ONE classified `host_down` incident
+#     partition:host=0,at=50%,secs=2   the host's network goes dark for
+#                                  `secs` (default: forever) via the
+#                                  deterministic injector at the
+#                                  transport seam (serve/netfault.py);
+#                                  connections from before the window
+#                                  come back half-open and reset
+#
+# kill accepts either replica= or host=; partition is host-only (a NIC
+# belongs to a machine); stall/slow stay replica-only (a wedged or slow
+# engine is a process property).
 
-SERVE_KINDS = ("kill", "stall", "slow")
+SERVE_KINDS = ("kill", "stall", "slow", "partition")
 
 
 @dataclasses.dataclass
 class ServeFaultAction:
     kind: str
-    replica: int
+    replica: Optional[int] = None     # replica-addressed actions
     at: Optional[float] = None        # seconds from fleet start
     at_frac: Optional[float] = None   # fraction of the horizon (at=..%)
-    secs: Optional[float] = None      # stall duration; None = forever
+    secs: Optional[float] = None      # stall/partition duration; None = forever
     factor: Optional[float] = None    # slow multiplier (kind="slow")
+    host: Optional[int] = None        # host-addressed actions (tcp fleet)
 
     def __str__(self) -> str:
         if self.at_frac is not None:
@@ -303,12 +322,14 @@ class ServeFaultAction:
             at = f"{self.at:g}s"
         else:
             at = "?"   # invalid (validate() rejects it) — still printable
+        addr = (f"host={self.host}" if self.host is not None
+                else f"replica={self.replica}")
         extra = ""
-        if self.kind == "stall" and self.secs is not None:
+        if self.kind in ("stall", "partition") and self.secs is not None:
             extra = f",secs={self.secs:g}"
         if self.kind == "slow" and self.factor is not None:
             extra = f",factor={self.factor:g}"
-        return f"{self.kind}:replica={self.replica},at={at}{extra}"
+        return f"{self.kind}:{addr},at={at}{extra}"
 
     def validate(self) -> None:
         """Per-action invariants, for actions built in code rather than
@@ -319,9 +340,29 @@ class ServeFaultAction:
         if self.kind not in SERVE_KINDS:
             raise FaultPlanError(
                 f"fault action {self}: kind must be in {SERVE_KINDS}")
-        if self.replica < 0:
+        if self.kind == "partition":
+            if self.host is None or self.replica is not None:
+                raise FaultPlanError(
+                    f"fault action {self}: partition is host-addressed "
+                    "(a NIC belongs to a machine) — use host=, not "
+                    "replica=")
+        elif self.kind == "kill":
+            if (self.replica is None) == (self.host is None):
+                raise FaultPlanError(
+                    f"fault action {self}: kill needs exactly one of "
+                    "replica= or host=")
+        else:   # stall / slow
+            if self.replica is None or self.host is not None:
+                raise FaultPlanError(
+                    f"fault action {self}: {self.kind} is "
+                    "replica-addressed (a wedged or slow engine is a "
+                    "process property) — use replica=, not host=")
+        if self.replica is not None and self.replica < 0:
             raise FaultPlanError(
                 f"fault action {self}: replica must be >= 0")
+        if self.host is not None and self.host < 0:
+            raise FaultPlanError(
+                f"fault action {self}: host must be >= 0")
         if (self.at is None) == (self.at_frac is None):
             raise FaultPlanError(
                 f"fault action {self}: exactly one of at= (seconds) or "
@@ -343,9 +384,10 @@ class ServeFaultAction:
             raise FaultPlanError(
                 f"fault action {self}: factor only applies to slow")
         if self.secs is not None:
-            if self.kind != "stall":
+            if self.kind not in ("stall", "partition"):
                 raise FaultPlanError(
-                    f"fault action {self}: secs only applies to stall")
+                    f"fault action {self}: secs only applies to stall "
+                    "and partition")
             if not self.secs > 0 or math.isnan(self.secs):
                 raise FaultPlanError(
                     f"fault action {self}: secs must be > 0")
@@ -413,37 +455,53 @@ def parse_serve_fault_plan(plan: str) -> List[ServeFaultAction]:
         if not sep or kind not in SERVE_KINDS:
             raise FaultPlanError(
                 f"fault plan clause {clause!r}: expected "
-                f"'<kind>:replica=R,at=T[,...]' with kind in "
-                f"{SERVE_KINDS}")
+                f"'<kind>:replica=R,at=T[,...]' (or host=H for "
+                f"kill/partition) with kind in {SERVE_KINDS}")
         kv = {}
         for pair in rest.split(","):
             key, psep, value = pair.partition("=")
             key = key.strip().lower()
-            if not psep or key not in ("replica", "at", "secs", "factor"):
+            if not psep or key not in ("replica", "host", "at", "secs",
+                                       "factor"):
                 raise FaultPlanError(
                     f"fault plan clause {clause!r}: bad key/value "
-                    f"{pair.strip()!r} (keys: replica, at, secs, factor)")
+                    f"{pair.strip()!r} (keys: replica, host, at, secs, "
+                    "factor)")
             kv[key] = value.strip()
-        if "replica" not in kv or "at" not in kv:
+        if ("replica" not in kv and "host" not in kv) or "at" not in kv:
             raise FaultPlanError(
                 f"fault plan clause {clause!r}: replica= and at= are "
-                "required")
-        try:
-            replica = int(kv["replica"])
-        except ValueError:
-            raise FaultPlanError(
-                f"fault plan clause {clause!r}: replica={kv['replica']!r} "
-                "is not an integer") from None
-        if replica < 0:
-            raise FaultPlanError(
-                f"fault plan clause {clause!r}: replica must be >= 0")
+                "required (host= replaces replica= on kill/partition "
+                "actions)")
+        replica = host = None
+        if "replica" in kv:
+            try:
+                replica = int(kv["replica"])
+            except ValueError:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: "
+                    f"replica={kv['replica']!r} is not an integer"
+                ) from None
+            if replica < 0:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: replica must be >= 0")
+        if "host" in kv:
+            try:
+                host = int(kv["host"])
+            except ValueError:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: host={kv['host']!r} "
+                    "is not an integer") from None
+            if host < 0:
+                raise FaultPlanError(
+                    f"fault plan clause {clause!r}: host must be >= 0")
         at, at_frac = _parse_at(clause, kv["at"])
         secs = factor = None
         if "secs" in kv:
-            if kind != "stall":
+            if kind not in ("stall", "partition"):
                 raise FaultPlanError(
                     f"fault plan clause {clause!r}: secs= only applies "
-                    "to stall actions")
+                    "to stall and partition actions")
             try:
                 secs = float(kv["secs"])
             except ValueError:
@@ -473,7 +531,13 @@ def parse_serve_fault_plan(plan: str) -> List[ServeFaultAction]:
             raise FaultPlanError(
                 f"fault plan clause {clause!r}: factor= only applies to "
                 "slow actions")
-        actions.append(ServeFaultAction(
+        action = ServeFaultAction(
             kind=kind, replica=replica, at=at, at_frac=at_frac,
-            secs=secs, factor=factor))
+            secs=secs, factor=factor, host=host)
+        # The addressing-shape invariants (kill: exactly one of
+        # replica/host; partition: host only; stall/slow: replica
+        # only) live in validate() so hand-built and parsed actions
+        # share one fail-fast contract.
+        action.validate()
+        actions.append(action)
     return actions

@@ -255,6 +255,27 @@ def spawn_worker(cmd: Sequence[str],
                                   else os.environ))
 
 
+def spawn_worker_ssh(host: str, cmd: Sequence[str],
+                     env: Optional[Dict[str, str]] = None
+                     ) -> subprocess.Popen:
+    """Spawn ONE supervised worker on a REMOTE host over ssh — the
+    multi-host lane of :func:`spawn_worker`, used by the serving
+    fleet's ``transport="tcp"`` placement. Reuses the launcher's ssh
+    discipline (:func:`_spawn_ssh`): ``-tt`` forces a pty so killing
+    the returned LOCAL ssh client's process group
+    (:func:`kill_worker` / :func:`terminate_worker`) HUPs the remote
+    process tree — the fail-fast kill works across hosts — and the
+    ``HOROVOD_SECRET`` entry of ``env`` ships over stdin after an
+    echo-off marker, never on the remote argv (world-readable via
+    /proc). Caveat the caller owns: the returned Popen is the ssh
+    CLIENT, so its exit code is the remote command's only when the
+    remote exits normally — a signal-killed remote (or a dead ssh
+    session) reports 255/-signum, and the fleet classifies those from
+    its own evidence instead (docs/serving.md "Multi-host fleet")."""
+    return _spawn_ssh(host, list(cmd),
+                      dict(env if env is not None else os.environ))
+
+
 def kill_worker(proc: subprocess.Popen,
                 timeout: float = 5.0) -> Optional[int]:
     """SIGKILL one worker's process group and reap it (bounded — a
@@ -492,5 +513,6 @@ def run(fn, args: tuple = (), kwargs: Optional[dict] = None, np: int = 1,
 
 __all__ = ["run", "launch_command", "launch_job", "JobResult",
            "WorkerExit", "classify_exit", "LaunchError",
-           "spawn_worker", "kill_worker", "terminate_worker",
+           "spawn_worker", "spawn_worker_ssh", "kill_worker",
+           "terminate_worker",
            "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_RESIZED", "EXIT_USAGE"]

@@ -35,12 +35,55 @@ def make_secret_key() -> bytes:
     return _secrets.token_bytes(32)
 
 
+def _route_probe_ip():
+    """The default-route interface's IP via the UDP-connect trick (no
+    packet is sent — ``connect`` on a datagram socket only selects the
+    route). Returns None instead of raising: on an air-gapped or
+    offline host the kernel has no route to 8.8.8.8 and ``connect``
+    raises ``OSError`` (ENETUNREACH) — that must degrade to the next
+    resolution rung, never kill address discovery."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))  # no traffic: picks the route only
+            return s.getsockname()[0] or None
+    except OSError:
+        return None
+
+
+def _hostname_ips():
+    """Every IPv4 address the hostname resolves to ([] when resolution
+    fails — a bare container with no /etc/hosts entry)."""
+    try:
+        return [info[4][0]
+                for info in socket.getaddrinfo(socket.gethostname(), None,
+                                               socket.AF_INET)]
+    except (socket.gaierror, OSError):
+        return []
+
+
+def advertise_ip() -> str:
+    """The single best address to ADVERTISE a locally-bound service at,
+    with the offline-host fallback chain: default-route interface (the
+    UDP-connect probe) -> hostname resolution (first non-loopback
+    address) -> loopback. Never raises — an air-gapped host where the
+    route probe gets ``OSError`` still resolves (the serving fleet's
+    TCP workers print their advertised endpoint through this)."""
+    ip = _route_probe_ip()
+    if ip:
+        return ip
+    for ip in _hostname_ips():
+        if ip and not ip.startswith("127."):
+            return ip
+    return "127.0.0.1"
+
+
 def candidate_addresses(port: int) -> list:
     """Every plausible ``host:port`` endpoint a service bound on 0.0.0.0
     of this machine can be reached at: loopback, the hostname's
     addresses, and the default-route interface (UDP-connect trick — no
-    packet is sent). The reference's Spark driver enumerated NICs the
-    same way and let tasks probe for the routable subset
+    packet is sent; degrades through :func:`advertise_ip`'s fallback
+    chain on offline hosts). The reference's Spark driver enumerated
+    NICs the same way and let tasks probe for the routable subset
     (spark/__init__.py:33-39,123-140); on a multi-NIC pod only some of
     these are reachable from a given worker, so publish them ALL and let
     the worker probe (:func:`horovod_tpu.run.driver.probe_service`)."""
@@ -50,18 +93,9 @@ def candidate_addresses(port: int) -> list:
         if ip and ip not in ips:
             ips.append(ip)
 
-    try:
-        for info in socket.getaddrinfo(socket.gethostname(), None,
-                                       socket.AF_INET):
-            add(info[4][0])
-    except socket.gaierror:
-        pass
-    try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect(("8.8.8.8", 80))  # no traffic: picks the route only
-            add(s.getsockname()[0])
-    except OSError:
-        pass
+    for ip in _hostname_ips():
+        add(ip)
+    add(advertise_ip())
     return [f"{ip}:{port}" for ip in ips]
 
 

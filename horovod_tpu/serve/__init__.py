@@ -36,14 +36,23 @@ steps without ever recompiling.
   frame protocol over a Unix socket — real crash isolation, with
   every transport failure converted into the fleet's replica-death
   path (typed :class:`~horovod_tpu.serve.transport.TransportError`
-  taxonomy, never an RPC-level retry).
+  taxonomy, never an RPC-level retry);
+* the same frame protocol over TCP (``transport="tcp"``) places
+  workers across HOSTS (``FleetConfig.hosts``, ssh placement, a
+  shared-secret connect handshake): a lost machine is one classified
+  ``host_down`` incident with every replica drained + redispatched,
+  stall liveness rides a heartbeat sequence in the RPC replies, and
+  :mod:`~horovod_tpu.serve.netfault` injects partitions/delays/
+  trickles/torn frames deterministically on loopback TCP for CI.
 
 Architecture, page math, and the SLO tuning runbook: docs/serving.md.
 """
 
 from horovod_tpu.serve.config import FleetConfig, ServeConfig
 from horovod_tpu.serve.engine import ServeEngine
-from horovod_tpu.serve.fleet import ProcessReplica, Replica, ServeFleet
+from horovod_tpu.serve.fleet import (ProcessReplica, Replica, ServeFleet,
+                                     TcpReplica)
+from horovod_tpu.serve.netfault import FaultableSocket, NetFaults
 from horovod_tpu.serve.kvcache import OutOfPages, PageAllocator, PagedKVCache
 from horovod_tpu.serve.scheduler import Request, RequestState, Scheduler
 from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
@@ -54,8 +63,10 @@ __all__ = [
     "ChecksumError",
     "ConnectionLost",
     "DeadlineExceeded",
+    "FaultableSocket",
     "FleetConfig",
     "FrameError",
+    "NetFaults",
     "OutOfPages",
     "PageAllocator",
     "PagedKVCache",
@@ -68,5 +79,6 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "ServeFleet",
+    "TcpReplica",
     "TransportError",
 ]

@@ -33,8 +33,52 @@ ATTENTIONS = ("gather", "paged")
 #: crash isolation); ``process`` runs each replica as its own worker
 #: process (:mod:`horovod_tpu.serve.worker`) behind the deadline-
 #: checked framed RPC transport (:mod:`horovod_tpu.serve.transport`)
-#: — a replica crash is one SIGKILLed OS process, never the router.
-TRANSPORTS = ("inproc", "process")
+#: — a replica crash is one SIGKILLed OS process, never the router;
+#: ``tcp`` runs the same frame protocol over TCP with a shared-secret
+#: connect handshake, placing workers across HOSTS
+#: (``FleetConfig.hosts``, ssh placement) so a whole machine is a
+#: first-class failure domain (``host_down``).
+TRANSPORTS = ("inproc", "process", "tcp")
+
+#: Host names a TCP worker can be spawned on WITHOUT ssh (and whose
+#: workers may get router-probed free ports instead of an explicit
+#: base port).
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def parse_host_entry(entry) -> tuple:
+    """One ``FleetConfig.hosts`` entry — ``"host"`` or ``"host:port"``
+    — parsed to ``(host, port_or_None)``, validated fail-fast (the
+    construction-time contract: a malformed placement must never
+    survive to the first spawn). ``port`` is the BASE port for that
+    host's workers (worker ``i``-th on the host binds ``port + i``);
+    local hosts may omit it (the router probes free ports), remote
+    hosts must not (the router cannot probe a port over ssh)."""
+    if not isinstance(entry, str) or not entry.strip():
+        raise ValueError(
+            f"hosts entry {entry!r}: expected a 'host[:port]' string")
+    e = entry.strip()
+    if "/" in e:
+        raise ValueError(
+            f"hosts entry {entry!r} looks like a unix-socket path — "
+            "transport='tcp' places workers at 'host[:port]' network "
+            "endpoints (the unix-socket lane is transport='process')")
+    host, sep, port_s = e.rpartition(":")
+    if not sep:
+        return e, None
+    if not host:
+        raise ValueError(
+            f"hosts entry {entry!r}: missing the host part")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"hosts entry {entry!r}: port {port_s!r} is not an "
+            "integer") from None
+    if not 1 <= port <= 65535:
+        raise ValueError(
+            f"hosts entry {entry!r}: port {port} outside 1..65535")
+    return host, port
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,7 +215,21 @@ class FleetConfig:
     ``process`` spawns each replica as its own
     ``python -m horovod_tpu.serve.worker`` OS process behind the
     framed Unix-socket RPC transport, so a replica crash (a REAL
-    ``SIGKILL``, an OOM, a segfault) takes down exactly one worker.
+    ``SIGKILL``, an OOM, a segfault) takes down exactly one worker;
+    ``tcp`` runs the same frame protocol over TCP (plus a
+    shared-secret connect handshake — a TCP listener is
+    network-reachable) and places workers across ``hosts``: each entry
+    is ``"host"`` or ``"host:port"`` (``port`` = that host's base
+    port; its ``i``-th worker binds ``port + i``), replicas assigned
+    round-robin, remote hosts reached over ssh (the launcher's pty-HUP
+    kill discipline, secret over stdin). With ``hosts=None`` every
+    worker runs on loopback — the CI lane. A lost HOST is then one
+    failure domain: all its replicas drain and redispatch in a single
+    classified ``host_down`` incident. The transport/hosts
+    combination is validated HERE, at construction (``hosts`` without
+    ``transport="tcp"``, unix-socket-path entries, duplicate
+    host:port pairs, portless remote hosts all raise) — never at
+    first spawn.
     Every RPC then carries ``rpc_deadline`` seconds of budget — size
     it ABOVE the worker's one-off costs inside a call (the first
     ``step`` poll after a (re)spawn waits out the engine build + jax
@@ -192,9 +250,12 @@ class FleetConfig:
     heartbeat_dir: Optional[str] = None   # base dir; namespaced per fleet
     retry_after_min: float = 0.05
     transport: str = "inproc"
-    rpc_deadline: float = 60.0     # per-RPC budget (process transport)
+    rpc_deadline: float = 60.0     # per-RPC budget (process/tcp transport)
     spawn_timeout: float = 120.0   # worker must listen within this
     shutdown_deadline: float = 2.0  # graceful-shutdown RPC budget
+    #: TCP placement: host entries ("host" or "host:port"), replicas
+    #: round-robin. None (with transport="tcp") = all on loopback.
+    hosts: Optional[tuple] = None
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -234,3 +295,31 @@ class FleetConfig:
             raise ValueError(
                 f"shutdown_deadline must be > 0 seconds, got "
                 f"{self.shutdown_deadline}")
+        if self.hosts is not None:
+            if self.transport != "tcp":
+                raise ValueError(
+                    f"hosts= places workers over the network and needs "
+                    f"transport='tcp' (got transport="
+                    f"{self.transport!r}) — the 'process' transport is "
+                    "unix-socket, same-host by construction")
+            if isinstance(self.hosts, str):
+                raise ValueError(
+                    "hosts must be a sequence of 'host[:port]' entries, "
+                    f"not the single string {self.hosts!r} (a string "
+                    "would iterate per-character)")
+            seen = set()
+            for entry in self.hosts:
+                host, port = parse_host_entry(entry)   # raises fail-fast
+                if host not in LOCAL_HOSTS and port is None:
+                    raise ValueError(
+                        f"hosts entry {entry!r}: a remote host needs an "
+                        "explicit base port — the router cannot probe "
+                        "free ports over ssh")
+                if (host, port) in seen:
+                    raise ValueError(
+                        f"duplicate host:port entry {entry!r} — two "
+                        "hosts' workers would race for the same ports")
+                seen.add((host, port))
+            # Normalize to a tuple so the frozen config stays hashable
+            # whatever sequence the caller passed.
+            object.__setattr__(self, "hosts", tuple(self.hosts))

@@ -57,7 +57,21 @@ Replicas come in two placements (``FleetConfig.transport``):
   mid-write death, a checksum mismatch, a deadline expiry — is
   converted into this same replica-death path, never retried at the
   RPC layer (a blind resend could double-apply a submit and break
-  at-most-once).
+  at-most-once);
+* ``tcp``: the same frame protocol over TCP with a shared-secret
+  connect handshake, placed across HOSTS (``FleetConfig.hosts``,
+  round-robin; remote hosts over ssh with the launcher's pty-HUP kill
+  discipline). A machine is then a first-class failure domain: a lost
+  host — ``kill:host=`` fault, NIC ``partition:``, ssh HUP — drains
+  and redispatches ALL its replicas in one classified ``host_down``
+  incident (a transport death triggers a short probe sweep of the
+  host's other replicas to coalesce the loss), and stall liveness
+  rides the transport itself (a heartbeat sequence in every
+  step/ping/collect reply, aged by the router's clock) because a
+  remote heartbeat file is invisible to the router's watchdog. Every
+  connection to a host routes through one shared
+  :class:`~horovod_tpu.serve.netfault.NetFaults` state, so partitions
+  are deterministically injectable on loopback TCP in CI.
 
 Either way the router's drain uses only router-side bookkeeping
 (dispatched requests + streamed tokens), never the dead engine's
@@ -112,8 +126,17 @@ class Replica:
     #: served tick (the fleet must never stamp for them — a wedged
     #: worker would look alive forever).
     stamps_own_heartbeat = False
+    #: How stall liveness is observed: ``file`` (heartbeat files + the
+    #: PR-9 HealthWatchdog — in-process and same-host process
+    #: replicas) or ``transport`` (a heartbeat SEQUENCE riding the
+    #: step/ping/collect replies, aged by the ROUTER's clock — TCP
+    #: replicas, whose heartbeat file may live on another machine the
+    #: router cannot stat).
+    liveness = "file"
+    #: Host failure-domain index (tcp placement only).
+    host: Optional[int] = None
 
-    def __init__(self, rid: int, engine, heartbeat: Heartbeat):
+    def __init__(self, rid: int, engine, heartbeat: Optional[Heartbeat]):
         self.id = rid
         self.engine = engine
         self.heartbeat = heartbeat
@@ -125,6 +148,10 @@ class Replica:
         self.stall_until: Optional[float] = None   # None = not stalled
         self.slow_factor = 1.0
         self.steps = 0
+        #: Transport-liveness channel (tcp): last observed heartbeat
+        #: sequence value + the ROUTER-clock stamp of when it changed.
+        self.hb_seq: Optional[int] = None
+        self.hb_at: Optional[float] = None
 
     @property
     def healthy(self) -> bool:
@@ -218,6 +245,59 @@ class ProcessReplica(Replica):
         self.sock_path = fresh.sock_path
 
 
+class TcpReplica(ProcessReplica):
+    """One replica worker behind the TCP frame transport, possibly on
+    another HOST (ssh placement). Same RPC surface and failure →
+    drain/redispatch rules as :class:`ProcessReplica`; what changes:
+
+    * ``host`` indexes the fleet's host table — the replica's failure
+      DOMAIN: a transport failure here makes the fleet probe the
+      host's other replicas, and a whole-host loss is one classified
+      ``host_down`` incident;
+    * liveness rides the transport (the worker's heartbeat-sequence
+      counter in every ``step``/``ping``/``collect`` reply, aged by
+      the router's clock) because a remote heartbeat FILE is not
+      visible to the router's watchdog;
+    * for ssh-placed workers ``proc`` is the local ssh CLIENT — its
+      process group is the kill handle (SIGKILL → pty HUP kills the
+      remote tree), but its exit code is only the worker's when the
+      remote exited normally: signal deaths and dead sessions report
+      255/-signum, which say nothing about the worker, so
+      :meth:`ensure_dead` falls back to the caller's evidence hint.
+    """
+
+    transport = "tcp"
+    liveness = "transport"
+    stamps_own_heartbeat = True   # the fleet never stamps files for it
+
+    def __init__(self, rid: int, engine: "_EngineProxy",
+                 proc, client: RpcClient, endpoint: str,
+                 host: int, host_name: str, via_ssh: bool):
+        super().__init__(rid, engine, None, proc, client, endpoint)
+        self.host = host
+        self.host_name = host_name
+        self.via_ssh = via_ssh
+
+    def _cleanup_ipc(self) -> None:
+        if self.client is not None:
+            self.client.close()
+        # No socket file to unlink: the endpoint is a network address.
+
+    def ensure_dead(self, code_hint: int) -> int:
+        from horovod_tpu.run import kill_worker
+
+        code = kill_worker(self.proc)
+        self._cleanup_ipc()
+        if code is None:
+            return code_hint
+        if self.via_ssh and (code < 0 or code == 255):
+            # The ssh CLIENT's own death (our SIGKILL of it, or ssh's
+            # 255 for a signal-killed/unreachable remote) is not the
+            # worker's exit code — classify from the caller's evidence.
+            return code_hint
+        return code
+
+
 class _SizedQueueView:
     """``len()``-only stand-in for a remote engine's queue (the router
     checks ``len(eng.scheduler.queue)`` for the engine-side bound)."""
@@ -285,6 +365,11 @@ class _EngineProxy:
         self._free = config.decode_slots
         self._in_flight = 0
         self._last_ticks = 0
+        #: Worker heartbeat-sequence value last seen in a reply (the
+        #: transport liveness channel: the worker bumps it once per
+        #: engine-loop iteration, idle ones included, so a frozen
+        #: value + work outstanding = a wedged engine thread).
+        self.last_hb: Optional[int] = None
         #: rid -> worker-output tokens already applied to the mirror.
         self._streamed: Dict[int, int] = {}
         self._by_rid: Dict[int, Request] = {}
@@ -331,6 +416,8 @@ class _EngineProxy:
         self.cache._occ = float(s["occupancy"])
         self.scheduler.queue.n = int(s["queue_len"])
         self._in_flight = int(s["in_flight"])
+        if s.get("hb") is not None:
+            self.last_hb = int(s["hb"])
         stepped = int(s["ticks"]) > self._last_ticks
         self._last_ticks = int(s["ticks"])
         if not self._by_rid:
@@ -342,6 +429,8 @@ class _EngineProxy:
             return stepped
         c = self.client.call("collect", {
             "since": {str(r): n for r, n in self._streamed.items()}})
+        if c.get("hb") is not None:
+            self.last_hb = int(c["hb"])
         now = self.clock()
         for pr in c.get("progress", ()):
             req = self._by_rid.get(int(pr["rid"]))
@@ -490,7 +579,13 @@ class ServeFleet:
         self._incarnations: Dict[int, int] = {}
         self._worker_env = dict(worker_env or {})
         self._worker_cmd = worker_cmd
-        if self.fleet.transport == "process":
+        # TCP placement: the parsed host table — each entry one
+        # FAILURE DOMAIN: {"name", "port" (base or None=probe-free,
+        # local only), "local", "faults" (the shared NetFaults every
+        # connection to the host routes through — one NIC, one fate)}.
+        self._hosts: List[Dict] = []
+        self._secret: Optional[str] = None
+        if self.fleet.transport in ("process", "tcp"):
             import dataclasses as _dc
             import json as _json
             import tempfile
@@ -505,6 +600,24 @@ class ServeFleet:
                                              "config.json")
             with open(self._config_path, "w") as f:
                 _json.dump(_dc.asdict(config), f)
+        if self.fleet.transport == "tcp":
+            from horovod_tpu.run.network import make_secret_key
+            from horovod_tpu.serve.config import (LOCAL_HOSTS,
+                                                  parse_host_entry)
+            from horovod_tpu.serve.netfault import NetFaults
+
+            # One ephemeral shared secret per fleet instance: every
+            # TCP connection must pass the handshake before an RPC is
+            # served. It reaches workers through the environment
+            # (ssh placement ships it over stdin, never argv).
+            self._secret = make_secret_key().hex()
+            for entry in (self.fleet.hosts or ("127.0.0.1",)):
+                name, port = parse_host_entry(entry)
+                self._hosts.append({
+                    "name": name, "port": port,
+                    "local": name in LOCAL_HOSTS,
+                    "faults": NetFaults(),
+                })
 
         self._closed = False
         self.replicas: List[Replica] = []
@@ -561,6 +674,10 @@ class ServeFleet:
     # ------------------------------------------------------- lifecycle
 
     def _spawn(self, rid: int) -> Replica:
+        if self.fleet.transport == "tcp":
+            # No heartbeat FILE: a remote worker's file is on another
+            # machine — liveness rides the transport instead.
+            return self._spawn_tcp(rid)
         hb = Heartbeat(self.heartbeat_dir, rank=rid)
         # A (re)spawned replica is unwatched until its first completed
         # step: no stale file from a previous incarnation may insta-kill
@@ -610,6 +727,76 @@ class ServeFleet:
              f"(incarnation {inc}) on {sock_path}")
         return ProcessReplica(rid, proxy, hb, proc, client, sock_path)
 
+    @staticmethod
+    def _free_local_port() -> int:
+        import socket as _socket
+
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _spawn_tcp(self, rid: int) -> TcpReplica:
+        """One TCP worker on its assigned host. Replicas spread
+        round-robin over the host table (``rid % hosts``); a host with
+        a base port gives its ``k``-th worker ``base + k`` (stable
+        across relaunches — the worker binds with ``SO_REUSEADDR``),
+        while local auto-port hosts get a fresh probed free port per
+        incarnation. Remote hosts spawn over ssh (the launcher's
+        pty-HUP kill discipline; NOTE: the params/config files live in
+        this fleet's workdir, so multi-host placement assumes a shared
+        working filesystem — the standard pod setup, same as elastic
+        checkpoints)."""
+        from horovod_tpu.run import spawn_worker, spawn_worker_ssh
+
+        h = rid % len(self._hosts)
+        slot = rid // len(self._hosts)   # k-th worker on this host
+        host = self._hosts[h]
+        inc = self._incarnations.get(rid, 0) + 1
+        self._incarnations[rid] = inc
+        if host["port"] is not None:
+            port = host["port"] + slot
+        else:
+            port = self._free_local_port()
+        bind_host = "127.0.0.1" if host["local"] else "0.0.0.0"
+        endpoint = f"{bind_host}:{port}"
+        cmd = [sys.executable, "-m", "horovod_tpu.serve.worker",
+               "--bind", endpoint,
+               "--params", self._params_path,
+               "--config", self._config_path,
+               "--rank", str(rid)]
+        env = dict(os.environ)
+        env.update(self._worker_env)
+        env["HOROVOD_SECRET"] = self._secret
+        if self._worker_cmd is not None:
+            cmd, env = self._worker_cmd(rid, endpoint, (cmd, env))
+        if host["local"]:
+            proc = spawn_worker(cmd, env)
+        else:
+            proc = spawn_worker_ssh(host["name"], cmd, env)
+        connect_host = "127.0.0.1" if host["local"] else host["name"]
+        client = RpcClient(
+            (connect_host, port),
+            default_timeout=self.fleet.rpc_deadline,
+            connect_timeout=self.fleet.spawn_timeout,
+            proc_alive=lambda: proc.poll() is None,
+            call_ms=self._rpc_samples,
+            secret=self._secret,
+            sock_wrap=host["faults"].wrap)
+        proxy = _EngineProxy(client, self.config, self._fits,
+                             self.clock)
+        _log(f"replica {rid}: spawned tcp worker pid {proc.pid} "
+             f"(incarnation {inc}) on host {h} ({host['name']}) "
+             f"port {port}" + (" via ssh" if not host["local"] else ""))
+        rep = TcpReplica(rid, proxy, proc, client,
+                         f"{connect_host}:{port}", h, host["name"],
+                         via_ssh=not host["local"])
+        # The liveness channel starts "fresh now": a spawned worker is
+        # unwatched until its heartbeat sequence first moves, aged
+        # from spawn time — the same no-insta-kill grace the file
+        # watchdog gets by unlinking the stale heartbeat.
+        rep.hb_at = self.clock()
+        return rep
+
     @property
     def in_flight(self) -> int:
         return sum(len(r.assigned) for r in self.replicas) + \
@@ -644,10 +831,22 @@ class ServeFleet:
             # too — a malformed one must raise HERE, not TypeError
             # out of the fleet loop at fire time.
             a.validate()
-            if not 0 <= a.replica < len(self.replicas):
+            if a.replica is not None and \
+                    not 0 <= a.replica < len(self.replicas):
                 raise FaultPlanError(
                     f"fault action {a}: replica {a.replica} is outside "
                     f"this fleet (replicas 0..{len(self.replicas) - 1})")
+            if a.host is not None:
+                if self.fleet.transport != "tcp":
+                    raise FaultPlanError(
+                        f"fault action {a}: host-addressed faults need "
+                        f"the tcp transport (this fleet is "
+                        f"{self.fleet.transport!r} — hosts are not a "
+                        "failure domain there)")
+                if not 0 <= a.host < len(self._hosts):
+                    raise FaultPlanError(
+                        f"fault action {a}: host {a.host} is outside "
+                        f"this fleet (hosts 0..{len(self._hosts) - 1})")
         self._pending_faults.extend(
             (a.resolve_at(horizon), a) for a in actions)
         self._pending_faults.sort(key=lambda p: p[0])
@@ -658,6 +857,23 @@ class ServeFleet:
         t = now - self._fault_t0
         while self._pending_faults and self._pending_faults[0][0] <= t:
             _, action = self._pending_faults.pop(0)
+            if action.host is not None:
+                _log(f"fault injection: {action} firing")
+                if action.kind == "kill":
+                    # The machine-loss shape: every worker on the host
+                    # SIGKILLed (through the ssh pty for remote ones),
+                    # one host_down incident, one mass redispatch.
+                    self._host_down(action.host, now, cause="kill")
+                elif action.kind == "partition":
+                    # The NIC-loss shape: every connection to the host
+                    # goes dark via the shared NetFaults state at the
+                    # transport seam; detection happens organically —
+                    # a deadline expiry or the half-open reset when
+                    # the window ends — and the probe sweep coalesces
+                    # the loss into host_down.
+                    self._hosts[action.host]["faults"].partition(
+                        action.secs)
+                continue
             rep = self.replicas[action.replica]
             _log(f"fault injection: {action} firing (replica state "
                  f"{rep.state})")
@@ -767,6 +983,17 @@ class ServeFleet:
 
     # ---------------------------------------------------- supervision
 
+    def _probe_alive(self, rep: Replica, budget: float = 1.0):
+        """Short-deadline reachability probe of one replica (the
+        host-domain sweep after a peer's transport death). Returns
+        None when alive, else the typed failure's class name."""
+        try:
+            rep.engine.client.call(
+                "ping", timeout=min(budget, self.fleet.rpc_deadline))
+            return None
+        except TransportError as e:
+            return type(e).__name__
+
     def _transport_death(self, rep: Replica, err: Exception,
                          now: float) -> None:
         """The tentpole's one rule: ANY transport failure — refused
@@ -775,28 +1002,111 @@ class ServeFleet:
         (a blind resend could double-apply a submit and break
         at-most-once). ``ensure_dead`` inside the kill path turns the
         maybe-still-running worker into a definitely-dead one and
-        recovers its real exit code for classification."""
+        recovers its real exit code for classification.
+
+        On the TCP transport the replica's HOST is the suspect: the
+        fleet immediately probes the host's other live replicas with a
+        short ping, and when the whole host is unreachable (>= 2
+        replicas failing together) the loss is ONE classified
+        ``host_down`` incident — every replica of the host drains and
+        redispatches in the same sweep, instead of N separate
+        incidents trickling in one deadline at a time."""
         kind = type(err).__name__
         self.transport_incidents[kind] = \
             self.transport_incidents.get(kind, 0) + 1
         _log(f"replica {rep.id}: transport failure {kind}: {err} — "
              "routing into the replica-death path (no retry)")
+        if rep.host is not None:
+            peers = [r for r in self.replicas
+                     if r is not rep and r.healthy
+                     and r.host == rep.host]
+            dead_peers = [(p, self._probe_alive(p)) for p in peers]
+            dead_peers = [(p, k) for p, k in dead_peers if k is not None]
+            if peers and len(dead_peers) == len(peers):
+                # The whole host is dark — one incident, one drain.
+                self._host_down(rep.host, now, cause="transport",
+                                transport_error=kind)
+                return
+            # A partial sweep: the trigger dies, and so does any peer
+            # the probe found dead — each its own classified incident.
+            self._kill_replica(rep, code=1, stalled=False, now=now,
+                               transport_error=kind)
+            for p, pkind in dead_peers:
+                self.transport_incidents[pkind] = \
+                    self.transport_incidents.get(pkind, 0) + 1
+                self._kill_replica(p, code=1, stalled=False, now=now,
+                                   transport_error=pkind)
+            return
         self._kill_replica(rep, code=1, stalled=False, now=now,
                            transport_error=kind)
 
+    def _host_down(self, h: int, now: float, *, cause: str,
+                   transport_error: Optional[str] = None,
+                   detect_age: Optional[float] = None) -> None:
+        """A whole host is one failure domain: kill, drain and
+        redispatch EVERY healthy replica placed on it as a single
+        classified ``host_down`` incident (``kill:host=`` faults land
+        here directly; transport-detected losses arrive via
+        :meth:`_transport_death`'s probe sweep). Each replica still
+        relaunches individually under the fleet-wide restart budget —
+        a host that comes back simply receives its workers again."""
+        host = self._hosts[h]
+        reps = [r for r in self.replicas
+                if r.healthy and r.host == h]
+        if not reps:
+            return
+        self.incidents_by_class["host_down"] = \
+            self.incidents_by_class.get("host_down", 0) + 1
+        details = []
+        total_moved = total_rec = 0
+        max_backoff = 0.0
+        code_hint = -int(_signal.SIGKILL) if cause == "kill" else 1
+        for rep in reps:
+            code, moved, recomputed, backoff = self._kill_replica(
+                rep, code=code_hint, stalled=False, now=now,
+                transport_error=transport_error, record=False)
+            details.append({"replica": rep.id, "code": code})
+            total_moved += moved
+            total_rec += recomputed
+            max_backoff = max(max_backoff, backoff)
+        self.incidents.append({
+            "replica": None,
+            "host": h,
+            "host_name": host["name"],
+            "category": "host_down",
+            "cause": cause,
+            "code": details[0]["code"],
+            "replicas": details,
+            "transport_error": transport_error,
+            "t_s": round(now - self._t_start, 4),
+            "detect_s": round(detect_age, 4) if detect_age is not None
+            else 0.0,
+            "redispatched": total_moved,
+            "tokens_recomputed": total_rec,
+            "backoff_s": round(max_backoff, 4),
+        })
+        _log(f"host {h} ({host['name']}) down ({cause}"
+             + (f": {transport_error}" if transport_error else "")
+             + f") — {len(reps)} replica(s) lost in one incident, "
+             f"{total_moved} request(s) drained to survivors "
+             f"({total_rec} KV tokens to recompute)")
+
     def _kill_replica(self, rep: Replica, *, code: int, stalled: bool,
                       now: float, detect_age: Optional[float] = None,
-                      transport_error: Optional[str] = None) -> None:
+                      transport_error: Optional[str] = None,
+                      record: bool = True) -> tuple:
         """Classify + drain + schedule relaunch: the fleet edition of
-        the supervisor's per-incident policy."""
+        the supervisor's per-incident policy. ``record=False`` (the
+        host-incident path) suppresses the per-replica incident entry
+        and class count — the caller owns the single aggregate record
+        — and returns ``(code, moved, recomputed, backoff)`` either
+        way."""
         # Make the failure domain REALLY dead first (process replicas:
         # SIGKILL the worker's process group + reap — no zombies, and
         # the reaped code beats the synthetic hint as evidence).
         code = rep.ensure_dead(code)
         rep.exit = WorkerExit(rank=rep.id, code=code, stalled=stalled)
         category = rep.exit.category
-        self.incidents_by_class[category] = \
-            self.incidents_by_class.get(category, 0) + 1
         moved, recomputed = self._drain(rep, now)
         # The engine object (pages, allocator, compiled-step cache) is
         # dropped wholesale — the crash shape. Its heartbeat file goes
@@ -805,32 +1115,40 @@ class ServeFleet:
         rep.state = "dead"
         rep.stall_until = None
         rep.slow_factor = 1.0
-        try:
-            os.unlink(rep.heartbeat.path)
-        except OSError:
-            pass
+        rep.hb_seq = None
+        rep.hb_at = None
+        if rep.heartbeat is not None:
+            try:
+                os.unlink(rep.heartbeat.path)
+            except OSError:
+                pass
         backoff = min(self.fleet.backoff_cap,
                       self.fleet.backoff_base * (2 ** rep.restarts))
         rep.relaunch_at = now + backoff
-        self.incidents.append({
-            "replica": rep.id,
-            "category": category,
-            "code": code,
-            "transport_error": transport_error,
-            "t_s": round(now - self._t_start, 4),
-            # Watchdog kills carry the observed heartbeat age (real
-            # detection latency). In-process crashes are observed
-            # synchronously — 0.0 is honest here where a multi-process
-            # fleet would pay one supervision-poll interval.
-            "detect_s": round(detect_age, 4) if detect_age is not None
-            else 0.0,
-            "redispatched": moved,
-            "tokens_recomputed": recomputed,
-            "backoff_s": round(backoff, 4),
-        })
+        if record:
+            self.incidents_by_class[category] = \
+                self.incidents_by_class.get(category, 0) + 1
+            self.incidents.append({
+                "replica": rep.id,
+                "category": category,
+                "code": code,
+                "transport_error": transport_error,
+                "t_s": round(now - self._t_start, 4),
+                # Watchdog kills carry the observed heartbeat age (real
+                # detection latency). In-process crashes are observed
+                # synchronously — 0.0 is honest here where a
+                # multi-process fleet would pay one supervision-poll
+                # interval.
+                "detect_s": round(detect_age, 4)
+                if detect_age is not None else 0.0,
+                "redispatched": moved,
+                "tokens_recomputed": recomputed,
+                "backoff_s": round(backoff, 4),
+            })
         _log(f"{rep.exit.describe(role='replica')} — drained {moved} "
              f"request(s) to survivors ({recomputed} KV tokens to "
              f"recompute); relaunch in {backoff:g}s")
+        return code, moved, recomputed, backoff
 
     def _drain(self, rep: Replica, now: float) -> tuple:
         """Recover every dispatched-but-unfinished request of a dead
@@ -886,9 +1204,32 @@ class ServeFleet:
         return len(moved), recomputed
 
     def _check_watchdog(self, now: float) -> None:
+        # Transport-liveness lane (tcp replicas): the router cannot
+        # stat a remote heartbeat FILE, so liveness is the worker's
+        # heartbeat SEQUENCE riding every step/ping/collect reply,
+        # aged by the ROUTER's clock. A wedged engine thread keeps its
+        # RPC control thread answering — with a frozen sequence — so
+        # the stale age here is exactly what the stale file mtime is
+        # for local replicas: the silent-stall signal, classified
+        # ``stalled``.
+        if self.fleet.watchdog_timeout > 0:
+            for rep in self.replicas:
+                if not rep.healthy or rep.liveness != "transport":
+                    continue
+                age = now - (rep.hb_at if rep.hb_at is not None
+                             else self._t_start)
+                if age > self.fleet.watchdog_timeout:
+                    _log(f"health watchdog: replica {rep.id} transport "
+                         f"heartbeat stale for {age:.2f}s (timeout "
+                         f"{self.fleet.watchdog_timeout:g}s) — killing "
+                         "the stalled replica")
+                    self._kill_replica(rep, code=-int(_signal.SIGKILL),
+                                       stalled=True, now=now,
+                                       detect_age=age)
         if self.watchdog is None:
             return
-        live = [r.id for r in self.replicas if r.healthy]
+        live = [r.id for r in self.replicas
+                if r.healthy and r.liveness == "file"]
         for rid, age in self.watchdog.check(live).items():
             rep = self.replicas[rid]
             self.watchdog.kills[rid] = age
@@ -910,9 +1251,16 @@ class ServeFleet:
                 continue
             self.restarts_used += 1
             rep.restarts += 1
-            rep.adopt(self._spawn(rep.id))
+            fresh = self._spawn(rep.id)
+            rep.adopt(fresh)
             rep.state = "healthy"
             rep.exit = None
+            if rep.liveness == "transport":
+                # Fresh incarnation, fresh liveness grace (the spawn
+                # stamped fresh.hb_at; the adopted replica keeps its
+                # identity but must not inherit a stale age).
+                rep.hb_seq = None
+                rep.hb_at = fresh.hb_at
             if self.watchdog is not None:
                 # The PREVIOUS incarnation's kill record must not mute
                 # watching the fresh one.
@@ -1066,6 +1414,21 @@ class ServeFleet:
                     dt = self.clock() - t0
                     if dt > 0:
                         self._sleep((rep.slow_factor - 1.0) * dt)
+            if rep.liveness == "transport":
+                # Age the transport liveness channel with the ROUTER's
+                # clock: the sequence moving (the worker's engine loop
+                # iterated, idle ticks included) is what freshness
+                # means — reply arrival alone is only the RPC thread.
+                # Stamp the clock NOW, not the tick-top `now`: one
+                # slow peer step earlier in this tick (a relaunch
+                # compile) must not age a healthy, advancing replica's
+                # stamp toward a spurious stall kill — the same
+                # discipline the end-of-tick file stamping below
+                # exists for.
+                hb = getattr(rep.engine, "last_hb", None)
+                if hb is not None and hb != rep.hb_seq:
+                    rep.hb_seq = hb
+                    rep.hb_at = self.clock()
             ticked.append(rep)
             self._collect(rep)
             occ.append(rep.engine.cache.occupancy())
@@ -1164,7 +1527,7 @@ class ServeFleet:
         from horovod_tpu.serve.metrics import percentile
 
         rpc_ms = None
-        if self.fleet.transport == "process":
+        if self.fleet.transport in ("process", "tcp"):
             s = self._rpc_samples
             rpc_ms = {
                 "calls": len(s),
@@ -1174,6 +1537,10 @@ class ServeFleet:
         out["fleet"] = {
             "replicas": len(self.replicas),
             "transport": self.fleet.transport,
+            "hosts": len(self._hosts) or None,
+            "host_incidents": sum(
+                1 for i in self.incidents
+                if i.get("category") == "host_down"),
             "rpc_ms": rpc_ms,
             "transport_incidents": dict(self.transport_incidents),
             "healthy": sum(1 for r in self.replicas if r.healthy),

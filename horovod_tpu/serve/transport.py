@@ -1,10 +1,13 @@
 """Deadline-checked frame protocol for the cross-process serving fleet.
 
 One replica worker (:mod:`horovod_tpu.serve.worker`) serves its RPCs
-over a Unix-domain socket; the router side
-(:class:`~horovod_tpu.serve.fleet.ServeFleet` in ``transport=
-"process"`` mode) talks to it through :class:`RpcClient`. The wire
-format is deliberately minimal and fully checkable:
+over a Unix-domain socket (``FleetConfig(transport="process")``) or a
+TCP listener (``transport="tcp"`` — the multi-host placement); the
+router side (:class:`~horovod_tpu.serve.fleet.ServeFleet`) talks to it
+through :class:`RpcClient` either way — the address is a filesystem
+path for Unix sockets or a ``(host, port)`` tuple for TCP, and the
+frame discipline below is byte-identical on both. The wire format is
+deliberately minimal and fully checkable:
 
 ``[4B magic "HVSF"][4B big-endian payload length][4B CRC32][payload]``
 
@@ -35,19 +38,35 @@ The RPC layer never retries: any :class:`TransportError` means the
 caller must treat the replica as DEAD and route into the fleet's
 drain/redispatch path (at-most-once delivery is the fleet's invariant,
 and a blind resend could double-apply a ``submit``). docs/serving.md
-"Process fleet" carries the deadline table and the failure → action
-matrix.
+"Process fleet" / "Multi-host fleet" carry the deadline table and the
+failure → action matrix.
+
+TCP adds one thing Unix sockets never needed: a **connect handshake**.
+A Unix socket is reachable only through the filesystem; a TCP listener
+is reachable by anything that can route to the port, so every accepted
+connection must prove it holds the fleet's shared secret before a
+single RPC frame is served (:func:`server_handshake` /
+:func:`client_handshake` — an HMAC-SHA256 challenge/response over the
+same frame codec, the ``run/network.py`` secret discipline applied to
+the serving wire; the secret itself never crosses the wire, and over
+ssh placement it ships via stdin, never argv).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import os
 import socket
 import struct
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+#: An RPC endpoint: a Unix-socket filesystem path, or a TCP
+#: ``(host, port)`` pair.
+Address = Union[str, Tuple[str, int]]
 
 #: Frame magic. A reply that starts with anything else is byte garbage
 #: (a torn previous frame, or a non-worker peer) — never parsed.
@@ -198,8 +217,74 @@ def recv_frame(sock: socket.socket, deadline: Optional[float]) -> Any:
         raise FrameError(f"undecodable frame payload: {e}") from None
 
 
+# ------------------------------------------------------------- handshake
+#
+# TCP listeners are network-reachable, so a connection must prove it
+# holds the fleet's shared secret before any RPC is served. The
+# challenge/response rides the frame codec itself: server sends a
+# random nonce, client answers HMAC-SHA256(secret, nonce), server
+# compares in constant time and acks. An unauthenticated peer never
+# reaches the handler, and the secret never crosses the wire.
+
+
+def _handshake_mac(secret: str, nonce: str) -> str:
+    # utf-8 on both legs: encoding a str can then never raise, so an
+    # adversarial (non-ASCII) nonce or auth value from the wire can
+    # only ever FAIL the comparison — never throw past the typed
+    # taxonomy (a TypeError/UnicodeEncodeError here would kill the
+    # worker's only accept thread / leak the client's socket).
+    return hmac.new(secret.encode("utf-8"), nonce.encode("utf-8"),
+                    hashlib.sha256).hexdigest()
+
+
+def server_handshake(sock: socket.socket, secret: str,
+                     deadline: Optional[float]) -> bool:
+    """Worker-side challenge/response on one accepted connection.
+    Returns True when the peer proved the shared secret; False (after a
+    best-effort rejection ack) otherwise — the caller drops the
+    connection and keeps accepting. Never raises: a garbage or silent
+    peer is just an unauthenticated one."""
+    nonce = os.urandom(16).hex()
+    try:
+        send_frame(sock, {"hvsf": 1, "nonce": nonce}, deadline)
+        reply = recv_frame(sock, deadline)
+    except TransportError:
+        return False
+    auth = reply.get("auth") if isinstance(reply, dict) else None
+    # Compare BYTES: compare_digest on str raises TypeError for
+    # non-ASCII input, and this function's contract is never-raise —
+    # an unauthenticated peer must only ever be dropped.
+    ok = isinstance(auth, str) and hmac.compare_digest(
+        auth.encode("utf-8"),
+        _handshake_mac(secret, nonce).encode("utf-8"))
+    try:
+        send_frame(sock, {"ok": bool(ok)}, deadline)
+    except TransportError:
+        return False
+    return ok
+
+
+def client_handshake(sock: socket.socket, secret: str,
+                     deadline: Optional[float]) -> None:
+    """Router-side half: answer the server's nonce challenge. Raises a
+    typed :class:`TransportError` on any failure — a rejected handshake
+    (secret mismatch) is :class:`ConnectionLost`, because to the fleet
+    it IS one: the replica can never be spoken to."""
+    challenge = recv_frame(sock, deadline)
+    nonce = challenge.get("nonce") if isinstance(challenge, dict) else None
+    if not isinstance(nonce, str):
+        raise FrameError(
+            f"handshake: expected a nonce challenge, got {challenge!r}")
+    send_frame(sock, {"auth": _handshake_mac(secret, nonce)}, deadline)
+    ack = recv_frame(sock, deadline)
+    if not (isinstance(ack, dict) and ack.get("ok")):
+        raise ConnectionLost(
+            "handshake rejected by the worker — shared-secret mismatch "
+            "(is HOROVOD_SECRET the fleet's secret on both ends?)")
+
+
 class RpcClient:
-    """Fleet-side RPC stub over one Unix-socket connection.
+    """Fleet-side RPC stub over one Unix-socket or TCP connection.
 
     Every :meth:`call` carries its own deadline (``timeout``, default
     ``default_timeout``); the request/response pair shares it — a
@@ -227,17 +312,30 @@ class RpcClient:
     ``call_ms`` (optional shared list) accumulates per-call wall
     milliseconds — the fleet aggregates them across replica
     incarnations into the ``rpc_ms`` overhead stamp.
+
+    ``path`` may be a Unix-socket filesystem path or a TCP
+    ``(host, port)`` tuple. TCP connections additionally take
+    ``secret`` (the fleet's shared secret: every fresh connection runs
+    the :func:`client_handshake` challenge/response before the first
+    RPC) and ``sock_wrap`` (a ``sock -> sock`` hook applied to every
+    fresh connection — the seam the deterministic network fault
+    injector, :mod:`horovod_tpu.serve.netfault`, plugs into).
     """
 
-    def __init__(self, path: str, *, default_timeout: float = 60.0,
+    def __init__(self, path: Address, *, default_timeout: float = 60.0,
                  connect_timeout: Optional[float] = None,
                  proc_alive: Optional[Callable[[], bool]] = None,
-                 call_ms: Optional[List[float]] = None):
+                 call_ms: Optional[List[float]] = None,
+                 secret: Optional[str] = None,
+                 sock_wrap: Optional[
+                     Callable[[socket.socket], socket.socket]] = None):
         self.path = path
         self.default_timeout = float(default_timeout)
         self.connect_timeout = connect_timeout
         self._proc_alive = proc_alive
         self.call_ms = call_ms if call_ms is not None else []
+        self.secret = secret
+        self._sock_wrap = sock_wrap
         self._sock: Optional[socket.socket] = None
         self._next_id = 0
 
@@ -245,49 +343,73 @@ class RpcClient:
     def connected(self) -> bool:
         return self._sock is not None
 
+    @property
+    def _is_tcp(self) -> bool:
+        return isinstance(self.path, tuple)
+
+    def _endpoint(self) -> str:
+        return (f"{self.path[0]}:{self.path[1]}" if self._is_tcp
+                else str(self.path))
+
     def connect(self, timeout: Optional[float] = None) -> None:
         """Connect, retrying while the socket file is absent or the
         listener not yet up (the worker binds before its heavy jax
         init, but a relaunch can race). Gives up early when
-        ``proc_alive`` reports the worker dead."""
+        ``proc_alive`` reports the worker dead. TCP connections run the
+        shared-secret handshake before the client counts as connected —
+        a replica we cannot authenticate to is one we cannot speak to."""
         if self._sock is not None:
             return
         deadline = _deadline(timeout if timeout is not None
                              else self.default_timeout)
+        family = socket.AF_INET if self._is_tcp else socket.AF_UNIX
+        target = tuple(self.path) if self._is_tcp else self.path
         while True:
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock = socket.socket(family, socket.SOCK_STREAM)
             try:
                 remaining = _remaining(deadline)
                 if remaining is not None and remaining <= 0:
                     sock.close()
                     raise DeadlineExceeded(
-                        f"could not connect to worker at {self.path} "
-                        "before the deadline")
+                        f"could not connect to worker at "
+                        f"{self._endpoint()} before the deadline")
                 sock.settimeout(remaining)
-                sock.connect(self.path)
-                self._sock = sock
-                return
+                sock.connect(target)
+                break
             except socket.timeout:
                 sock.close()
                 raise DeadlineExceeded(
-                    f"connect to {self.path} timed out") from None
+                    f"connect to {self._endpoint()} timed out") from None
             except (FileNotFoundError, ConnectionRefusedError) as e:
                 sock.close()
                 if self._proc_alive is not None and \
                         not self._proc_alive():
                     raise ConnectionLost(
-                        f"worker exited before serving {self.path} "
-                        "(died on startup?)") from None
+                        f"worker exited before serving "
+                        f"{self._endpoint()} (died on startup?)"
+                    ) from None
                 remaining = _remaining(deadline)
                 if remaining is not None and remaining <= 0:
                     raise DeadlineExceeded(
-                        f"worker never listened on {self.path}: {e}"
-                    ) from None
+                        f"worker never listened on {self._endpoint()}: "
+                        f"{e}") from None
                 time.sleep(0.02)
             except OSError as e:
                 sock.close()
                 raise ConnectionLost(
-                    f"connect to {self.path} failed: {e}") from None
+                    f"connect to {self._endpoint()} failed: {e}"
+                ) from None
+        if self._sock_wrap is not None:
+            sock = self._sock_wrap(sock)
+        if self.secret is not None:
+            try:
+                client_handshake(sock, self.secret, deadline)
+            except Exception:
+                # Typed or not (defense in depth), a failed handshake
+                # must never leak the connected socket.
+                sock.close()
+                raise
+        self._sock = sock
 
     def call(self, method: str, params: Optional[Dict] = None,
              timeout: Optional[float] = None) -> Any:
@@ -396,8 +518,9 @@ def serve_connection(sock: socket.socket,
 
 
 __all__ = [
-    "ChecksumError", "ConnectionLost", "DeadlineExceeded", "FrameError",
-    "HEADER_LEN", "MAGIC", "MAX_FRAME", "RemoteCallError", "RpcClient",
-    "TransportError", "encode_frame", "recv_exact", "recv_frame",
-    "send_frame", "serve_connection",
+    "Address", "ChecksumError", "ConnectionLost", "DeadlineExceeded",
+    "FrameError", "HEADER_LEN", "MAGIC", "MAX_FRAME", "RemoteCallError",
+    "RpcClient", "TransportError", "client_handshake", "encode_frame",
+    "recv_exact", "recv_frame", "send_frame", "serve_connection",
+    "server_handshake",
 ]

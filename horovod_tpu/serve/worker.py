@@ -5,7 +5,14 @@
 :class:`~horovod_tpu.serve.engine.ServeEngine` as its own OS process —
 the crash-isolation boundary the in-process fleet honestly lacked: a
 replica that segfaults, OOMs, or is SIGKILLed takes down exactly one
-worker, never the router or its peers.
+worker, never the router or its peers. ``--bind host:port`` (instead
+of ``--socket``) serves the same frame protocol over TCP — the
+multi-host placement: the listener demands the fleet's shared secret
+(``HOROVOD_SECRET``; every accepted connection passes the HMAC
+handshake before an RPC is served), liveness rides a heartbeat
+SEQUENCE in every ping/step/collect reply instead of a file the
+router could not see, and the advertised endpoint resolves through
+``run/network.py``'s offline-safe fallback chain.
 
 Two threads, one failure story:
 
@@ -125,11 +132,23 @@ def _jsonable(x: Any) -> Any:
 
 
 class WorkerHost:
-    """The worker's two-thread engine host (see module docstring)."""
+    """The worker's two-thread engine host (see module docstring).
 
-    def __init__(self, engine, heartbeat=None):
+    ``secret`` (TCP placement) arms the shared-secret connect
+    handshake: every accepted connection must answer the HMAC
+    challenge before a single RPC frame is served — a TCP listener is
+    network-reachable, unlike the filesystem-gated Unix socket."""
+
+    def __init__(self, engine, heartbeat=None, secret=None):
         self.engine = engine
         self.heartbeat = heartbeat
+        self._secret = secret
+        #: Transport liveness channel: bumped once per engine-loop
+        #: iteration (idle ticks included — "nothing to do" is not
+        #: "wedged"), reported in every ping/step/collect reply so a
+        #: router that cannot see this machine's heartbeat FILE can
+        #: age the same signal off the wire.
+        self._hb_seq = 0
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         #: router rid -> the ENGINE's Request (the worker's own rids
@@ -170,6 +189,7 @@ class WorkerHost:
                 if progressed:
                     self._ticks += 1
                 self._harvest_locked()
+            self._hb_seq += 1
             if progressed and self._slow > 1.0:
                 dt = time.perf_counter() - t0
                 if dt > 0:
@@ -222,7 +242,8 @@ class WorkerHost:
         return fn(params)
 
     def _rpc_ping(self, p: Dict) -> Dict:
-        return {"pid": os.getpid(), "ticks": self._ticks}
+        return {"pid": os.getpid(), "ticks": self._ticks,
+                "hb": self._hb_seq}
 
     def _rpc_submit(self, p: Dict) -> Dict:
         from horovod_tpu.serve.scheduler import make_request
@@ -257,6 +278,7 @@ class WorkerHost:
         with self._lock:
             eng = self.engine
             return {"ticks": self._ticks,
+                    "hb": self._hb_seq,
                     "free_slots": eng._free_slots(),
                     "occupancy": float(eng.cache.occupancy()),
                     "queue_len": len(eng.scheduler.queue),
@@ -280,7 +302,8 @@ class WorkerHost:
                     "generated_len": len(req.generated),
                 })
         self._collects += 1
-        return {"events": events, "progress": progress}
+        return {"events": events, "progress": progress,
+                "hb": self._hb_seq}
 
     def _rpc_stats(self, p: Dict) -> Dict:
         with self._lock:
@@ -334,6 +357,8 @@ class WorkerHost:
         return False
 
     def rpc_loop(self, server_sock: socket.socket) -> None:
+        from horovod_tpu.serve.transport import server_handshake
+
         while not self._shutdown.is_set():
             server_sock.settimeout(0.25)
             try:
@@ -343,6 +368,14 @@ class WorkerHost:
             except OSError:
                 return
             with conn:
+                if self._secret:
+                    # TCP listener: anything that routes to the port
+                    # can connect — prove the fleet secret before a
+                    # single RPC frame is served, drop otherwise.
+                    if not server_handshake(
+                            conn, self._secret,
+                            time.monotonic() + 5.0):
+                        continue
                 serve_connection(conn, self.handle,
                                  should_stop=self._shutdown.is_set,
                                  send_hook=self._send_hook)
@@ -364,8 +397,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m horovod_tpu.serve.worker",
         description="One serving-fleet replica worker process.")
-    ap.add_argument("--socket", required=True,
-                    help="Unix-domain socket path to serve RPCs on")
+    ap.add_argument("--socket", default="",
+                    help="Unix-domain socket path to serve RPCs on "
+                         "(the same-host 'process' transport)")
+    ap.add_argument("--bind", default="",
+                    help="TCP 'host:port' to listen on instead of a "
+                         "unix socket (the multi-host 'tcp' "
+                         "transport; port 0 = ephemeral). Requires "
+                         "HOROVOD_SECRET in the environment — a TCP "
+                         "listener is network-reachable, so every "
+                         "connection must pass the shared-secret "
+                         "handshake")
     ap.add_argument("--params", required=True,
                     help="npz of model params (worker.save_params)")
     ap.add_argument("--config", required=True,
@@ -373,24 +415,65 @@ def main(argv=None) -> int:
     ap.add_argument("--rank", type=int, default=0,
                     help="replica id (heartbeat file + logs)")
     ap.add_argument("--heartbeat-dir", default="",
-                    help="fleet heartbeat directory ('' = no beacon)")
+                    help="fleet heartbeat directory ('' = no beacon; "
+                         "tcp workers normally run without one — "
+                         "liveness rides the transport)")
     args = ap.parse_args(argv)
+    if bool(args.socket) == bool(args.bind):
+        ap.error("exactly one of --socket (unix) or --bind host:port "
+                 "(tcp) is required")
 
     # Bind BEFORE the heavy init: the router's connect succeeds as soon
     # as the process is alive; its first RPCs wait inside their own
     # deadline for the engine to finish constructing.
-    try:
-        os.unlink(args.socket)
-    except OSError:
-        pass
-    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    try:
-        srv.bind(args.socket)
-    except OSError as e:
-        print(f"serve.worker[{args.rank}]: cannot bind {args.socket}: "
-              f"{e}", file=sys.stderr, flush=True)
-        return EXIT_USAGE
-    srv.listen(2)
+    secret = ""
+    if args.bind:
+        host, _, port_s = args.bind.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            print(f"serve.worker[{args.rank}]: --bind {args.bind!r} is "
+                  "not host:port", file=sys.stderr, flush=True)
+            return EXIT_USAGE
+        secret = os.environ.get("HOROVOD_SECRET", "")
+        if not secret:
+            print(f"serve.worker[{args.rank}]: --bind needs "
+                  "HOROVOD_SECRET in the environment — refusing to "
+                  "serve an unauthenticated network listener",
+                  file=sys.stderr, flush=True)
+            return EXIT_USAGE
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind((host or "0.0.0.0", port))
+        except OSError as e:
+            print(f"serve.worker[{args.rank}]: cannot bind "
+                  f"{args.bind}: {e}", file=sys.stderr, flush=True)
+            return EXIT_USAGE
+        srv.listen(2)
+        bound_port = srv.getsockname()[1]
+        # Advertised-address resolution (run/network.py's offline-safe
+        # fallback chain): which endpoint peers should dial when the
+        # bind address is a wildcard.
+        from horovod_tpu.run.network import advertise_ip
+
+        adv = host if host and host != "0.0.0.0" else advertise_ip()
+        print(f"serve.worker[{args.rank}]: tcp listener on "
+              f"{args.bind} (advertise {adv}:{bound_port})",
+              file=sys.stderr, flush=True)
+    else:
+        try:
+            os.unlink(args.socket)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            srv.bind(args.socket)
+        except OSError as e:
+            print(f"serve.worker[{args.rank}]: cannot bind "
+                  f"{args.socket}: {e}", file=sys.stderr, flush=True)
+            return EXIT_USAGE
+        srv.listen(2)
 
     import jax
 
@@ -411,14 +494,15 @@ def main(argv=None) -> int:
     hb = Heartbeat(args.heartbeat_dir, rank=args.rank) \
         if args.heartbeat_dir else None
 
-    host = WorkerHost(engine, hb)
-    rpc = threading.Thread(target=host.rpc_loop, args=(srv,),
+    host_loop = WorkerHost(engine, hb, secret=secret or None)
+    rpc = threading.Thread(target=host_loop.rpc_loop, args=(srv,),
                            daemon=True,
                            name=f"serve-worker-rpc-{args.rank}")
     rpc.start()
-    print(f"serve.worker[{args.rank}]: serving on {args.socket} "
-          f"(pid {os.getpid()})", file=sys.stderr, flush=True)
-    host.serve_loop()
+    print(f"serve.worker[{args.rank}]: serving on "
+          f"{args.bind or args.socket} (pid {os.getpid()})",
+          file=sys.stderr, flush=True)
+    host_loop.serve_loop()
     srv.close()
     return EXIT_CLEAN
 

@@ -229,6 +229,12 @@ _SLOW_TESTS = {
     "test_serve_worker.py::TestRealWorkerE2E::test_kill_redispatch_bit_exact_vs_lm_decode",
     "test_serve_worker.py::TestRealWorkerE2E::test_stall_watchdog_classified_relaunch",
     "test_serve_worker.py::TestRealWorkerE2E::test_kill_mid_write_torn_frame_redispatch_exact",
+    # Real-worker loopback-TCP partition e2e (round-14): same jax-spawn
+    # cost as the others; fast stand-ins are
+    # test_serve_fleet_tcp.py::TestStubTcpFleet (the whole host-domain
+    # recovery matrix over real TCP via the no-jax stub) and the
+    # tools/check.sh loopback-TCP fleet smoke.
+    "test_serve_worker.py::TestRealWorkerE2E::test_tcp_partition_host_down_bit_exact_vs_lm_decode",
 }
 
 

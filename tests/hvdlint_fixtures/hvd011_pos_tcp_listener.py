@@ -1,0 +1,20 @@
+"""HVD011 positive: a TCP listener that blocks forever in accept/recv.
+
+The multi-host fleet round's shape: a worker whose accept() has no
+timeout can never notice a shutdown flag, and its per-connection
+recv() with no deadline hangs on a peer that dies mid-write — the
+router sees a live process that serves nothing, with nothing for a
+watchdog to classify. The real worker polls accept() in 0.25 s slices
+and runs every recv through the deadline-sliced frame codec.
+"""
+
+
+def listener_loop(server_sock, handler):
+    while True:
+        conn, _ = server_sock.accept()  # EXPECT: HVD011
+        handle_connection(conn, handler)
+
+
+def handle_connection(conn, handler):
+    header = conn.recv(12)  # EXPECT: HVD011
+    handler(header)

@@ -66,11 +66,14 @@ def _load_transport():
 
 
 class StubHost:
-    def __init__(self, transport, slots, heartbeat_path, tick_s):
+    def __init__(self, transport, slots, heartbeat_path, tick_s,
+                 secret=None):
         self.T = transport
         self.slots = slots
         self.heartbeat_path = heartbeat_path
         self.tick_s = tick_s
+        self._secret = secret
+        self._hb = 0       # transport liveness seq (real-worker parity)
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._requests = {}    # rid -> dict(prompt, max_new, output)
@@ -102,6 +105,7 @@ class StubHost:
                 progressed = self._tick_locked()
                 if progressed:
                     self._ticks += 1
+            self._hb += 1
             if progressed and self._slow > 1.0:
                 time.sleep((self._slow - 1.0)
                            * max(time.monotonic() - t0, self.tick_s))
@@ -145,7 +149,8 @@ class StubHost:
         return fn(params)
 
     def _rpc_ping(self, p):
-        return {"pid": os.getpid(), "ticks": self._ticks}
+        return {"pid": os.getpid(), "ticks": self._ticks,
+                "hb": self._hb}
 
     def _rpc_submit(self, p):
         with self._lock:
@@ -161,6 +166,7 @@ class StubHost:
     def _rpc_step(self, p):
         with self._lock:
             return {"ticks": self._ticks,
+                    "hb": self._hb,
                     "free_slots": max(0, self.slots
                                       - len(self._requests)),
                     "occupancy": 0.0,
@@ -184,7 +190,8 @@ class StubHost:
                     "generated_len": len(req["output"]),
                 })
         self._collects += 1
-        return {"events": events, "progress": progress}
+        return {"events": events, "progress": progress,
+                "hb": self._hb}
 
     def _rpc_stats(self, p):
         with self._lock:
@@ -245,6 +252,10 @@ class StubHost:
             except OSError:
                 return
             with conn:
+                if self._secret:
+                    if not self.T.server_handshake(
+                            conn, self._secret, time.monotonic() + 5.0):
+                        continue
                 self.T.serve_connection(conn, self.handle,
                                         should_stop=self._shutdown.is_set,
                                         send_hook=self._send_hook)
@@ -258,7 +269,11 @@ def main(argv=None):
         return int(fail)
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--socket", required=True)
+    ap.add_argument("--socket", default="")
+    ap.add_argument("--bind", default="",
+                    help="tcp host:port instead of a unix socket "
+                         "(real-worker parity: requires "
+                         "HOROVOD_SECRET, handshake per connection)")
     ap.add_argument("--rank", type=int, default=0)
     ap.add_argument("--heartbeat-dir", default="")
     ap.add_argument("--slots", type=int, default=2)
@@ -267,6 +282,8 @@ def main(argv=None):
     ap.add_argument("--startup-delay", type=float, default=0.0,
                     help="sleep before binding (spawn-race tests)")
     args = ap.parse_args(argv)
+    if bool(args.socket) == bool(args.bind):
+        ap.error("exactly one of --socket / --bind required")
 
     if args.startup_delay > 0:
         time.sleep(args.startup_delay)
@@ -274,20 +291,34 @@ def main(argv=None):
     T = _load_transport()
     import socket as _socket
 
-    try:
-        os.unlink(args.socket)
-    except OSError:
-        pass
-    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-    srv.bind(args.socket)
-    srv.listen(2)
+    secret = None
+    if args.bind:
+        host, _, port_s = args.bind.rpartition(":")
+        secret = os.environ.get("HOROVOD_SECRET", "")
+        if not secret:
+            print("serve_stub_worker: --bind needs HOROVOD_SECRET",
+                  file=sys.stderr, flush=True)
+            return 2
+        srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        srv.bind((host or "0.0.0.0", int(port_s)))
+        srv.listen(2)
+    else:
+        try:
+            os.unlink(args.socket)
+        except OSError:
+            pass
+        srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        srv.bind(args.socket)
+        srv.listen(2)
 
     hb_path = ""
     if args.heartbeat_dir:
         os.makedirs(args.heartbeat_dir, exist_ok=True)
         hb_path = os.path.join(args.heartbeat_dir, f"hb-{args.rank}")
 
-    host = StubHost(T, args.slots, hb_path, args.tick_s)
+    host = StubHost(T, args.slots, hb_path, args.tick_s,
+                    secret=secret)
     rpc = threading.Thread(target=host.rpc_loop, args=(srv,),
                            daemon=True)
     rpc.start()

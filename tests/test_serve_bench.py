@@ -257,6 +257,15 @@ def test_fleet_cell_renders_synthetic_record():
     assert fleet_cell(proc) == "2r proc rpc 0.3/2.1ms crashed1 rd1/4tok"
     inp = {"serve": {"fleet": {"replicas": 2, "transport": "inproc"}}}
     assert fleet_cell(inp) == "2r inproc"
+    # tcp records tag the transport + host count; host_down incidents
+    # ride the incidents_by_class render like any other class.
+    tcp = {"serve": {"fleet": {
+        "replicas": 2, "transport": "tcp", "hosts": 2,
+        "rpc_ms": {"calls": 10, "p50": 0.4, "p99": 3.0},
+        "incidents_by_class": {"host_down": 1}, "redispatched": 4,
+        "tokens_recomputed": 18}}}
+    assert fleet_cell(tcp) == \
+        "2r tcp 2h rpc 0.4/3ms host_down1 rd4/18tok"
 
 
 class TestDecodeBenchSatellites:

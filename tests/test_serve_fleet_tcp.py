@@ -1,0 +1,374 @@
+"""Multi-host serving fleet: TCP transport, host failure domains,
+network fault injection (serve/fleet.py transport="tcp").
+
+Four lanes:
+
+* **construction-time validation** — the FleetConfig transport/hosts
+  matrix fails fast at construction, never at first spawn;
+* **host fault grammar** — ``kill:host=`` / ``partition:host=`` parse,
+  validate, and are range-checked at arm time;
+* **advertised-address resolution** — run/network.py's offline-host
+  fallback chain (route probe -> hostname -> loopback), the regression
+  for the air-gapped ``OSError`` that used to kill discovery;
+* **stub TCP fleet (fast)** — real OS processes on loopback TCP
+  behind the shared-secret handshake (tests/serve_stub_worker.py,
+  ``python -S``, no jax): partition -> ONE host_down incident with
+  every stream redispatch-bit-exact, kill:host= mass SIGKILL, and
+  stall detection over the TRANSPORT liveness channel (no heartbeat
+  files exist for tcp replicas — the sequence riding the RPC replies
+  is the only signal). The real-worker TCP e2e (greedy == lm_decode
+  across a partition) is slow-marked in tests/test_serve_worker.py.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.faults import (FaultPlanError, ServeFaultAction,
+                                        parse_serve_fault_plan)
+from horovod_tpu.run import network
+from horovod_tpu.serve import (FleetConfig, ServeConfig, ServeFleet,
+                               TcpReplica)
+from tests.serve_stub_worker import expected_stream
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STUB = os.path.join(HERE, "serve_stub_worker.py")
+STUB_PARAMS = {"pos": np.zeros((64, 4), np.float32)}
+
+
+# ------------------------------------------------------------ validation
+
+
+class TestFleetConfigTcp:
+    """Satellite: transport/hosts combinations fail fast at
+    CONSTRUCTION — a malformed placement never survives to a spawn."""
+
+    def test_hosts_without_tcp_transport_raises(self):
+        with pytest.raises(ValueError, match="transport='tcp'"):
+            FleetConfig(hosts=("hosta:5000",))
+        with pytest.raises(ValueError, match="transport='tcp'"):
+            FleetConfig(transport="process", hosts=("hosta:5000",))
+
+    def test_unix_socket_path_entry_raises(self):
+        with pytest.raises(ValueError, match="unix-socket path"):
+            FleetConfig(transport="tcp", hosts=("/tmp/worker.sock",))
+
+    def test_duplicate_host_port_raises(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetConfig(transport="tcp",
+                        hosts=("a:5000", "b:6000", "a:5000"))
+
+    def test_remote_host_without_port_raises(self):
+        with pytest.raises(ValueError, match="base port"):
+            FleetConfig(transport="tcp", hosts=("remotebox",))
+
+    def test_bad_ports_raise(self):
+        with pytest.raises(ValueError, match="not an integer"):
+            FleetConfig(transport="tcp", hosts=("a:http",))
+        with pytest.raises(ValueError, match="65535"):
+            FleetConfig(transport="tcp", hosts=("a:70000",))
+        with pytest.raises(ValueError, match="65535"):
+            FleetConfig(transport="tcp", hosts=("a:0",))
+
+    def test_single_string_hosts_raises(self):
+        with pytest.raises(ValueError, match="not the single string"):
+            FleetConfig(transport="tcp", hosts="127.0.0.1:5000")
+
+    def test_valid_forms_normalize_to_tuple(self):
+        c = FleetConfig(transport="tcp",
+                        hosts=["127.0.0.1", "localhost:9000",
+                               "hostb:47000"])
+        assert c.hosts == ("127.0.0.1", "localhost:9000", "hostb:47000")
+        assert isinstance(c.hosts, tuple)
+        # tcp without hosts is the loopback CI lane
+        assert FleetConfig(transport="tcp").hosts is None
+
+
+# --------------------------------------------------------- fault grammar
+
+
+class TestHostFaultGrammar:
+    def test_kill_host_and_partition_parse(self):
+        a, b = parse_serve_fault_plan(
+            "kill:host=1,at=2.5s; partition:host=0,at=50%,secs=2")
+        assert a.kind == "kill" and a.host == 1 and a.replica is None
+        assert a.at == 2.5
+        assert b.kind == "partition" and b.host == 0
+        assert b.at_frac == 0.5 and b.secs == 2.0
+        assert "host=0" in str(b) and "secs=2" in str(b)
+
+    def test_partition_without_secs_is_forever(self):
+        (a,) = parse_serve_fault_plan("partition:host=0,at=1s")
+        assert a.secs is None
+
+    @pytest.mark.parametrize("plan, match", [
+        ("partition:replica=0,at=1s", "host-addressed"),
+        ("stall:host=0,at=1s", "replica-addressed"),
+        ("slow:host=0,at=1s,factor=2", "replica-addressed"),
+        ("kill:replica=0,host=1,at=1s", "exactly one"),
+        ("partition:host=-1,at=1s", ">= 0"),
+        ("partition:host=x,at=1s", "not an integer"),
+        ("partition:host=0,at=1s,factor=2", "only applies to"),
+        ("partition:host=0,at=1s,secs=0", "> 0"),
+    ])
+    def test_malformed_host_plans_fail_fast(self, plan, match):
+        with pytest.raises(FaultPlanError, match=match):
+            parse_serve_fault_plan(plan)
+
+    def test_hand_built_actions_validate(self):
+        with pytest.raises(FaultPlanError, match="host-addressed"):
+            ServeFaultAction(kind="partition", replica=0, at=1.0
+                             ).validate()
+        ServeFaultAction(kind="partition", host=0, at=1.0).validate()
+        ServeFaultAction(kind="kill", host=2, at=0.0).validate()
+
+
+# ---------------------------------------------------- address resolution
+
+
+class TestAdvertiseIp:
+    """Satellite: the route-probe OSError on air-gapped hosts must
+    degrade through hostname resolution to loopback — never kill
+    address discovery."""
+
+    def test_route_probe_oserror_falls_back_to_hostname(self, monkeypatch):
+        monkeypatch.setattr(network, "_route_probe_ip", lambda: None)
+        monkeypatch.setattr(network, "_hostname_ips",
+                            lambda: ["127.0.0.1", "10.1.2.3"])
+        assert network.advertise_ip() == "10.1.2.3"
+
+    def test_everything_failing_degrades_to_loopback(self, monkeypatch):
+        monkeypatch.setattr(network, "_route_probe_ip", lambda: None)
+        monkeypatch.setattr(network, "_hostname_ips", lambda: [])
+        assert network.advertise_ip() == "127.0.0.1"
+
+    def test_route_probe_swallows_oserror(self, monkeypatch):
+        import socket as _socket
+
+        class _Boom:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def connect(self, addr):
+                raise OSError("Network is unreachable")
+
+        monkeypatch.setattr(network.socket, "socket", _Boom)
+        assert network._route_probe_ip() is None
+        assert _socket.socket is not _Boom or True
+
+    def test_candidate_addresses_never_raise_offline(self, monkeypatch):
+        monkeypatch.setattr(network, "_route_probe_ip", lambda: None)
+        monkeypatch.setattr(network, "_hostname_ips", lambda: [])
+        assert network.candidate_addresses(7000) == ["127.0.0.1:7000"]
+
+
+# --------------------------------------------------------- stub tcp fleet
+
+
+def _stub_tcp_cmd(extra_env=None, extra_args=(), per_rid_env=None,
+                  seen=None):
+    """worker_cmd hook launching the protocol stub over TCP. The fleet
+    hands the bind endpoint (host:port) instead of a socket path;
+    everything else (env incl. the fleet's HOROVOD_SECRET) rides the
+    default. ``per_rid_env`` applies to a replica's FIRST incarnation
+    only (fault hooks must not re-fire on the relaunch)."""
+    seen = seen if seen is not None else {}
+
+    def cmd(rid, endpoint, default):
+        _, denv = default
+        argv = [sys.executable, "-S", STUB, "--bind", endpoint,
+                "--rank", str(rid), "--slots", "2"] + list(extra_args)
+        env = dict(denv)
+        env.update(extra_env or {})
+        if seen.setdefault(rid, 0) == 0:
+            env.update((per_rid_env or {}).get(rid, {}))
+        seen[rid] += 1
+        return argv, env
+
+    return cmd
+
+
+def _stub_fleet(worker_cmd=None, **fleet_kw):
+    fleet_kw.setdefault("replicas", 2)
+    fleet_kw.setdefault("transport", "tcp")
+    fleet_kw.setdefault("backoff_base", 0.01)
+    fleet_kw.setdefault("rpc_deadline", 10.0)
+    fleet_kw.setdefault("max_restarts", 4)
+    return ServeFleet(STUB_PARAMS,
+                      ServeConfig(page_size=8, num_pages=32,
+                                  decode_slots=2, prefill_chunk=4),
+                      FleetConfig(**fleet_kw),
+                      worker_cmd=worker_cmd or _stub_tcp_cmd())
+
+
+def _prompts(n, base=3):
+    return [list(range(base + i, base + i + 4 + i % 3)) for i in range(n)]
+
+
+def _assert_reaped(fl):
+    for rep in fl.replicas:
+        assert isinstance(rep, TcpReplica)
+        assert rep.proc.poll() is not None, (
+            f"replica {rep.id} pid {rep.proc.pid} not reaped (zombie)")
+
+
+def _run_until(fl, reqs, timeout=30.0):
+    t0 = time.monotonic()
+    while not fl.idle and time.monotonic() - t0 < timeout:
+        fl.run(max_steps=fl.steps + 50)
+        if not fl.idle:
+            time.sleep(0.01)
+    assert fl.idle, [r.state for r in reqs]
+
+
+class TestStubTcpFleet:
+    def test_clean_run_streams_exact_over_tcp(self):
+        fl = _stub_fleet()
+        try:
+            prompts = _prompts(5)
+            reqs = [fl.submit(np.asarray(p, np.int32), 4 + i % 3)
+                    for i, p in enumerate(prompts)]
+            _run_until(fl, reqs)
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, r.orig_max_new)
+            f = fl.stats()["fleet"]
+            assert f["transport"] == "tcp"
+            assert f["hosts"] == 1 and f["host_incidents"] == 0
+            assert f["rpc_ms"]["calls"] > 0
+            assert f["transport_incidents"] == {}
+            # tcp replicas never write heartbeat FILES — liveness is
+            # the transport sequence, aged by the router's clock
+            assert not any(n.startswith("hb-")
+                           for n in os.listdir(fl.heartbeat_dir))
+            assert all(r.hb_at is not None for r in fl.replicas)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+        fl.close()   # idempotent
+
+    def test_partition_is_one_host_down_mass_redispatch(self):
+        """The acceptance shape on the fast stub: partition the whole
+        (single) host mid-run — BOTH replicas die in ONE classified
+        host_down incident, every request redispatches and finishes
+        its bit-identical stream, and nothing leaks."""
+        fl = _stub_fleet(worker_cmd=_stub_tcp_cmd(
+            extra_args=["--tick-s", "0.02"]))
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 8)
+                    for p in prompts]
+            for _ in range(4):
+                fl.step()
+            fl.arm_fault_plan("partition:host=0,at=0s,secs=0.5")
+            _run_until(fl, reqs)
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"host_down": 1}, f
+            assert f["host_incidents"] == 1
+            inc = [i for i in fl.incidents
+                   if i["category"] == "host_down"][0]
+            assert inc["host"] == 0 and inc["cause"] == "transport"
+            assert len(inc["replicas"]) == 2
+            assert inc["redispatched"] >= 1
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, 8), (p, r.output)
+            assert any(r.redispatches for r in reqs)
+            assert f["failed"] == 0
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_kill_host_fault_mass_sigkills(self):
+        import signal as _signal
+
+        fl = _stub_fleet(worker_cmd=_stub_tcp_cmd(
+            extra_args=["--tick-s", "0.02"]))
+        try:
+            prompts = _prompts(4)
+            reqs = [fl.submit(np.asarray(p, np.int32), 8)
+                    for p in prompts]
+            for _ in range(3):
+                fl.step()
+            pids = [rep.proc for rep in fl.replicas]
+            fl.arm_fault_plan("kill:host=0,at=0s")
+            _run_until(fl, reqs)
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"host_down": 1}, f
+            inc = fl.incidents[0]
+            assert inc["cause"] == "kill"
+            # genuine SIGKILLs of real OS processes, reaped codes
+            assert all(d["code"] == -_signal.SIGKILL
+                       for d in inc["replicas"]), inc
+            assert all(p.poll() == -_signal.SIGKILL for p in pids)
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, 8)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_stall_detected_via_transport_liveness(self):
+        """A stalled tcp worker stops bumping its heartbeat SEQUENCE
+        while its RPC thread keeps answering — there is no heartbeat
+        file for the watchdog to stat, so only the transport channel
+        (aged by the router's clock) can classify it stalled."""
+        fl = _stub_fleet(watchdog_timeout=0.6,
+                         worker_cmd=_stub_tcp_cmd(
+                             extra_args=["--tick-s", "0.01"]))
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 12)
+                    for p in prompts]
+            for _ in range(3):
+                fl.step()
+            fl.arm_fault_plan("stall:replica=0,at=0s")
+            _run_until(fl, reqs, timeout=30.0)
+            f = fl.stats()["fleet"]
+            assert f["incidents_by_class"] == {"stalled": 1}, f
+            assert f["detect_s"] is not None and f["detect_s"] >= 0.6
+            assert f["host_incidents"] == 0   # one wedged process != host
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == expected_stream(p, 12)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_host_fault_validation_at_arm_time(self):
+        fl = _stub_fleet()
+        try:
+            with pytest.raises(FaultPlanError, match="outside"):
+                fl.arm_fault_plan("partition:host=1,at=1s,secs=1")
+            fl.arm_fault_plan("partition:host=0,at=1000s,secs=1")
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_host_faults_rejected_on_non_tcp_fleet(self):
+        # An inproc fleet: hosts are not a failure domain there —
+        # arming a host-addressed fault must fail fast.
+        import jax
+
+        from horovod_tpu.models import parallel_lm as plm
+
+        params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1,
+                                    1, 4, 8)
+        fl = ServeFleet(params,
+                        ServeConfig(page_size=8, num_pages=16,
+                                    decode_slots=1, prefill_chunk=4),
+                        FleetConfig(replicas=1))
+        try:
+            with pytest.raises(FaultPlanError, match="tcp transport"):
+                fl.arm_fault_plan("kill:host=0,at=1s")
+        finally:
+            fl.close()

@@ -22,13 +22,15 @@ import time
 
 import pytest
 
+from horovod_tpu.serve.netfault import FaultableSocket, NetFaults
 from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
                                          DeadlineExceeded, FrameError,
                                          HEADER_LEN, MAX_FRAME,
                                          RemoteCallError, RpcClient,
                                          TransportError, encode_frame,
                                          recv_frame, send_frame,
-                                         serve_connection)
+                                         serve_connection,
+                                         server_handshake)
 
 
 def _pair():
@@ -282,4 +284,242 @@ class TestRpcClient:
         for _ in range(3):
             assert c.call("ping") == {"pong": True}
         assert len(samples) == 3 and all(s >= 0 for s in samples)
+        srv.close()
+
+
+class _FakeTcpServer:
+    """Thread-served loopback TCP listener with a scriptable
+    per-connection behavior (the TCP twin of :class:`_FakeServer`;
+    serves until closed so handshake-reject tests can reconnect)."""
+
+    def __init__(self, behavior):
+        self._behavior = behavior
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(2)
+        self.addr = ("127.0.0.1", self._srv.getsockname()[1])
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._srv.settimeout(0.1)
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    self._behavior(conn)
+                except Exception:
+                    pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._thread.join(2.0)
+
+
+def _serve_authed(secret, handler=lambda m, p: {"pong": True}):
+    """A worker-faithful TCP behavior: handshake gate, then the RPC
+    loop."""
+
+    def behavior(conn):
+        if not server_handshake(conn, secret, time.monotonic() + 2.0):
+            return
+        serve_connection(conn, handler, idle_timeout=2.0)
+
+    return behavior
+
+
+class TestTcpHandshake:
+    """The TCP lane's admission contract: a listener is
+    network-reachable, so nothing is served before the shared-secret
+    challenge/response passes — and every way it can fail is typed."""
+
+    def test_matching_secret_serves_rpcs(self):
+        srv = _FakeTcpServer(_serve_authed("s3cret"))
+        c = RpcClient(srv.addr, default_timeout=2.0, secret="s3cret")
+        assert c.call("ping") == {"pong": True}
+        assert c.call("ping") == {"pong": True}   # same conn, one shake
+        c.close()
+        srv.close()
+
+    def test_wrong_secret_is_typed_rejection(self):
+        srv = _FakeTcpServer(_serve_authed("right"))
+        c = RpcClient(srv.addr, default_timeout=2.0, secret="wrong")
+        with pytest.raises(ConnectionLost, match="handshake rejected"):
+            c.call("ping")
+        assert not c.connected
+        srv.close()
+
+    def test_secretless_client_never_reaches_the_handler(self):
+        served = []
+        srv = _FakeTcpServer(_serve_authed(
+            "right", lambda m, p: served.append(m) or {}))
+        c = RpcClient(srv.addr, default_timeout=1.0)   # no secret
+        with pytest.raises(TransportError):
+            c.call("ping")
+        assert served == []
+        srv.close()
+
+    def test_non_ascii_auth_is_rejected_not_a_crash(self):
+        """An adversarial peer sending a non-ASCII auth value must be
+        DROPPED, never crash the worker's accept thread (str-mode
+        compare_digest raises TypeError on non-ASCII — the handshake
+        compares bytes for exactly this reason). The listener must
+        still serve the next, honest client."""
+        srv = _FakeTcpServer(_serve_authed("s3cret"))
+        raw = socket.create_connection(srv.addr, timeout=2.0)
+        challenge = recv_frame(raw, _deadline(2.0))
+        assert "nonce" in challenge
+        send_frame(raw, {"auth": "über-hacker"}, _deadline(2.0))
+        ack = recv_frame(raw, _deadline(2.0))
+        assert ack == {"ok": False}
+        raw.close()
+        good = RpcClient(srv.addr, default_timeout=2.0,
+                         secret="s3cret")
+        assert good.call("ping") == {"pong": True}
+        good.close()
+        srv.close()
+
+    def test_non_ascii_nonce_resolves_typed_and_closes_socket(self):
+        """A spoofed listener replying with a non-ASCII nonce must
+        resolve through the typed taxonomy (utf-8 MAC: the client just
+        computes a MAC the impostor can't validate), and the client's
+        socket must not leak on the rejection."""
+
+        def behavior(conn):
+            send_frame(conn, {"hvsf": 1, "nonce": "café"},
+                       _deadline(2.0))
+            recv_frame(conn, _deadline(2.0))
+            send_frame(conn, {"ok": False}, _deadline(2.0))
+
+        srv = _FakeTcpServer(behavior)
+        c = RpcClient(srv.addr, default_timeout=2.0, secret="s")
+        with pytest.raises(ConnectionLost, match="handshake rejected"):
+            c.call("ping")
+        assert not c.connected
+        srv.close()
+
+    def test_tcp_refused_fails_fast_with_dead_proc(self):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+        c = RpcClient(("127.0.0.1", dead_port), default_timeout=5.0,
+                      proc_alive=lambda: False)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionLost, match="startup"):
+            c.call("ping")
+        assert time.monotonic() - t0 < 1.0
+
+
+class TestNetFaultInjector:
+    """serve/netfault.py: every injected network failure resolves as a
+    typed TransportError subclass within its deadline — never a hang,
+    never a mis-parse (the fault-injector satellite)."""
+
+    def _authed_client(self, srv, faults, timeout=2.0):
+        return RpcClient(srv.addr, default_timeout=timeout,
+                         secret="s", sock_wrap=faults.wrap)
+
+    def test_partition_blackhole_hits_deadline(self):
+        srv = _FakeTcpServer(_serve_authed("s"))
+        faults = NetFaults()
+        c = self._authed_client(srv, faults, timeout=2.0)
+        assert c.call("ping") == {"pong": True}
+        faults.partition()    # forever: only the deadline can resolve it
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            c.call("step", timeout=0.6)
+        assert time.monotonic() - t0 < 3.0
+        srv.close()
+
+    def test_partition_heal_resets_half_open_connection(self):
+        """The host-returns shape: a window SHORTER than the deadline
+        must still be detected — the pre-partition connection comes
+        back half-open and resets, typed ConnectionLost, promptly."""
+        srv = _FakeTcpServer(_serve_authed("s"))
+        faults = NetFaults()
+        c = self._authed_client(srv, faults, timeout=10.0)
+        assert c.call("ping") == {"pong": True}
+        faults.partition(secs=0.4)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionLost, match="reset"):
+            c.call("step")
+        # ~the window, nowhere near the generous 10 s deadline
+        assert time.monotonic() - t0 < 3.0
+        srv.close()
+
+    def test_post_partition_fresh_connection_is_clean(self):
+        srv = _FakeTcpServer(_serve_authed("s"))
+        faults = NetFaults()
+        c = self._authed_client(srv, faults)
+        assert c.call("ping") == {"pong": True}
+        faults.partition(secs=0.1)
+        time.sleep(0.15)
+        with pytest.raises(ConnectionLost):
+            c.call("ping")     # old conn: half-open reset
+        c2 = self._authed_client(srv, faults)
+        assert c2.call("ping") == {"pong": True}   # born after: clean
+        c2.close()
+        srv.close()
+
+    def test_delay_past_deadline_is_typed(self):
+        srv = _FakeTcpServer(_serve_authed("s"))
+        faults = NetFaults()
+        c = self._authed_client(srv, faults)
+        assert c.call("ping") == {"pong": True}
+        faults.delay_s = 5.0
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            c.call("step", timeout=0.5)
+        assert time.monotonic() - t0 < 3.0
+        srv.close()
+
+    def test_trickle_within_deadline_completes(self):
+        srv = _FakeTcpServer(_serve_authed("s"))
+        faults = NetFaults()
+        faults.trickle_bytes = 3
+        c = self._authed_client(srv, faults, timeout=5.0)
+        assert c.call("ping") == {"pong": True}
+        srv.close()
+
+    def test_trickle_past_deadline_is_typed(self):
+        srv = _FakeTcpServer(_serve_authed(
+            "s", lambda m, p: {"big": list(range(2000))}))
+        faults = NetFaults()
+        c = self._authed_client(srv, faults, timeout=5.0)
+        assert c.call("ping")["big"][:3] == [0, 1, 2]
+        faults.trickle_bytes = 1
+        faults.delay_s = 0.05   # 1 byte per 50 ms: a ~9KB reply can't fit
+        with pytest.raises(DeadlineExceeded):
+            c.call("ping", timeout=0.5)
+        srv.close()
+
+    def test_tear_mid_frame_is_torn_frame_at_peer(self):
+        """Server-side injection: the worker dies mid-write of its
+        Nth frame — the client's codec must type it, never mis-parse."""
+        faults = NetFaults()
+        faults.tear_send_frame = 3   # challenge, ack, then TEAR reply 1
+
+        def behavior(conn):
+            wrapped = faults.wrap(conn)
+            if not server_handshake(wrapped, "s",
+                                    time.monotonic() + 2.0):
+                return
+            serve_connection(wrapped, lambda m, p: {"pong": True},
+                             idle_timeout=2.0)
+
+        srv = _FakeTcpServer(behavior)
+        c = RpcClient(srv.addr, default_timeout=2.0, secret="s")
+        with pytest.raises(FrameError, match="torn"):
+            c.call("ping")
         srv.close()

@@ -406,6 +406,39 @@ class TestRealWorkerE2E:
             fl.close()
         _assert_reaped(fl)
 
+    def test_tcp_partition_host_down_bit_exact_vs_lm_decode(self):
+        """Round-14 acceptance, real-worker edition: a 2-replica fleet
+        on loopback TCP, the whole host network-partitioned mid-run —
+        ONE classified host_down incident, both workers reaped and
+        relaunched, and every greedy stream still bit-identical to
+        lm_decode (the redispatch pin is transport-agnostic)."""
+        params, cfg, V = _lm_setup()
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=2, transport="tcp",
+                                    backoff_base=0.01, max_restarts=4,
+                                    rpc_deadline=60.0),
+                        worker_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            _warm(fl)
+            prompts = _lm_prompts(V, 6)
+            reqs = [fl.submit(p, 10) for p in prompts]
+            for _ in range(4):
+                fl.step()
+            fl.arm_fault_plan("partition:host=0,at=0s,secs=2")
+            fl.run()
+            f = fl.stats()["fleet"]
+            assert f["transport"] == "tcp"
+            assert f["incidents_by_class"] == {"host_down": 1}, f
+            assert f["host_incidents"] == 1
+            assert f["failed"] == 0
+            assert f["rpc_ms"]["p50"] is not None
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == _lm_ref(params, p, 10)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
     def test_kill_mid_write_torn_frame_redispatch_exact(self):
         """The satellite's e2e pin: a worker killed MID-WRITE of a
         collect reply leaves half a frame on the wire; the codec

@@ -63,6 +63,15 @@
 #   tools/check.sh --no-serve   skip the serving smoke
 #   tools/check.sh --no-fleet   skip the fleet smoke
 #   tools/check.sh --no-fleet-proc  skip the process-fleet smoke
+#   tools/check.sh --no-fleet-tcp   skip the loopback-TCP fleet smoke
+#                               (round-14 tentpole: 2 workers on
+#                               127.0.0.1 behind the TCP transport,
+#                               the whole host network-partitioned for
+#                               2 s mid-run — ONE classified host_down
+#                               incident, every replica drained +
+#                               redispatched, all requests finish
+#                               redispatch-pin-exact, and zero worker
+#                               processes survive close())
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -78,6 +87,7 @@ ELASTIC=1
 SERVE=1
 FLEET=1
 FLEET_PROC=1
+FLEET_TCP=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -87,9 +97,10 @@ for arg in "$@"; do
     --no-serve) SERVE=0 ;;
     --no-fleet) FLEET=0 ;;
     --no-fleet-proc) FLEET_PROC=0 ;;
+    --no-fleet-tcp) FLEET_TCP=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -216,6 +227,51 @@ print("process-fleet smoke: real SIGKILL -> crashed(code -9), "
     exit 1
   fi
   echo "process-fleet smoke: zero surviving worker processes"
+fi
+
+if [[ "$FLEET_TCP" == "1" ]]; then
+  echo "== loopback-TCP fleet smoke (2 workers on 127.0.0.1, host 0 partitioned 2s mid-run: ONE host_down incident, redispatch pin-exact, no zombies) =="
+  PRE_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  FLEETT_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 200 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --fleet 2 --fleet-transport tcp \
+    --fleet-max-restarts 4 \
+    --fault-plan "partition:host=0,at=50%,secs=2" \
+    --pin-exact --require-finished)
+  echo "$FLEETT_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "fleet_fault_ab", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+f = s["fleet"]
+assert f["transport"] == "tcp", f["transport"]
+# the partition took the whole HOST: one aggregated incident, never
+# silent, never N separate deadline-trickle incidents
+assert f["incidents_by_class"].get("host_down") == 1, f["incidents_by_class"]
+assert f["host_incidents"] == 1, f["host_incidents"]
+assert f["redispatched"] >= 1, f
+assert f["failed"] == 0, f
+assert f["rpc_ms"]["calls"] > 0 and f["rpc_ms"]["p50"] is not None, f
+ab = s["fleet_ab"]
+assert ab["redispatch_pin"]["identical"] is True
+assert ab["redispatch_pin"]["compared"] == 8, ab["redispatch_pin"]
+print("loopback-TCP fleet smoke: partition -> host_down x1, "
+      "%d redispatched (%d KV tokens recomputed), all 8 pin-exact, "
+      "rpc p50/p99 %s/%s ms" % (
+          f["redispatched"], f["tokens_recomputed"],
+          f["rpc_ms"]["p50"], f["rpc_ms"]["p99"]))
+'
+  POST_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  LEAKED=$(comm -13 <(echo "$PRE_WORKERS" | sort) <(echo "$POST_WORKERS" | sort) | tr -d '[:space:]')
+  if [[ -n "$LEAKED" ]]; then
+    echo "loopback-TCP fleet smoke: ORPHANED worker processes survive:" >&2
+    pgrep -af "horovod_tpu.serve.worker" >&2
+    exit 1
+  fi
+  echo "loopback-TCP fleet smoke: zero surviving worker processes"
 fi
 
 if [[ "$HIER" == "1" ]]; then

@@ -31,10 +31,11 @@ than generic style:
   and no attempt counter — the crash-loop / retry-storm shape the
   elastic supervisor's budget + backoff (and the serving fleet's
   exponential backoff) exist to prevent.
-* **HVD011** blocking ``recv``/``read``/``readline`` on a socket or
-  pipe with no timeout/deadline in scope — the silent-hang shape the
-  serving-fleet transport (every receive deadline-checked, every
-  failure a typed TransportError) must never have.
+* **HVD011** blocking ``recv``/``accept``/``read``/``readline`` on a
+  socket or pipe with no timeout/deadline in scope — the silent-hang
+  shape the serving-fleet transport (every receive deadline-checked,
+  every failure a typed TransportError; listeners accept in poll
+  slices) must never have.
 
 Run as ``python -m tools.hvdlint <paths...>``; suppress a finding with
 a ``# hvdlint: disable=HVDxxx`` comment on (or immediately above) the

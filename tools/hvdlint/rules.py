@@ -873,7 +873,12 @@ def check_hvd010(tree: ast.AST) -> List[RawFinding]:
 
 #: Method names that are ALWAYS a blocking network receive (socket
 #: API); these fire regardless of what the receiver is called.
-RECEIVE_CALL_NAMES = {"recv", "recvfrom", "recv_into", "recvmsg"}
+#: ``accept`` belongs here since the TCP-listener round: a listener
+#: blocked in accept() with no timeout can never notice shutdown —
+#: the serving-fleet workers poll it in 0.25 s slices for exactly
+#: that reason.
+RECEIVE_CALL_NAMES = {"recv", "recvfrom", "recv_into", "recvmsg",
+                      "accept"}
 
 #: Stream-read spellings that are only a hang risk on a socket/pipe —
 #: gated on the receiver's name so ordinary file ``f.read()`` stays

@@ -140,6 +140,26 @@ LANES = [
                              "--fleet-transport", "process",
                              "--fault-plan", "kill:replica=1,at=40%",
                              "--require-finished"]),
+    # Loopback-TCP fleet A/B (round-14 tentpole, serve/transport.py tcp
+    # + serve/netfault.py): the SAME workload through a 2-replica fleet
+    # on the TCP transport, with the whole HOST network-partitioned for
+    # 2 s mid-run — the deterministic injector darkens every connection
+    # to the host at the transport seam, detection rides the typed
+    # taxonomy (deadline expiry or the half-open reset when the window
+    # ends), BOTH replicas drain + redispatch as ONE classified
+    # host_down incident, and every greedy stream still finishes
+    # bit-identical to the clean run. serve.fleet stamps
+    # transport="tcp" + hosts + host_incidents + rpc overhead on both
+    # sides, so the record pair prices what the extra transport hop
+    # and a whole-host loss cost.
+    ("serve_fleet_tcp_ab", ["tools/serve_bench.py", "--requests", "64",
+                            "--rate", "8", "--new-min", "16",
+                            "--new-max", "256", "--fleet", "2",
+                            "--fleet-transport", "tcp",
+                            "--fleet-max-restarts", "4",
+                            "--fault-plan",
+                            "partition:host=0,at=50%,secs=2",
+                            "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

@@ -192,9 +192,11 @@ def fleet_cell(rec):
     process transport (per-RPC overhead p50/p99), one crashed incident,
     3 requests redispatched (10 KV tokens recomputed), worst
     stale-heartbeat time-to-detect, 2 requests shed, faulted-over-clean
-    p99 TTFT from the fault A/B. Pre-transport records carry no
-    transport key and render untagged (they were inproc); non-fleet
-    records render as em-dash."""
+    p99 TTFT from the fault A/B. TCP fleets render the ``tcp`` tag plus
+    their host count ("2r tcp 1h ... host_down1 ...") — host_down
+    incidents ride the incidents_by_class render. Pre-transport records
+    carry no transport key and render untagged (they were inproc);
+    non-fleet records render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -204,7 +206,9 @@ def fleet_cell(rec):
     cell = f"{f.get('replicas', '?')}r"
     transport = f.get("transport")
     if transport:
-        cell += " " + ("proc" if transport == "process" else "inproc")
+        cell += " " + {"process": "proc"}.get(transport, transport)
+        if transport == "tcp" and f.get("hosts"):
+            cell += f" {f['hosts']}h"
     rpc = f.get("rpc_ms") or {}
     if rpc.get("p50") is not None:
         p99 = rpc.get("p99")

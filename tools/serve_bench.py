@@ -48,14 +48,20 @@ Modes:
               redispatched count, KV tokens recomputed, faulted-vs-
               clean p99 TTFT) in ``serve.fleet`` / ``serve.fleet_ab``.
               Exclusive with --ab/--static/--ab-attention.
-  --fleet-transport inproc|process
+  --fleet-transport inproc|process|tcp
               replica placement for the fleet: in this process (fast
-              lane), or one worker OS process per replica behind the
+              lane), one worker OS process per replica behind the
               deadline-checked framed RPC transport — kill: faults
               then SIGKILL a REAL process, the incident classifies
               through the reaped exit code, and ``serve.fleet`` stamps
               ``transport``, per-RPC overhead p50/p99 (``rpc_ms``) and
-              ``transport_incidents`` on BOTH sides of the fault A/B.
+              ``transport_incidents`` on BOTH sides of the fault A/B —
+              or the same frame protocol over TCP (shared-secret
+              handshake, ``--fleet-hosts`` host placement): a HOST is
+              then a failure domain (``kill:host=`` mass-kills,
+              ``partition:host=,at=,secs=`` darkens the NIC via the
+              deterministic injector) and ``serve.fleet`` additionally
+              stamps ``hosts``/``host_incidents`` on both A/B sides.
 
 ``--pin-exact`` re-decodes every finished request through
 ``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
@@ -280,7 +286,8 @@ def main() -> int:
     ap.add_argument("--fleet", type=int, default=0,
                     help="run a fault-tolerant N-replica fleet behind "
                          "the least-loaded router (0 = single engine)")
-    ap.add_argument("--fleet-transport", choices=("inproc", "process"),
+    ap.add_argument("--fleet-transport",
+                    choices=("inproc", "process", "tcp"),
                     default="inproc",
                     help="replica placement: inproc = engines in this "
                          "process (fast lane); process = one "
@@ -289,7 +296,17 @@ def main() -> int:
                          "checked RPC transport (real crash "
                          "isolation; kill: faults become genuine "
                          "SIGKILLs and the record stamps per-RPC "
-                         "overhead + transport incidents)")
+                         "overhead + transport incidents); tcp = the "
+                         "same frame protocol over TCP with a shared-"
+                         "secret handshake and HOST failure domains "
+                         "(--fleet-hosts; kill:host=/partition:host= "
+                         "faults, host_down incidents)")
+    ap.add_argument("--fleet-hosts", default="",
+                    help="comma-separated 'host[:port]' placement for "
+                         "--fleet-transport tcp (port = that host's "
+                         "base port; remote hosts are reached over "
+                         "ssh and require one). Empty = all workers "
+                         "on loopback")
     ap.add_argument("--fleet-rpc-deadline", type=float, default=60.0,
                     help="per-RPC deadline seconds (process transport; "
                          "must exceed the worst single worker step "
@@ -333,6 +350,9 @@ def main() -> int:
     if args.fault_plan and not args.fleet:
         ap.error("--fault-plan requires --fleet N (faults address "
                  "fleet replicas)")
+    if args.fleet_hosts and args.fleet_transport != "tcp":
+        ap.error("--fleet-hosts places workers over the network and "
+                 "needs --fleet-transport tcp")
     if args.fault_plan:
         from horovod_tpu.elastic.faults import (FaultPlanError,
                                                 parse_serve_fault_plan)
@@ -341,10 +361,22 @@ def main() -> int:
             plan_actions = parse_serve_fault_plan(args.fault_plan)
         except FaultPlanError as e:
             ap.error(str(e))
+        n_hosts = len([h for h in args.fleet_hosts.split(",")
+                       if h.strip()]) or 1
         for a in plan_actions:
-            if a.replica >= args.fleet:
+            if a.replica is not None and a.replica >= args.fleet:
                 ap.error(f"fault action {a}: replica {a.replica} is "
                          f"outside --fleet {args.fleet}")
+            if a.host is not None:
+                if args.fleet_transport != "tcp":
+                    ap.error(f"fault action {a}: host-addressed faults "
+                             "(kill:host=/partition:) need "
+                             "--fleet-transport tcp — hosts are not a "
+                             "failure domain on the "
+                             f"{args.fleet_transport} transport")
+                if a.host >= n_hosts:
+                    ap.error(f"fault action {a}: host {a.host} is "
+                             f"outside the {n_hosts}-host placement")
         if any(a.kind == "stall" for a in plan_actions) and \
                 args.fleet_watchdog_timeout <= 0:
             ap.error("stall: fault plans need --fleet-watchdog-timeout "
@@ -392,13 +424,19 @@ def main() -> int:
     if args.fleet:
         from horovod_tpu.serve import FleetConfig
 
-        fleet_cfg = FleetConfig(
-            replicas=args.fleet, max_queue=args.fleet_max_queue,
-            max_restarts=args.fleet_max_restarts,
-            backoff_base=args.fleet_backoff,
-            watchdog_timeout=args.fleet_watchdog_timeout,
-            transport=args.fleet_transport,
-            rpc_deadline=args.fleet_rpc_deadline)
+        hosts = tuple(h.strip() for h in args.fleet_hosts.split(",")
+                      if h.strip()) or None
+        try:
+            fleet_cfg = FleetConfig(
+                replicas=args.fleet, max_queue=args.fleet_max_queue,
+                max_restarts=args.fleet_max_restarts,
+                backoff_base=args.fleet_backoff,
+                watchdog_timeout=args.fleet_watchdog_timeout,
+                transport=args.fleet_transport,
+                rpc_deadline=args.fleet_rpc_deadline,
+                hosts=hosts)
+        except ValueError as e:
+            ap.error(str(e))
 
         def fleet_lane(tag, fault_plan=""):
             fl, reqs = run_fleet(params, cfg, fleet_cfg, workload,
@@ -415,6 +453,8 @@ def main() -> int:
                       f"redispatched {f['redispatched']} "
                       f"({f['tokens_recomputed']} KV tokens recomputed), "
                       f"shed {f['shed']}, transport {f['transport']}"
+                      + (f" ({f['host_incidents']} host incident(s))"
+                         if f.get("host_incidents") else "")
                       + (f" rpc p50/p99 {f['rpc_ms']['p50']}/"
                          f"{f['rpc_ms']['p99']} ms"
                          if f.get("rpc_ms") else ""),
@@ -513,6 +553,7 @@ def main() -> int:
             "fleet": ({
                 "replicas": args.fleet,
                 "transport": args.fleet_transport,
+                "hosts": args.fleet_hosts or None,
                 "max_restarts": args.fleet_max_restarts,
                 "watchdog_timeout": args.fleet_watchdog_timeout,
                 "max_queue": args.fleet_max_queue,
