@@ -301,8 +301,31 @@ class FaultInjector:
 # kill accepts either replica= or host=; partition is host-only (a NIC
 # belongs to a machine); stall/slow stay replica-only (a wedged or slow
 # engine is a process property).
+#
+# The wire-native weight distribution (serve/params_wire.py) adds two
+# verbs addressing the params-PUSH lane — the one RPC lane the fleet
+# retries (chunk writes are idempotent + digest-verified), so its
+# failure modes need their own injectable shapes:
+#
+#     transfer:replica=0,at=50%      the NEXT params push to the
+#                                  replica is torn mid-stream (the
+#                                  connection dies after half the
+#                                  chunks) — the fleet must classify
+#                                  it, back off, reconnect, and RESUME
+#                                  from the worker's verified offset
+#     corrupt:replica=0,at=50%       the NEXT push delivers one chunk
+#                                  whose bytes do not match its own
+#                                  crc32 — the worker rejects it with
+#                                  a typed ChecksumError and the fleet
+#                                  re-sends that chunk (never commits
+#                                  a corrupted artifact)
+#
+# Both are replica-addressed, fire at most once (armed at `at=`,
+# consumed by the next push), and need a wire transport (process/tcp)
+# — an inproc fleet has no push lane, rejected fail-fast at arm time.
 
-SERVE_KINDS = ("kill", "stall", "slow", "partition")
+SERVE_KINDS = ("kill", "stall", "slow", "partition", "transfer",
+               "corrupt")
 
 
 @dataclasses.dataclass
@@ -351,12 +374,13 @@ class ServeFaultAction:
                 raise FaultPlanError(
                     f"fault action {self}: kill needs exactly one of "
                     "replica= or host=")
-        else:   # stall / slow
+        else:   # stall / slow / transfer / corrupt
             if self.replica is None or self.host is not None:
                 raise FaultPlanError(
                     f"fault action {self}: {self.kind} is "
-                    "replica-addressed (a wedged or slow engine is a "
-                    "process property) — use replica=, not host=")
+                    "replica-addressed (a wedged/slow engine is a "
+                    "process property; a push targets one replica's "
+                    "wire) — use replica=, not host=")
         if self.replica is not None and self.replica < 0:
             raise FaultPlanError(
                 f"fault action {self}: replica must be >= 0")

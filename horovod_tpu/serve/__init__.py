@@ -43,7 +43,16 @@ steps without ever recompiling.
   ``host_down`` incident with every replica drained + redispatched,
   stall liveness rides a heartbeat sequence in the RPC replies, and
   :mod:`~horovod_tpu.serve.netfault` injects partitions/delays/
-  trickles/torn frames deterministically on loopback TCP for CI.
+  trickles/torn frames deterministically on loopback TCP for CI;
+* :mod:`~horovod_tpu.serve.params_wire` — wire-native versioned
+  weight distribution: weights are a content-addressed artifact
+  (deterministic blob + sha256) chunk-streamed to every worker
+  incarnation over the frame protocol (per-chunk CRC,
+  assemble-to-temp, digest-verify, atomic rename,
+  resume-from-offset after torn transfers) — no shared-filesystem
+  assumption on any transport — and ``ServeFleet.update_params``
+  rolls new weights through the fleet with zero downtime, each
+  request's decode pinned to exactly one params version.
 
 Architecture, page math, and the SLO tuning runbook: docs/serving.md.
 """

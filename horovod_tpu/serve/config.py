@@ -239,6 +239,18 @@ class FleetConfig:
     start listening; ``shutdown_deadline`` is :meth:`ServeFleet.close
     <horovod_tpu.serve.fleet.ServeFleet.close>`'s budget for the
     graceful ``shutdown`` RPC before it escalates SIGTERM → SIGKILL.
+
+    **Weight distribution** (process/tcp transports): every worker
+    incarnation receives its ServeConfig and a versioned params
+    artifact OVER THE WIRE at spawn (``put_config`` + chunked
+    ``push_*`` RPCs, :mod:`horovod_tpu.serve.params_wire`) — no shared
+    filesystem. ``push_chunk_bytes`` bounds each transfer frame (its
+    base64 form must stay under the transport's 16 MiB frame cap);
+    ``push_retries`` budgets how many times one push may resume after
+    a transport failure (chunk writes are idempotent and
+    digest-verified, so the push lane is the ONE place a
+    TransportError is retried — under the same exponential backoff as
+    relaunches) before the replica takes the ordinary death path.
     """
 
     replicas: int = 2
@@ -256,6 +268,10 @@ class FleetConfig:
     #: TCP placement: host entries ("host" or "host:port"), replicas
     #: round-robin. None (with transport="tcp") = all on loopback.
     hosts: Optional[tuple] = None
+    #: Params-transfer chunk size in bytes (process/tcp transports).
+    push_chunk_bytes: int = 1 << 20
+    #: Budgeted resume-retries per params push before replica death.
+    push_retries: int = 2
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -295,6 +311,14 @@ class FleetConfig:
             raise ValueError(
                 f"shutdown_deadline must be > 0 seconds, got "
                 f"{self.shutdown_deadline}")
+        if not 1 <= self.push_chunk_bytes <= (8 << 20):
+            raise ValueError(
+                f"push_chunk_bytes must be within 1..{8 << 20} (the "
+                f"base64 form of a chunk must fit the 16 MiB transport "
+                f"frame bound), got {self.push_chunk_bytes}")
+        if self.push_retries < 0:
+            raise ValueError(
+                f"push_retries must be >= 0, got {self.push_retries}")
         if self.hosts is not None:
             if self.transport != "tcp":
                 raise ValueError(

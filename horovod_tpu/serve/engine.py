@@ -561,6 +561,26 @@ class ServeEngine:
         if req.done_generating or req.hit_eos(self.config.eos_token):
             self._finish(req)
 
+    def update_params(self, params: Dict) -> None:
+        """Swap the model weights in place — the fleet's rolling-update
+        primitive. Only valid when IDLE: a live request's decode must
+        never mix weights mid-stream (the fleet drains the replica
+        before pushing). Geometry must match the compiled programs'
+        shapes, so the jitted step variants re-trace nothing — a
+        geometry change is a respawn, not an update."""
+        if not self.idle:
+            raise RuntimeError(
+                "update_params with requests in flight — drain the "
+                "engine first (the fleet's rolling update does)")
+        old, new = self.params["pos"].shape, params["pos"].shape
+        if tuple(old) != tuple(new):
+            raise ValueError(
+                f"update_params geometry mismatch: position table "
+                f"{tuple(new)} vs the engine's {tuple(old)} — a "
+                "geometry change needs a fresh engine, not a weight "
+                "swap")
+        self.params = params
+
     # ------------------------------------------------------------- run
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:

@@ -77,12 +77,34 @@ Either way the router's drain uses only router-side bookkeeping
 (dispatched requests + streamed tokens), never the dead engine's
 internals, and a crash loses the replica's engine state wholesale — in
 process mode that sentence is literally true of a SIGKILLed address
-space. docs/serving.md "The fleet" / "Process fleet" cover the
-runbook.
+space.
+
+**Weights travel the wire, versioned** (the round-15 tentpole;
+:mod:`~horovod_tpu.serve.params_wire`): every worker incarnation —
+spawn, relaunch, redispatch, unix or tcp — receives its ServeConfig
+and a content-addressed params artifact over the RPC transport itself
+(chunked, per-chunk CRC'd, whole-artifact digest-verified, atomically
+committed), so no placement assumes a shared filesystem and every
+replica provably decodes with bit-identical weights. The push lane is
+the ONE place a transport failure retries (chunk writes are
+idempotent): torn/corrupted transfers are classified transfer
+incidents that resume from the worker's verified offset under the
+budgeted backoff, never a silently wrong model.
+:meth:`ServeFleet.update_params` rolls a NEW weights version through
+the fleet with zero downtime — drain one replica (peers carry its
+traffic) → push → verify digest → readmit — while the router pins
+each request's entire decode to one version: redispatch rebases only
+onto a same-version replica, and a version no replica can ever serve
+again triggers the explicit restart-under-current-version policy — a
+mid-stream mix of two models' tokens is impossible by construction.
+
+docs/serving.md "The fleet" / "Process fleet" / "Weight distribution
+and rolling updates" cover the runbooks.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal as _signal
 import sys
@@ -94,13 +116,17 @@ from horovod_tpu.elastic.faults import (FaultPlanError, ServeFaultAction,
 from horovod_tpu.elastic.signals import Heartbeat, namespaced_heartbeat_dir
 from horovod_tpu.elastic.supervisor import HealthWatchdog
 from horovod_tpu.run.driver import WorkerExit
+from horovod_tpu.serve import params_wire
 from horovod_tpu.serve.config import FleetConfig, ServeConfig
 from horovod_tpu.serve.engine import ServeEngine
 from horovod_tpu.serve.router import (pick_replica, replica_load,
                                       retry_after_hint)
 from horovod_tpu.serve.scheduler import (Request, RequestState,
-                                         rebase_for_recompute)
-from horovod_tpu.serve.transport import RpcClient, TransportError
+                                         rebase_for_recompute,
+                                         restart_from_scratch)
+from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
+                                         RpcClient, TransportError,
+                                         remote_error_kind)
 
 
 def _log(msg: str) -> None:
@@ -152,6 +178,17 @@ class Replica:
         #: sequence value + the ROUTER-clock stamp of when it changed.
         self.hb_seq: Optional[int] = None
         self.hb_at: Optional[float] = None
+        #: Params version this replica serves (None = wire-init still
+        #: pending: a worker with no weights yet takes no traffic) +
+        #: the digest the fleet verified it against.
+        self.version: Optional[int] = None
+        self.params_sha: Optional[str] = None
+        #: False while the rolling update drains this replica — the
+        #: router routes around it; its in-flight requests finish.
+        self.accepting = True
+        #: Armed push-lane fault (the transfer:/corrupt: verbs),
+        #: consumed one-shot by the next params push.
+        self.push_fault: Optional[str] = None
 
     @property
     def healthy(self) -> bool:
@@ -174,6 +211,9 @@ class Replica:
         router bookkeeping and per-id metrics keep their identity)."""
         self.engine = fresh.engine
         self.heartbeat = fresh.heartbeat
+        self.version = fresh.version
+        self.params_sha = fresh.params_sha
+        self.accepting = True
 
 
 class ProcessReplica(Replica):
@@ -562,10 +602,26 @@ class ServeFleet:
                 self.heartbeat_dir, self.fleet.watchdog_timeout,
                 interval=min(0.5, self.fleet.watchdog_timeout / 2))
 
+        # Versioned weights: ONE content-addressed artifact per
+        # version (serve/params_wire.py — deterministic blob, sha256,
+        # chunked-transfer manifest), built for every transport so
+        # digests and version bookkeeping are uniform. Wire transports
+        # (process/tcp) push it to every worker incarnation at spawn —
+        # params never touch a filesystem any other process reads —
+        # and update_params() rolls the fleet to a new version one
+        # replica at a time.
+        self.params_version = 1
+        self._artifact = self._build_artifact(params, 1)
+        self._config_payload = dataclasses.asdict(config)
+        self.push_stats: Dict = {"pushes": 0, "bytes": 0, "chunks": 0,
+                                 "retries": 0, "ms": 0.0}
+        self.transfer_incidents: Dict[str, int] = {}
+        self.version_recomputed = 0
+        self._update: Optional[Dict] = None
+
         # Process-transport plumbing: one workdir per fleet INSTANCE
-        # (sockets + the params/config files every worker incarnation
-        # loads — written ONCE, so all replicas decode with
-        # bit-identical weights), per-call RPC wall samples (overhead
+        # (Unix socket paths ONLY — config and params reach every
+        # worker over the wire), per-call RPC wall samples (overhead
         # evidence, shared across incarnations), and the transport-
         # failure incident counters. ``worker_cmd(rid, sock_path,
         # default) -> (argv, env)`` is the spawn injection point
@@ -585,21 +641,10 @@ class ServeFleet:
         # connection to the host routes through — one NIC, one fate)}.
         self._hosts: List[Dict] = []
         self._secret: Optional[str] = None
-        if self.fleet.transport in ("process", "tcp"):
-            import dataclasses as _dc
-            import json as _json
+        if self.fleet.transport == "process":
             import tempfile
 
-            from horovod_tpu.serve.worker import save_params
-
             self._workdir = tempfile.mkdtemp(prefix="hvd-fleet-")
-            self._params_path = os.path.join(self._workdir,
-                                             "params.npz")
-            save_params(params, self._params_path)
-            self._config_path = os.path.join(self._workdir,
-                                             "config.json")
-            with open(self._config_path, "w") as f:
-                _json.dump(_dc.asdict(config), f)
         if self.fleet.transport == "tcp":
             from horovod_tpu.run.network import make_secret_key
             from horovod_tpu.serve.config import (LOCAL_HOSTS,
@@ -673,6 +718,17 @@ class ServeFleet:
 
     # ------------------------------------------------------- lifecycle
 
+    def _build_artifact(self, params: Dict, version: int) -> Dict:
+        """One content-addressed, versioned transfer artifact (blob +
+        manifest + sha256) — the single source every push, digest
+        verify, and version stamp reads."""
+        blob = params_wire.params_to_blob(params)
+        manifest = params_wire.make_manifest(
+            blob, version=version,
+            chunk_bytes=self.fleet.push_chunk_bytes)
+        return {"blob": blob, "manifest": manifest,
+                "sha256": manifest["sha256"], "version": version}
+
     def _spawn(self, rid: int) -> Replica:
         if self.fleet.transport == "tcp":
             # No heartbeat FILE: a remote worker's file is on another
@@ -691,13 +747,19 @@ class ServeFleet:
         engine = ServeEngine(self.params, self.config,
                              chips=self.chips_per_replica,
                              clock=self.clock)
-        return Replica(rid, engine, hb)
+        rep = Replica(rid, engine, hb)
+        # In-process engines share the fleet's params object directly —
+        # no wire, so the version stamp lands at spawn.
+        rep.version = self.params_version
+        rep.params_sha = self._artifact["sha256"]
+        return rep
 
     def _default_worker_cmd(self, rid: int, sock_path: str):
+        # No --params/--config: config and weights arrive over the
+        # wire (put_config + the chunked push RPCs) — a worker
+        # incarnation reads NOTHING the fleet wrote to a filesystem.
         cmd = [sys.executable, "-m", "horovod_tpu.serve.worker",
                "--socket", sock_path,
-               "--params", self._params_path,
-               "--config", self._config_path,
                "--rank", str(rid),
                "--heartbeat-dir", self.heartbeat_dir]
         env = dict(os.environ)
@@ -742,10 +804,11 @@ class ServeFleet:
         across relaunches — the worker binds with ``SO_REUSEADDR``),
         while local auto-port hosts get a fresh probed free port per
         incarnation. Remote hosts spawn over ssh (the launcher's
-        pty-HUP kill discipline; NOTE: the params/config files live in
-        this fleet's workdir, so multi-host placement assumes a shared
-        working filesystem — the standard pod setup, same as elastic
-        checkpoints)."""
+        pty-HUP kill discipline). The worker starts with NOTHING from
+        any filesystem: ServeConfig and the versioned params artifact
+        arrive over the wire (``_init_due`` → ``_push_artifact``), so
+        multi-host placement assumes no shared working filesystem at
+        all."""
         from horovod_tpu.run import spawn_worker, spawn_worker_ssh
 
         h = rid % len(self._hosts)
@@ -761,8 +824,6 @@ class ServeFleet:
         endpoint = f"{bind_host}:{port}"
         cmd = [sys.executable, "-m", "horovod_tpu.serve.worker",
                "--bind", endpoint,
-               "--params", self._params_path,
-               "--config", self._config_path,
                "--rank", str(rid)]
         env = dict(os.environ)
         env.update(self._worker_env)
@@ -796,6 +857,266 @@ class ServeFleet:
         # watchdog gets by unlinking the stale heartbeat.
         rep.hb_at = self.clock()
         return rep
+
+    # --------------------------------------- wire weight distribution
+
+    def _proc_dead(self, rep: Replica) -> bool:
+        proc = getattr(rep, "proc", None)
+        return proc is not None and proc.poll() is not None
+
+    def _push_artifact(self, rep: Replica,
+                       include_config: bool = False) -> None:
+        """Stream the CURRENT params artifact to one wire replica in
+        bounded chunks: manifest first (``push_begin`` returns the
+        worker's verified resume offset), then per-chunk-CRC'd chunks,
+        then ``push_commit`` — the worker digest-verifies the whole
+        artifact and atomically renames it into place, and the fleet
+        verifies the returned sha256 against its own.
+
+        THE one exception to the no-RPC-retry rule: chunk writes are
+        idempotent (same bytes at the same offset, contiguity
+        enforced, digest at commit), so a torn or corrupted transfer
+        is a typed failure that RETRIES — resume-from-offset under the
+        fleet's budgeted exponential backoff (``push_retries``) —
+        never a silently wrong model and never an instant replica
+        death. Past the budget (or with the worker process observably
+        dead) the error propagates and the caller routes the ordinary
+        replica-death path.
+
+        Honest limitation: the transfer (and its retry backoff) runs
+        SYNCHRONOUSLY inside the fleet tick — for CI-scale artifacts
+        this is milliseconds, but a multi-GB push stalls the other
+        replicas' stepping for its duration. Chunking the transfer
+        ACROSS ticks (the relaunch path's schedule-and-return pattern)
+        is the named follow-up when artifact sizes demand it."""
+        art = self._artifact
+        man = art["manifest"]
+        client = rep.engine.client
+        fault, rep.push_fault = rep.push_fault, None
+        attempts = 0
+        t0 = self.clock()
+        chunks_sent = 0
+        cb, n = man["chunk_bytes"], man["num_chunks"]
+        while True:
+            try:
+                if include_config:
+                    client.call("put_config",
+                                {"config": dict(self._config_payload)})
+                have = int(client.call(
+                    "push_begin", {"manifest": man})["have_bytes"])
+                if have:
+                    _log(f"replica {rep.id}: resuming params push at "
+                         f"byte {have}/{man['total_bytes']} (the "
+                         "worker's verified prefix survives the torn "
+                         "transfer)")
+                for i in range(have // cb, n):
+                    chunk = params_wire.make_chunk(art["blob"], man, i)
+                    if fault is not None and i >= min(max(1, n // 2),
+                                                      n - 1):
+                        # Consume the one-shot BEFORE applying it: the
+                        # tear raises, and a retry must resume clean,
+                        # not re-tear forever into the death path.
+                        armed, fault = fault, None
+                        chunk = self._push_fault_chunk(
+                            rep, armed, chunk, i, n, client)
+                    client.call("push_chunk", chunk)
+                    chunks_sent += 1
+                res = client.call("push_commit",
+                                  {"version": man["version"]})
+                if res.get("sha256") != man["sha256"]:
+                    raise ChecksumError(
+                        f"push_commit digest {res.get('sha256')!r} != "
+                        f"artifact {man['sha256']} — the worker "
+                        "assembled a different artifact")
+                break
+            except TransportError as e:
+                kind = remote_error_kind(e)
+                self.transfer_incidents[kind] = \
+                    self.transfer_incidents.get(kind, 0) + 1
+                attempts += 1
+                if attempts > self.fleet.push_retries \
+                        or self._proc_dead(rep):
+                    _log(f"replica {rep.id}: params push failed "
+                         f"({kind}: {e}) with no budget left — "
+                         "routing into the replica-death path")
+                    raise
+                # Counted AFTER the budget gate: "retries" are resumes
+                # that actually ran, not the terminal failed attempt
+                # (transfer_incidents records every observation).
+                self.push_stats["retries"] += 1
+                backoff = min(self.fleet.backoff_cap,
+                              self.fleet.backoff_base
+                              * (2 ** (attempts - 1)))
+                _log(f"replica {rep.id}: params push attempt "
+                     f"{attempts} failed ({kind}: {e}) — classified "
+                     f"transfer retry, resuming from the worker's "
+                     f"verified offset in {backoff:g}s")
+                self._sleep(backoff)
+        rep.version = man["version"]
+        rep.params_sha = man["sha256"]
+        self.push_stats["pushes"] += 1
+        self.push_stats["bytes"] += man["total_bytes"]
+        self.push_stats["chunks"] += chunks_sent
+        self.push_stats["ms"] += round((self.clock() - t0) * 1e3, 3)
+
+    def _push_fault_chunk(self, rep: Replica, fault: str, chunk: Dict,
+                          i: int, n: int, client) -> Dict:
+        """Apply an already-consumed transfer:/corrupt: fault to the
+        push's mid-stream chunk. ``corrupt`` returns a chunk whose
+        payload no longer matches its own crc32 — the worker MUST
+        reject it typed; ``transfer`` tears the connection mid-push —
+        the retry must resume from the worker's verified offset."""
+        import base64 as _b64
+
+        if fault == "corrupt":
+            raw = bytearray(_b64.b64decode(chunk["data"]))
+            raw[0] ^= 0x01
+            _log(f"fault injection: corrupt: flipping a bit in chunk "
+                 f"{i}/{n} of the push to replica {rep.id}")
+            return dict(chunk,
+                        data=_b64.b64encode(bytes(raw)).decode("ascii"))
+        _log(f"fault injection: transfer: tearing the push to replica "
+             f"{rep.id} after {i}/{n} chunks")
+        client.close()
+        raise ConnectionLost(
+            f"transfer fault injection: connection torn mid-push "
+            f"after {i}/{n} chunks")
+
+    def _init_due(self, now: float) -> None:
+        """Wire-init any healthy replica that has no weights yet (a
+        fresh spawn or relaunch): ship ServeConfig + the current
+        artifact over its RPC wire. A failed init (worker dead on
+        startup, push budget exhausted) is the ordinary classified
+        replica-death path — it consumes restart budget exactly like
+        the old first-step failure did."""
+        if self.fleet.transport == "inproc":
+            return
+        for rep in self.replicas:
+            if not rep.healthy or rep.version is not None:
+                continue
+            try:
+                self._push_artifact(rep, include_config=True)
+            except TransportError as e:
+                self._transport_death(rep, e, now)
+                continue
+            _log(f"replica {rep.id}: wire-init complete — params "
+                 f"v{rep.version} (sha256 {rep.params_sha[:12]}) "
+                 "digest-verified over the transport")
+
+    # --------------------------------------------- rolling updates
+
+    @property
+    def update_active(self) -> bool:
+        return self._update is not None
+
+    def update_params(self, params: Dict) -> int:
+        """Arm a ZERO-DOWNTIME rolling weight update; returns the new
+        version. The roll itself advances inside :meth:`step`, one
+        replica at a time: stop routing to it → let its in-flight
+        requests finish (drain) → push the new artifact over the wire
+        (or swap in place, inproc) → verify the digest → readmit.
+        Requests already streaming stay PINNED to the version they
+        started on (the router only redispatches them onto
+        same-version replicas; see ``Request.version``), so a weight
+        mix mid-stream is impossible by construction. Replicas that
+        are dead when the roll reaches them pick the new version up at
+        relaunch — every relaunch wire-inits from the CURRENT
+        artifact."""
+        if self._closed:
+            raise RuntimeError("update_params on a closed ServeFleet")
+        if self._update is not None:
+            raise RuntimeError(
+                "a rolling update is already in progress — one version "
+                "boundary at a time (wait for update_active to clear)")
+        version = self.params_version + 1
+        art = self._build_artifact(params, version)
+        # Geometry gate BEFORE any state mutates: the blob header is
+        # the complete structural fingerprint (the full pytree spec —
+        # every key and nesting — plus per-leaf shapes/dtypes), so a
+        # wrong-shaped OR restructured update raises HERE — never
+        # after the artifact swap, where it would crash-loop every
+        # relaunch (wire) or escape the fleet loop mid-roll (inproc).
+        # A geometry change is a new fleet, not a weight roll.
+        if params_wire.blob_spec(art["blob"]) != \
+                params_wire.blob_spec(self._artifact["blob"]):
+            raise ValueError(
+                "update_params geometry mismatch: the new params' tree "
+                "structure or leaf shapes/dtypes differ from the "
+                "serving artifact's — a rolling update swaps WEIGHTS "
+                "under the compiled programs; a geometry change needs "
+                "a fresh fleet")
+        self.params = params
+        self.params_version = version
+        self._artifact = art
+        self._update = {"version": version, "params": params,
+                        "current": None, "t0": self.clock()}
+        _log(f"rolling update to params v{version} (sha256 "
+             f"{art['sha256'][:12]}) armed — one replica at a time, "
+             "version-pinned streams keep decoding")
+        return version
+
+    def _advance_update(self, now: float) -> None:
+        """One tick of the rolling update's state machine (see
+        :meth:`update_params`): pick the next non-updated healthy
+        replica, stop routing to it, wait for its in-flight requests
+        to finish, push + digest-verify + readmit, repeat. A replica
+        already drained updates in the SAME tick it is picked; one
+        that is still serving drains across ticks while its peers
+        carry the traffic."""
+        u = self._update
+        if u is None:
+            return
+        while True:
+            rep = u["current"]
+            if rep is None:
+                for cand in self.replicas:
+                    if cand.healthy and cand.version is not None \
+                            and cand.version != u["version"]:
+                        cand.accepting = False
+                        u["current"] = cand
+                        _log(f"rolling update: draining replica "
+                             f"{cand.id} (v{cand.version} → "
+                             f"v{u['version']}; {len(cand.assigned)} "
+                             "in flight finish first)")
+                        break
+                else:
+                    # No healthy replica left behind the target: the
+                    # roll is complete (dead/uninitialized replicas
+                    # wire-init from the new artifact at relaunch).
+                    if all(r.version == u["version"] or not r.healthy
+                           or r.version is None
+                           for r in self.replicas):
+                        _log(f"rolling update to params "
+                             f"v{u['version']} complete in "
+                             f"{self.clock() - u['t0']:.3f}s")
+                        self._update = None
+                    return
+                continue
+            if rep.state != "healthy":
+                # Died mid-drain/push: its relaunch wire-inits from
+                # the new artifact; move on.
+                rep.accepting = True
+                u["current"] = None
+                continue
+            if rep.assigned:
+                return   # still draining: in-flight requests finish
+            try:
+                if rep.transport == "inproc":
+                    rep.engine.update_params(u["params"])
+                    rep.version = u["version"]
+                    rep.params_sha = self._artifact["sha256"]
+                    self.push_stats["pushes"] += 1
+                else:
+                    self._push_artifact(rep)
+            except TransportError as e:
+                self._transport_death(rep, e, now)
+                rep.accepting = True
+                u["current"] = None
+                return
+            rep.accepting = True
+            u["current"] = None
+            _log(f"replica {rep.id}: updated to params "
+                 f"v{rep.version} (digest verified) — readmitted")
 
     @property
     def in_flight(self) -> int:
@@ -836,6 +1157,12 @@ class ServeFleet:
                 raise FaultPlanError(
                     f"fault action {a}: replica {a.replica} is outside "
                     f"this fleet (replicas 0..{len(self.replicas) - 1})")
+            if a.kind in ("transfer", "corrupt") \
+                    and self.fleet.transport == "inproc":
+                raise FaultPlanError(
+                    f"fault action {a}: {a.kind} faults address the "
+                    "params-push wire — the inproc transport has none "
+                    "(use transport='process' or 'tcp')")
             if a.host is not None:
                 if self.fleet.transport != "tcp":
                     raise FaultPlanError(
@@ -902,6 +1229,12 @@ class ServeFleet:
                         rep, now, "slow", {"factor": action.factor},
                         lambda: setattr(rep, "slow_factor",
                                         float(action.factor)))
+            elif action.kind in ("transfer", "corrupt"):
+                # Armed on the REPLICA, consumed one-shot by its next
+                # params push (a spawn/relaunch wire-init or the
+                # rolling update's roll reaching it).
+                if rep.healthy:
+                    rep.push_fault = action.kind
 
     def _arm_replica_fault(self, rep: Replica, now: float, kind: str,
                            payload: Dict, inproc_apply) -> None:
@@ -1117,6 +1450,9 @@ class ServeFleet:
         rep.slow_factor = 1.0
         rep.hb_seq = None
         rep.hb_at = None
+        rep.accepting = True     # the relaunch serves; pins re-gate it
+        rep.push_fault = None    # a one-shot fault never brands the
+        #                          next incarnation
         if rep.heartbeat is not None:
             try:
                 os.unlink(rep.heartbeat.path)
@@ -1291,11 +1627,37 @@ class ServeFleet:
             req.t_finish = now
             self.timed_out.append(req)
 
+    def _version_stranded(self, req: Request) -> bool:
+        """A pinned request whose params version no replica can EVER
+        serve again: relaunches always wire-init from the CURRENT
+        artifact, so a version older than ``params_version`` survives
+        only on still-healthy replicas — none left means waiting at
+        the head would strand the request forever."""
+        return (req.version is not None
+                and req.version != self.params_version
+                and not any(r.healthy and r.version == req.version
+                            for r in self.replicas))
+
     def _dispatch(self) -> None:
         while self.queue:
             req = self.queue[0]
             rep = pick_replica(self.replicas, req)
             if rep is None:
+                if self._version_stranded(req):
+                    # The explicit cross-version policy: the stream
+                    # RESTARTS from its original prompt under the new
+                    # version (scheduler.restart_from_scratch) — the
+                    # rebase alternative would splice tokens from two
+                    # different models into one stream.
+                    _log(f"request {req.rid}: pinned params v"
+                         f"{req.version} can never be served again — "
+                         "restarting the stream from scratch under "
+                         f"v{self.params_version} (explicit policy; "
+                         f"{len(req.output)} emitted token(s) "
+                         "retracted as a stream restart)")
+                    restart_from_scratch(req)
+                    self.version_recomputed += 1
+                    continue
                 break   # head waits; order (and requeue priority) holds
             self.queue.pop(0)
             try:
@@ -1324,6 +1686,11 @@ class ServeFleet:
                     self.shed_total += 1
                 continue
             rep.assigned.append(req)
+            if req.version is None:
+                # First dispatch pins the request's ENTIRE decode to
+                # this replica's params version — redispatch may only
+                # rebase onto the same version (router.eligible).
+                req.version = rep.version
 
     def _collect(self, rep: Replica) -> None:
         """Pull terminal requests out of a live replica into the fleet
@@ -1359,10 +1726,11 @@ class ServeFleet:
 
     def step(self) -> bool:
         """One fleet tick: inject due faults, run the watchdog, process
-        due relaunches, expire queued deadlines, dispatch, then step
-        every live replica once. Returns whether any replica made
-        progress (False = idle, everything stalled, or everything
-        waiting on a backoff — callers let wall time pass)."""
+        due relaunches, wire-init fresh workers, advance a rolling
+        update, expire queued deadlines, dispatch, then step every
+        live replica once. Returns whether any replica made progress
+        (False = idle, everything stalled, or everything waiting on a
+        backoff — callers let wall time pass)."""
         if self._closed:
             raise RuntimeError("step() on a closed ServeFleet")
         now = self.clock()
@@ -1371,6 +1739,8 @@ class ServeFleet:
         self._inject_faults(now)
         self._check_watchdog(now)
         self._relaunch_due(now)
+        self._init_due(now)
+        self._advance_update(now)
         self._expire_queued(now)
         self._dispatch()
 
@@ -1378,7 +1748,11 @@ class ServeFleet:
         occ: List[float] = []
         ticked: List[Replica] = []
         for rep in self.replicas:
-            if not rep.healthy:
+            if not rep.healthy or rep.version is None:
+                # version None = wire init still pending (its push
+                # failed this tick and the death path is scheduled):
+                # the proxy's step RPC would only park on the missing
+                # engine.
                 continue
             if rep.stall_until is not None:
                 if now < rep.stall_until:
@@ -1457,12 +1831,15 @@ class ServeFleet:
         requests finished so far. Ticks that make no progress (a stall
         waiting for the watchdog, a relaunch waiting out its backoff)
         sleep briefly so wall time — which heartbeat mtimes and
-        backoffs are measured in — actually passes."""
-        while not self.idle:
+        backoffs are measured in — actually passes. An in-progress
+        rolling update keeps the loop alive past request-idle: the
+        roll must complete (every replica on the new version) before
+        the fleet is done."""
+        while not self.idle or self._update is not None:
             if max_steps is not None and self.steps >= max_steps:
                 break
             if not self.step():
-                if self.idle:
+                if self.idle and self._update is None:
                     break
                 self._sleep(0.001)
         return self.finished
@@ -1490,6 +1867,10 @@ class ServeFleet:
         self.steps = 0
         self._rpc_samples.clear()
         self.transport_incidents = {}
+        self.push_stats = {"pushes": 0, "bytes": 0, "chunks": 0,
+                           "retries": 0, "ms": 0.0}
+        self.transfer_incidents = {}
+        self.version_recomputed = 0
         for rep in self.replicas:
             if rep.healthy and rep.engine is not None:
                 try:
@@ -1543,6 +1924,12 @@ class ServeFleet:
                 if i.get("category") == "host_down"),
             "rpc_ms": rpc_ms,
             "transport_incidents": dict(self.transport_incidents),
+            "params_version": self.params_version,
+            "params_push": dict(self.push_stats,
+                                version=self.params_version),
+            "transfer_incidents": dict(self.transfer_incidents),
+            "version_recomputed": self.version_recomputed,
+            "update_active": self._update is not None,
             "healthy": sum(1 for r in self.replicas if r.healthy),
             "dead": sum(1 for r in self.replicas if r.state == "dead"),
             "failed": sum(1 for r in self.replicas
@@ -1560,7 +1947,8 @@ class ServeFleet:
             "detect_s": round(max(detect), 4) if detect else None,
             "per_replica": [
                 dict(replica_load(r), id=r.id, state=r.state,
-                     steps=r.steps, restarts=r.restarts)
+                     steps=r.steps, restarts=r.restarts,
+                     version=r.version, params_sha=r.params_sha)
                 for r in self.replicas],
         }
         return out

@@ -41,7 +41,9 @@ hang):
   socket writes only half its bytes, then the connection dies — the
   kill-mid-write shape, injected mid-FRAME so the peer's codec must
   resolve it as a torn :class:`FrameError
-  <horovod_tpu.serve.transport.FrameError>`.
+  <horovod_tpu.serve.transport.FrameError>`. ONE-SHOT: firing clears
+  the armed fault, so a retry's fresh connection (the params-push
+  resume lane) proceeds clean instead of tearing forever.
 
 The wrapper intercepts only the calls the transport makes (``recv``,
 ``sendall``, ``settimeout``, ``close``); everything else delegates.
@@ -161,7 +163,16 @@ class FaultableSocket:
             return   # black hole: the kernel "accepted" it, the wire ate it
         if f.tear_send_frame is not None:
             self._sends += 1
-            if self._sends >= f.tear_send_frame:
+            fire = False
+            with f._lock:
+                if f.tear_send_frame is not None \
+                        and self._sends >= f.tear_send_frame:
+                    # One-shot: the armed tear is consumed by the
+                    # socket that fires it (a resumed transfer's fresh
+                    # connection must not re-tear).
+                    f.tear_send_frame = None
+                    fire = True
+            if fire:
                 self._sock.sendall(data[:max(1, len(data) // 2)])
                 try:
                     self._sock.close()

@@ -47,8 +47,15 @@ def replica_load(rep) -> dict:
 
 
 def eligible(rep, req) -> bool:
-    """May ``req`` be dispatched to ``rep`` right now? Healthy, the
-    geometry admits the request at all, there is in-flight headroom
+    """May ``req`` be dispatched to ``rep`` right now? Healthy,
+    INITIALIZED (a wire-init worker has no weights until its first
+    params push commits — ``rep.version`` is None until then),
+    ACCEPTING (a replica mid-rolling-update is draining: routing new
+    work to it would make the drain a livelock), VERSION-compatible
+    (a request already streaming under params version V may only
+    continue on a replica serving exactly V — the version pin that
+    makes a mid-stream weight mix impossible), the geometry admits the
+    request at all, there is in-flight headroom
     (dispatched-but-unfinished stays under the engine's in-flight
     limit, so the router never deep-queues into a replica), and the
     engine's OWN bounded queue — a standalone-engine knob the fleet
@@ -57,6 +64,12 @@ def eligible(rep, req) -> bool:
     is that a backlogged request WAITS at the fleet head until a
     replica frees up."""
     if not rep.healthy or rep.engine is None:
+        return False
+    if getattr(rep, "version", 1) is None \
+            or not getattr(rep, "accepting", True):
+        return False
+    req_version = getattr(req, "version", None)
+    if req_version is not None and rep.version != req_version:
         return False
     eng = rep.engine
     if not eng.cache.fits(req.prompt_len, req.max_new_tokens):

@@ -95,6 +95,15 @@ class Request:                     # tracked by `is` in slot lists
     #: redispatched to a survivor (fleet bookkeeping; eviction-recompute
     #: within one engine counts in ``evictions``).
     redispatches: int = 0
+    #: params version this request's ENTIRE decode is pinned to (fleet
+    #: bookkeeping, stamped at first dispatch). A redispatch rebases
+    #: only onto a same-version replica; when that version can never
+    #: be served again, :func:`restart_from_scratch` re-pins — a
+    #: version mix mid-stream is impossible by construction.
+    version: Optional[int] = None
+    #: times this request restarted from its original prompt under a
+    #: newer params version (the explicit cross-version policy).
+    version_restarts: int = 0
 
     state: str = RequestState.QUEUED
     #: prompt tokens already prefilled (chunk progress).
@@ -368,6 +377,28 @@ def rebase_for_recompute(req: Request) -> bool:
         req.generated = []
     req.prefill_pos = 0
     return req.max_new_tokens >= 1
+
+
+def restart_from_scratch(req: Request) -> None:
+    """The explicit cross-version redispatch policy's arithmetic: a
+    request pinned to a params version no replica can ever serve again
+    RESTARTS — original prompt, full budget, stream and measurement
+    trail reset — so its whole decode re-pins to one (newer) version.
+    The inverse trade of :func:`rebase_for_recompute`: the rebase keeps
+    emitted tokens at the cost of requiring same-version weights; the
+    restart discards them (the router signals the client a stream
+    restart) because continuing a half-stream under different weights
+    would silently emit a token sequence NO single model ever
+    produced."""
+    req.prompt = req.prompt[:req.orig_prompt_len]
+    req.max_new_tokens = req.orig_max_new
+    req.generated = []
+    req.output = []
+    req.prefill_pos = 0
+    req.version = None
+    req.version_restarts += 1
+    req.t_first_token = None
+    req.token_times = []
 
 
 def pick_victim(candidates: Sequence[Request],

@@ -58,6 +58,7 @@ import hashlib
 import hmac
 import json
 import os
+import re
 import socket
 import struct
 import time
@@ -114,7 +115,35 @@ class RemoteCallError(TransportError):
     """The worker's handler raised; the error text rode back over a
     healthy frame layer. Still a replica-death signal: an engine that
     raises mid-step is the crash shape (the in-process fleet treats it
-    identically)."""
+    identically). The ONE exception is the params-push lane
+    (``push_begin``/``push_chunk``/``push_commit``): chunk writes are
+    idempotent and digest-verified, so the fleet retries those under
+    its budgeted backoff instead of killing the replica — see
+    :func:`remote_error_kind` for how a worker-side typed rejection
+    (e.g. the transfer codec's ``ChecksumError``) is classified."""
+
+
+def remote_error_kind(err: TransportError) -> str:
+    """Incident-classification label for a transport failure: for a
+    :class:`RemoteCallError` the WORKER-side exception class name (the
+    handler's typed error — e.g. the transfer codec's ``ChecksumError``
+    riding back over a healthy frame layer), else the local typed
+    class. The fleet stamps this into ``transfer_incidents`` /
+    ``transport_incidents`` so a corrupted chunk and a torn connection
+    stay distinguishable in the record. The class name rides the
+    reply's structured ``error_type`` field (set by
+    :func:`serve_connection`, stamped onto the exception by
+    :meth:`RpcClient.call`); the message-parse below is only the
+    fallback for a peer speaking an older reply shape."""
+    if isinstance(err, RemoteCallError):
+        kind = getattr(err, "remote_type", None)
+        if kind:
+            return str(kind)
+        m = re.search(r"worker raised: ([A-Za-z_][A-Za-z0-9_]*)",
+                      str(err))
+        if m:
+            return m.group(1)
+    return type(err).__name__
 
 
 def encode_frame(obj: Any) -> bytes:
@@ -294,8 +323,13 @@ class RpcClient:
     request's ``id`` and a mismatch (a duplicated or interleaved frame,
     e.g. a stale reply surviving a half-torn stream) raises
     :class:`FrameError`. After ANY transport error the connection is
-    closed and the client is dead — the fleet replaces the replica, it
-    never resends.
+    closed; on the normal RPC surface the fleet then replaces the
+    replica — it never resends (a resent ``submit`` could
+    double-apply). The ONE exception is the params-push lane
+    (``push_begin``/``push_chunk``/``push_commit``): those calls are
+    idempotent and digest-verified, so the fleet retries them through
+    this same client (the next :meth:`call` reconnects), resuming the
+    transfer from the worker's verified offset.
 
     ``proc_alive`` (optional callable) lets :meth:`connect` fail fast
     with :class:`ConnectionLost` when the worker process has already
@@ -440,8 +474,13 @@ class RpcClient:
                 f"does not match request id {rid} (duplicated or "
                 "interleaved frame)")
         if not resp.get("ok"):
-            raise RemoteCallError(
+            err = RemoteCallError(
                 f"{method}: worker raised: {resp.get('error')}")
+            # Structured worker-side exception class (what
+            # remote_error_kind classifies by) — never parsed back out
+            # of the human-readable message.
+            err.remote_type = resp.get("error_type")
+            raise err
         return resp.get("result")
 
     def close(self) -> None:
@@ -506,6 +545,7 @@ def serve_connection(sock: socket.socket,
             resp = {"id": rid, "ok": True, "result": result}
         except Exception as e:   # surfaced to the client, conn lives
             resp = {"id": rid, "ok": False,
+                    "error_type": type(e).__name__,
                     "error": f"{type(e).__name__}: {e}"}
         frame = encode_frame(resp)
         if send_hook is not None and send_hook(sock, frame):
@@ -521,6 +561,6 @@ __all__ = [
     "Address", "ChecksumError", "ConnectionLost", "DeadlineExceeded",
     "FrameError", "HEADER_LEN", "MAGIC", "MAX_FRAME", "RemoteCallError",
     "RpcClient", "TransportError", "client_handshake", "encode_frame",
-    "recv_exact", "recv_frame", "send_frame", "serve_connection",
-    "server_handshake",
+    "recv_exact", "recv_frame", "remote_error_kind", "send_frame",
+    "serve_connection", "server_handshake",
 ]

@@ -1,7 +1,7 @@
 """Replica worker process: one ServeEngine behind the fleet transport.
 
-``python -m horovod_tpu.serve.worker --socket S --params P --config C
---rank R --heartbeat-dir D`` runs ONE
+``python -m horovod_tpu.serve.worker --socket S --rank R
+--heartbeat-dir D`` runs ONE
 :class:`~horovod_tpu.serve.engine.ServeEngine` as its own OS process —
 the crash-isolation boundary the in-process fleet honestly lacked: a
 replica that segfaults, OOMs, or is SIGKILLed takes down exactly one
@@ -13,6 +13,21 @@ handshake before an RPC is served), liveness rides a heartbeat
 SEQUENCE in every ping/step/collect reply instead of a file the
 router could not see, and the advertised endpoint resolves through
 ``run/network.py``'s offline-safe fallback chain.
+
+**Wire init (the fleet's default).** With no ``--params``/``--config``
+the worker starts with NOTHING from any filesystem: it binds, serves
+the transfer RPCs (``put_config`` + ``push_begin``/``push_chunk``/
+``push_commit`` — :mod:`~horovod_tpu.serve.params_wire`), assembles
+the versioned params artifact into its own private temp dir with
+per-chunk CRCs, whole-artifact digest verify, and an atomic-rename
+commit, and only THEN builds the engine. Every spawn, relaunch, and
+redispatch incarnation therefore decodes with bit-identical,
+digest-verified weights — no shared-filesystem assumption on any
+transport. The same push RPCs later swap weights live (the fleet's
+zero-downtime rolling update): the fleet drains this replica first,
+``push_commit`` verifies the digest and replaces the idle engine's
+params in place. ``--params P --config C`` (both together) remains the
+standalone file mode for running a worker by hand.
 
 Two threads, one failure story:
 
@@ -54,65 +69,41 @@ import json
 import os
 import socket
 import sys
+import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from horovod_tpu.run.driver import EXIT_CLEAN, EXIT_USAGE
+from horovod_tpu.serve import params_wire
 from horovod_tpu.serve.transport import serve_connection
 
 # ------------------------------------------------------------------ params
 
-_LEAF = "__leaf_{}__"
-
 
 def save_params(params, path: str) -> None:
-    """Serialize a dict/list pytree of arrays to one ``.npz`` (a JSON
-    structure spec plus one entry per leaf) — the fleet writes it once,
-    every worker incarnation loads it, so all replicas decode with
-    BIT-IDENTICAL weights (the redispatch exactness pin depends on
-    it)."""
-    leaves: List[np.ndarray] = []
-
-    def enc(x):
-        if isinstance(x, dict):
-            return {k: enc(v) for k, v in x.items()}
-        if isinstance(x, (list, tuple)):
-            return [enc(v) for v in x]
-        leaves.append(np.asarray(x))
-        return _LEAF.format(len(leaves) - 1)
-
-    spec = enc(params)
-    np.savez(path, __spec__=np.asarray(json.dumps(spec)),
-             **{f"leaf_{i}": a for i, a in enumerate(leaves)})
+    """Serialize a dict/list pytree of arrays to one deterministic
+    artifact file (:func:`params_wire.params_to_blob` — the same
+    container the wire transfer ships), committed with tmp + atomic
+    rename so a crash mid-write can never leave a torn file a later
+    load would parse into silently wrong weights (the HVD012
+    discipline)."""
+    blob = params_wire.params_to_blob(params)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
 
 
 def load_params(path: str, as_jax: bool = True):
     """Inverse of :func:`save_params`; ``as_jax`` converts leaves once
     so the engine's compiled steps don't re-upload host arrays every
     call."""
-    with np.load(path, allow_pickle=False) as z:
-        spec = json.loads(str(z["__spec__"]))
-        leaves = {f"leaf_{i}": z[f"leaf_{i}"]
-                  for i in range(len(z.files) - 1)}
-    if as_jax:
-        import jax.numpy as jnp
-
-        leaves = {k: jnp.asarray(v) for k, v in leaves.items()}
-
-    def dec(x):
-        if isinstance(x, dict):
-            return {k: dec(v) for k, v in x.items()}
-        if isinstance(x, list):
-            return [dec(v) for v in x]
-        if isinstance(x, str) and x.startswith("__leaf_") \
-                and x.endswith("__"):
-            return leaves[f"leaf_{int(x[7:-2])}"]
-        return x
-
-    return dec(spec)
+    with open(path, "rb") as f:
+        blob = f.read()
+    return params_wire.params_from_blob(blob, as_jax=as_jax)
 
 
 def _jsonable(x: Any) -> Any:
@@ -137,12 +128,37 @@ class WorkerHost:
     ``secret`` (TCP placement) arms the shared-secret connect
     handshake: every accepted connection must answer the HMAC
     challenge before a single RPC frame is served — a TCP listener is
-    network-reachable, unlike the filesystem-gated Unix socket."""
+    network-reachable, unlike the filesystem-gated Unix socket.
 
-    def __init__(self, engine, heartbeat=None, secret=None):
+    ``engine`` may be ``None`` (wire init): the RPC thread then serves
+    the transfer RPCs immediately — they are pure file I/O against the
+    worker's private artifact dir — while the main thread waits for
+    config + a digest-verified params artifact before paying the heavy
+    jax/engine construction (:meth:`attach_engine`). Engine-facing
+    RPCs arriving in that window wait for the engine inside their own
+    deadline (the established first-RPC-after-spawn discipline)."""
+
+    def __init__(self, engine, heartbeat=None, secret=None, *,
+                 params_version: int = 0,
+                 params_sha: Optional[str] = None):
         self.engine = engine
         self.heartbeat = heartbeat
         self._secret = secret
+        #: Versioned-weights bookkeeping: which artifact this worker's
+        #: engine decodes with (file mode stamps it at startup; wire
+        #: init and rolling updates stamp it at push_commit). The sha
+        #: is the fleet's digest-verify handle.
+        self._params_version = params_version
+        self._params_sha = params_sha
+        #: Transfer state (wire init + rolling updates).
+        self._assembler = None
+        self._artifact_dir: Optional[str] = None
+        self._pending_config: Optional[Dict] = None
+        self._committed_path: Optional[str] = None
+        self._engine_ready = threading.Event()
+        if engine is not None:
+            self._engine_ready.set()
+        self._init_ready = threading.Event()
         #: Transport liveness channel: bumped once per engine-loop
         #: iteration (idle ticks included — "nothing to do" is not
         #: "wedged"), reported in every ping/step/collect reply so a
@@ -233,6 +249,56 @@ class WorkerHost:
             "retry_after": req.retry_after,
         }
 
+    # --------------------------------------------------- wire init
+
+    def attach_engine(self, engine, heartbeat=None) -> None:
+        """Hand the freshly-built engine to the host (wire init: the
+        main thread builds it once config + params have arrived and
+        verified). Unblocks every engine-facing RPC waiting in
+        :meth:`_require_engine`."""
+        self.engine = engine
+        if heartbeat is not None:
+            self.heartbeat = heartbeat
+        self._engine_ready.set()
+
+    def wait_init(self, timeout: float) -> bool:
+        """Main-thread wait (wire init) for config + a committed params
+        artifact; False on timeout or shutdown-before-init."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._shutdown.is_set():
+                return False
+            if self._init_ready.wait(0.25):
+                return True
+        return False
+
+    @property
+    def init_config(self) -> Optional[Dict]:
+        return self._pending_config
+
+    @property
+    def init_params_path(self) -> Optional[str]:
+        return self._committed_path
+
+    def _require_engine(self):
+        """Engine-facing RPCs block here until the engine exists (the
+        wire-init window / the post-spawn jax build). The CALLER's
+        deadline is the real bound; this local one only turns a worker
+        whose engine can never come up into a typed remote error
+        instead of a forever-parked RPC thread."""
+        if not self._engine_ready.wait(600.0):
+            raise RuntimeError(
+                "engine not initialized (no config/params pushed?)")
+        return self.engine
+
+    def _ensure_artifact_dir(self) -> str:
+        if self._artifact_dir is None:
+            # Worker-private, never shared: the whole point of the wire
+            # transfer is that no other host/process reads this.
+            self._artifact_dir = tempfile.mkdtemp(
+                prefix="hvd-worker-params-")
+        return self._artifact_dir
+
     # -------------------------------------------------- RPC thread
 
     def handle(self, method: str, params: Dict) -> Any:
@@ -243,11 +309,106 @@ class WorkerHost:
 
     def _rpc_ping(self, p: Dict) -> Dict:
         return {"pid": os.getpid(), "ticks": self._ticks,
-                "hb": self._hb_seq}
+                "hb": self._hb_seq,
+                "params_version": self._params_version or None,
+                "params_sha256": self._params_sha}
+
+    # ------------------------------------------- transfer RPCs
+    #
+    # put_config + push_begin/push_chunk/push_commit: the wire-native
+    # weight-distribution lane (serve/params_wire.py). These are the
+    # ONLY RPCs the fleet may retry after a TransportError — chunk
+    # writes are idempotent (same bytes at the same offset, contiguity
+    # enforced, whole-artifact digest at commit), unlike submit.
+
+    def _rpc_put_config(self, p: Dict) -> Dict:
+        cfg = p.get("config")
+        if not isinstance(cfg, dict):
+            raise ValueError(f"put_config: expected a config mapping, "
+                             f"got {type(cfg).__name__}")
+        if self._engine_ready.is_set():
+            if self._pending_config == dict(cfg):
+                # Idempotent re-send: a wire-init retry whose previous
+                # attempt lost only the REPLY (e.g. a commit acked
+                # worker-side, torn on the way back) re-runs the whole
+                # sequence — an identical config is a no-op, never a
+                # spurious replica death out of the one retried lane.
+                return {}
+            raise ValueError(
+                "put_config after engine construction — the engine "
+                "geometry is fixed for a worker's lifetime (weights "
+                "roll via push_*, geometry changes respawn)")
+        self._pending_config = dict(cfg)
+        self._maybe_init_ready()
+        return {}
+
+    def _rpc_push_begin(self, p: Dict) -> Dict:
+        man = p.get("manifest")
+        superseding = (
+            isinstance(man, dict)
+            and (man.get("version"), man.get("sha256"))
+            != (self._params_version, self._params_sha))
+        if self._committed_path is not None \
+                and not self._engine_ready.is_set() and superseding:
+            # A SUPERSEDING transfer (different version/digest) must
+            # not land while the main thread is still building the
+            # engine from the init artifact (it would prune the file
+            # mid-load, or leave old weights under a new version
+            # stamp) — wait the build out; the caller's RPC deadline
+            # bounds us, exactly the first-step-after-spawn
+            # discipline (size rpc_deadline above the engine build).
+            # A re-push of the SAME artifact (a retry whose previous
+            # attempt lost only the commit reply) proceeds
+            # immediately: its bytes and commit are idempotent, so it
+            # must never sit out the build burning the push budget.
+            self._require_engine()
+        asm = params_wire.ArtifactAssembler(self._ensure_artifact_dir())
+        have = asm.begin(man)
+        self._assembler = asm
+        return {"have_bytes": have}
+
+    def _rpc_push_chunk(self, p: Dict) -> Dict:
+        if self._assembler is None:
+            raise ValueError("push_chunk before push_begin")
+        return {"have_bytes": self._assembler.write_chunk(p)}
+
+    def _rpc_push_commit(self, p: Dict) -> Dict:
+        asm = self._assembler
+        if asm is None:
+            raise ValueError("push_commit before push_begin")
+        path, sha = asm.commit()
+        version = int(asm.manifest["version"])
+        self._assembler = None
+        # One weight copy on disk, not one per roll: superseded
+        # versions (full model artifacts) are pruned at commit.
+        params_wire.prune_artifacts(self._ensure_artifact_dir(), path)
+        if self._engine_ready.is_set():
+            # Rolling update: the fleet drained this replica first, so
+            # the engine is idle — swap weights in place, under the
+            # lock, between steps. A busy engine raising here is the
+            # drift signal, surfaced typed to the fleet.
+            with open(path, "rb") as f:
+                blob = f.read()
+            params = params_wire.params_from_blob(blob, as_jax=True)
+            with self._lock:
+                self.engine.update_params(params)
+        else:
+            self._committed_path = path
+        self._params_version, self._params_sha = version, sha
+        self._maybe_init_ready()
+        return {"version": version, "sha256": sha}
+
+    def _maybe_init_ready(self) -> None:
+        if self._pending_config is not None \
+                and self._committed_path is not None:
+            self._init_ready.set()
+
+    # ------------------------------------------- engine RPCs
 
     def _rpc_submit(self, p: Dict) -> Dict:
         from horovod_tpu.serve.scheduler import make_request
 
+        self._require_engine()
         with self._lock:
             eng = self.engine
             req = make_request(
@@ -275,6 +436,7 @@ class WorkerHost:
                     "retry_after": req.retry_after}
 
     def _rpc_step(self, p: Dict) -> Dict:
+        self._require_engine()
         with self._lock:
             eng = self.engine
             return {"ticks": self._ticks,
@@ -287,6 +449,7 @@ class WorkerHost:
 
     def _rpc_collect(self, p: Dict) -> Dict:
         since = p.get("since") or {}
+        self._require_engine()
         with self._lock:
             self._harvest_locked()
             events, self._terminal = self._terminal, []
@@ -306,10 +469,12 @@ class WorkerHost:
                 "hb": self._hb_seq}
 
     def _rpc_stats(self, p: Dict) -> Dict:
+        self._require_engine()
         with self._lock:
             return _jsonable(self.engine.stats())
 
     def _rpc_drain(self, p: Dict) -> Dict:
+        self._require_engine()
         deadline = time.monotonic() + float(p.get("timeout", 5.0))
         while time.monotonic() < deadline:
             with self._lock:
@@ -319,12 +484,18 @@ class WorkerHost:
         return {"idle": False}
 
     def _rpc_reset_metrics(self, p: Dict) -> Dict:
+        self._require_engine()
         with self._lock:
             self.engine.reset_metrics()   # raises if not idle
             self._ticks = 0
         return {"ticks": 0}
 
     def _rpc_fault(self, p: Dict) -> Dict:
+        # Deliberately NO _require_engine: fault arming only sets host
+        # flags the serve loop consumes post-attach, and the fleet may
+        # arm a fault in the same tick that wire-inits this worker —
+        # waiting here would deadlock against the very thread whose
+        # pushes make the engine ready.
         kind = p.get("kind")
         with self._lock:
             if kind == "stall":
@@ -408,10 +579,18 @@ def main(argv=None) -> int:
                          "listener is network-reachable, so every "
                          "connection must pass the shared-secret "
                          "handshake")
-    ap.add_argument("--params", required=True,
-                    help="npz of model params (worker.save_params)")
-    ap.add_argument("--config", required=True,
-                    help="path to the ServeConfig JSON")
+    ap.add_argument("--params", default="",
+                    help="params artifact file (worker.save_params). "
+                         "Omit BOTH --params and --config for wire "
+                         "init: config + params then arrive over the "
+                         "RPC wire (put_config + push_*) — the fleet's "
+                         "default, no filesystem assumption")
+    ap.add_argument("--config", default="",
+                    help="path to the ServeConfig JSON (file mode; "
+                         "see --params)")
+    ap.add_argument("--params-version", type=int, default=1,
+                    help="artifact version stamp for file mode (wire "
+                         "init takes it from the pushed manifest)")
     ap.add_argument("--rank", type=int, default=0,
                     help="replica id (heartbeat file + logs)")
     ap.add_argument("--heartbeat-dir", default="",
@@ -422,6 +601,10 @@ def main(argv=None) -> int:
     if bool(args.socket) == bool(args.bind):
         ap.error("exactly one of --socket (unix) or --bind host:port "
                  "(tcp) is required")
+    if bool(args.params) != bool(args.config):
+        ap.error("--params and --config come together (file mode) or "
+                 "not at all (wire init: both arrive over the RPC "
+                 "wire)")
 
     # Bind BEFORE the heavy init: the router's connect succeeds as soon
     # as the process is alive; its first RPCs wait inside their own
@@ -475,33 +658,76 @@ def main(argv=None) -> int:
             return EXIT_USAGE
         srv.listen(2)
 
-    import jax
+    def _build_engine(cfg_kwargs, params_path):
+        # The heavy half, shared by both modes: jax import + engine
+        # construction. Runs AFTER the socket is bound, so the
+        # router's connect always succeeds early.
+        import jax
 
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        # This image's sitecustomize imports jax at interpreter startup
-        # (the conftest note): config.update is the reliable override.
-        jax.config.update("jax_platforms", plat.split(",")[0])
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            # This image's sitecustomize imports jax at interpreter
+            # startup (the conftest note): config.update is the
+            # reliable override.
+            jax.config.update("jax_platforms", plat.split(",")[0])
+
+        from horovod_tpu.serve.config import ServeConfig
+        from horovod_tpu.serve.engine import ServeEngine
+
+        cfg = ServeConfig(**cfg_kwargs)
+        return ServeEngine(load_params(params_path), cfg)
 
     from horovod_tpu.elastic.signals import Heartbeat
-    from horovod_tpu.serve.config import ServeConfig
-    from horovod_tpu.serve.engine import ServeEngine
 
-    with open(args.config) as f:
-        cfg = ServeConfig(**json.load(f))
-    params = load_params(args.params)
-    engine = ServeEngine(params, cfg)
     hb = Heartbeat(args.heartbeat_dir, rank=args.rank) \
         if args.heartbeat_dir else None
 
-    host_loop = WorkerHost(engine, hb, secret=secret or None)
-    rpc = threading.Thread(target=host_loop.rpc_loop, args=(srv,),
-                           daemon=True,
-                           name=f"serve-worker-rpc-{args.rank}")
-    rpc.start()
-    print(f"serve.worker[{args.rank}]: serving on "
-          f"{args.bind or args.socket} (pid {os.getpid()})",
-          file=sys.stderr, flush=True)
+    if not args.params:
+        # WIRE INIT: serve the transfer RPCs first (pure file I/O, no
+        # jax), build the engine only once a digest-verified artifact
+        # and the config have both arrived over the wire.
+        host_loop = WorkerHost(None, None, secret=secret or None)
+        rpc = threading.Thread(target=host_loop.rpc_loop, args=(srv,),
+                               daemon=True,
+                               name=f"serve-worker-rpc-{args.rank}")
+        rpc.start()
+        print(f"serve.worker[{args.rank}]: serving on "
+              f"{args.bind or args.socket} (pid {os.getpid()}) — "
+              "awaiting config + params over the wire",
+              file=sys.stderr, flush=True)
+        init_timeout = float(os.environ.get(
+            "HVD_SERVE_WORKER_INIT_TIMEOUT", "600"))
+        if not host_loop.wait_init(init_timeout):
+            print(f"serve.worker[{args.rank}]: no config/params "
+                  f"arrived within {init_timeout:g}s — exiting",
+                  file=sys.stderr, flush=True)
+            srv.close()
+            return EXIT_USAGE
+        engine = _build_engine(host_loop.init_config,
+                               host_loop.init_params_path)
+        host_loop.attach_engine(engine, hb)
+        print(f"serve.worker[{args.rank}]: engine up on params "
+              f"v{host_loop._params_version} "
+              f"(sha256 {(host_loop._params_sha or '')[:12]})",
+              file=sys.stderr, flush=True)
+    else:
+        # FILE MODE (standalone / debugging): params + config from
+        # disk, version stamped from the CLI, sha from the file bytes.
+        with open(args.config) as f:
+            cfg_kwargs = json.load(f)
+        engine = _build_engine(cfg_kwargs, args.params)
+        with open(args.params, "rb") as f:
+            sha = params_wire.sha256_hex(f.read())
+        host_loop = WorkerHost(engine, hb, secret=secret or None,
+                               params_version=args.params_version,
+                               params_sha=sha)
+        rpc = threading.Thread(target=host_loop.rpc_loop, args=(srv,),
+                               daemon=True,
+                               name=f"serve-worker-rpc-{args.rank}")
+        rpc.start()
+        print(f"serve.worker[{args.rank}]: serving on "
+              f"{args.bind or args.socket} (pid {os.getpid()})",
+              file=sys.stderr, flush=True)
     host_loop.serve_loop()
     srv.close()
     return EXIT_CLEAN

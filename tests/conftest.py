@@ -235,6 +235,12 @@ _SLOW_TESTS = {
     # recovery matrix over real TCP via the no-jax stub) and the
     # tools/check.sh loopback-TCP fleet smoke.
     "test_serve_worker.py::TestRealWorkerE2E::test_tcp_partition_host_down_bit_exact_vs_lm_decode",
+    # Round-15 rolling-update e2e (2 real tcp workers + an update push
+    # = 4 jax imports + compiles). Fast stand-ins:
+    # TestStubRollingUpdate (the full drain/push/tear/resume matrix on
+    # the protocol stub) + TestVersionedRollingUpdate (inproc version
+    # pinning vs lm_decode) + the check.sh rolling-update smoke.
+    "test_serve_worker.py::TestRealWorkerE2E::test_tcp_rolling_update_torn_push_bit_exact_vs_lm_decode",
 }
 
 

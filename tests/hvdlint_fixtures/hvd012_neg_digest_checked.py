@@ -1,0 +1,27 @@
+"""HVD012 negative: digest-disciplined artifact write (the
+serve/params_wire.py assembler shape): the writer records the blob's
+sha256 beside it and the loader verifies before trusting a byte — a
+torn or corrupted artifact is a typed rejection, never a load, so the
+in-place write is safe to observe.
+"""
+
+import hashlib
+import json
+
+
+def save_params_blob(params_path, blob):
+    digest = hashlib.sha256(blob).hexdigest()
+    with open(params_path, "wb") as f:
+        f.write(blob)
+    with open(params_path + ".sha256", "w") as f:
+        json.dump({"sha256": digest, "bytes": len(blob)}, f)
+
+
+def load_params_blob(params_path):
+    with open(params_path, "rb") as f:
+        blob = f.read()
+    with open(params_path + ".sha256") as f:
+        want = json.load(f)["sha256"]
+    if hashlib.sha256(blob).hexdigest() != want:
+        raise ValueError("torn or corrupted params artifact")
+    return blob

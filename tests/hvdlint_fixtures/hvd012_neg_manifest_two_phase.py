@@ -1,0 +1,24 @@
+"""HVD012 negative: the elastic manifest's two-phase commit (the
+canonical discipline, horovod_tpu/elastic/snapshot.py): the artifact
+lands at a temp path first and os.replace() renames it into place
+atomically — a crash between the phases leaves either the old
+committed state or a stray .tmp, never a torn file at the path a
+restore opens.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def commit_snapshot(directory, step, arrays, manifest):
+    path = os.path.join(directory, f"snapshot-{step}.npz")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)            # phase 1: the artifact commits
+    pointer = os.path.join(directory, "MANIFEST")
+    ptmp = f"{pointer}.{os.getpid()}.tmp"
+    with open(ptmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(ptmp, pointer)        # phase 2: the pointer flips

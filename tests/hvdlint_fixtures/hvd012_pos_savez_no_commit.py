@@ -1,0 +1,18 @@
+"""HVD012 positive: checkpoint written straight to its final path.
+
+A crash (or SIGKILL) halfway through np.savez leaves a torn file at
+exactly the path the next restore opens — numpy parses the truncated
+container "successfully" for the leaves that landed, and the run
+resumes with silently wrong weights. No temp+rename commit, no digest.
+"""
+
+import numpy as np
+
+
+def save_checkpoint(params, path):
+    np.savez(path, **params)  # EXPECT: HVD012
+
+
+def load_checkpoint(path):
+    with np.load(path) as z:
+        return dict(z)

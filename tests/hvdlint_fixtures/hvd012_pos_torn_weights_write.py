@@ -1,0 +1,18 @@
+"""HVD012 positive: raw binary weights blob written in place.
+
+The serving-fleet shape this rule encodes: a params blob streamed to
+its FINAL path with open(..., "wb") — a worker killed mid-write (the
+whole reason the fleet transport exists) leaves a truncated blob, and
+the next incarnation loads a prefix of the model as if it were the
+model. No rename commit and no digest check anywhere in scope.
+"""
+
+
+def persist_weights(weights_path, blob):
+    with open(weights_path, "wb") as f:  # EXPECT: HVD012
+        f.write(blob)
+
+
+def restore_weights(weights_path):
+    with open(weights_path, "rb") as f:
+        return f.read()

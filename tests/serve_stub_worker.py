@@ -13,12 +13,17 @@ process-fleet recovery matrix (transport death paths, watchdog stalls,
 close escalation, startup crashes) run in the fast test lane against
 real OS processes.
 
-Its "model" is a deterministic context hash: the next token depends on
-the FULL context (prompt + everything generated), exactly like greedy
-LM decoding — so a redispatch that folds the generated-so-far prefix
-into the prompt (``rebase_for_recompute``) continues the identical
-stream, and the at-most-once/bit-exact pins hold for the same reason
-they hold on the real engine.
+Its "model" is a deterministic context hash SALTED by the params
+artifact the fleet pushed over the wire: the next token depends on the
+full context (prompt + everything generated) AND the sha256 of the
+worker's current weights, exactly like greedy LM decoding — so a
+redispatch that folds the generated-so-far prefix into the prompt
+(``rebase_for_recompute``) continues the identical stream, the
+at-most-once/bit-exact pins hold for the same reason they hold on the
+real engine, and a PARAMS VERSION change observably changes the
+stream (which is what makes the rolling-update pins — no mixed-version
+stream, wire-init actually delivered the weights — provable without
+jax).
 
 Loaded as a module by tests for :func:`expected_stream`; run as a
 script by the fleet's ``worker_cmd`` hook.
@@ -26,43 +31,81 @@ script by the fleet's ``worker_cmd`` hook.
 
 import argparse
 import importlib.util
-import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
 VOCAB = 97
 
 
-def next_token(context):
-    h = 0
+def next_token(context, salt=0):
+    h = int(salt) % 1000003
     for t in context:
         h = (h * 31 + int(t) + 1) % 1000003
     return h % VOCAB
 
 
-def expected_stream(prompt, n):
+def expected_stream(prompt, n, salt=0):
     """The stream an uninterrupted greedy 'decode' of ``prompt`` emits
-    — and, because each token depends on the full context, the stream
-    any rebased redispatch must continue bit-identically."""
+    under the weights whose digest-derived ``salt`` this is — and,
+    because each token depends on the full context, the stream any
+    rebased SAME-VERSION redispatch must continue bit-identically."""
     ctx = [int(t) for t in prompt]
     out = []
     for _ in range(n):
-        t = next_token(ctx)
+        t = next_token(ctx, salt)
         ctx.append(t)
         out.append(t)
     return out
 
 
-def _load_transport():
+def salt_for_sha(sha_hex):
+    """The stub model's weights: the artifact digest, folded small."""
+    return int(sha_hex[:8], 16)
+
+
+def params_salt(params):
+    """Test-side twin: the salt a stub serving ``params`` (pushed by
+    the fleet as a wire artifact) decodes with."""
+    pw = _load_serve_module("params_wire")
+    return salt_for_sha(pw.sha256_hex(pw.params_to_blob(params)))
+
+
+def _load_serve_module(name):
+    """Load one horovod_tpu/serve module by FILE, pre-seeding stub
+    package entries in sys.modules so intra-package imports (e.g.
+    params_wire's ``from horovod_tpu.serve.transport import ...``)
+    resolve WITHOUT executing the real package __init__ (which pulls
+    the whole serve stack — the stub runs ``python -S`` with no
+    site-packages and must stay jax/numpy-free on its hot path)."""
+    import types
+
     here = os.path.dirname(os.path.abspath(__file__))
-    path = os.path.join(os.path.dirname(here), "horovod_tpu", "serve",
-                        "transport.py")
-    spec = importlib.util.spec_from_file_location("_stub_transport", path)
+    serve_dir = os.path.join(os.path.dirname(here), "horovod_tpu",
+                             "serve")
+    full = f"horovod_tpu.serve.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    for pkg in ("horovod_tpu", "horovod_tpu.serve"):
+        if pkg not in sys.modules:
+            mod = types.ModuleType(pkg)
+            mod.__path__ = []
+            sys.modules[pkg] = mod
+    if name != "transport" \
+            and "horovod_tpu.serve.transport" not in sys.modules:
+        _load_serve_module("transport")
+    spec = importlib.util.spec_from_file_location(
+        full, os.path.join(serve_dir, f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
+    sys.modules[full] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_transport():
+    return _load_serve_module("transport")
 
 
 class StubHost:
@@ -86,6 +129,22 @@ class StubHost:
         self._collects = 0
         torn = os.environ.get("HVD_SERVE_WORKER_TORN_COLLECT_AFTER")
         self._torn_after = int(torn) if torn else None
+        #: Wire weight distribution (real-worker parity): the fleet
+        #: pushes config + a versioned params artifact at spawn and on
+        #: rolling updates; the committed artifact's digest salts the
+        #: stub's "model" so a version change changes the stream.
+        self._salt = 0
+        self._version = None
+        self._sha = None
+        self._config = None
+        self._assembler = None
+        self._artifact_dir = None
+        self._pushes = 0
+        die = os.environ.get("HVD_STUB_DIE_ON_PUSH_CHUNK")
+        #: test hook: os._exit(1) on the Nth push_chunk — the
+        #: kill-mid-push shape (retry consumes budget, then the
+        #: replica-death path).
+        self._die_on_chunk = int(die) if die else None
 
     # ------------------------------------------------ engine loop
 
@@ -125,7 +184,7 @@ class StubHost:
         for rid in active:
             req = self._requests[rid]
             ctx = req["prompt"] + req["output"]
-            req["output"].append(next_token(ctx))
+            req["output"].append(next_token(ctx, self._salt))
             progressed = True
             if len(req["output"]) >= req["max_new"]:
                 self._terminal.append({
@@ -150,7 +209,50 @@ class StubHost:
 
     def _rpc_ping(self, p):
         return {"pid": os.getpid(), "ticks": self._ticks,
-                "hb": self._hb}
+                "hb": self._hb, "params_version": self._version,
+                "params_sha256": self._sha}
+
+    # ------------------------------------------ transfer RPCs
+
+    def _rpc_put_config(self, p):
+        cfg = p.get("config")
+        if not isinstance(cfg, dict):
+            raise ValueError("put_config: expected a config mapping")
+        self._config = dict(cfg)
+        return {}
+
+    def _rpc_push_begin(self, p):
+        pw = _load_serve_module("params_wire")
+        if self._artifact_dir is None:
+            self._artifact_dir = tempfile.mkdtemp(
+                prefix="hvd-stub-params-")
+        asm = pw.ArtifactAssembler(self._artifact_dir)
+        have = asm.begin(p.get("manifest"))
+        self._assembler = asm
+        return {"have_bytes": have}
+
+    def _rpc_push_chunk(self, p):
+        if self._assembler is None:
+            raise ValueError("push_chunk before push_begin")
+        self._pushes += 1
+        if self._die_on_chunk is not None \
+                and self._pushes >= self._die_on_chunk:
+            os._exit(1)   # kill-mid-push: the worker-lost-mid-transfer shape
+        return {"have_bytes": self._assembler.write_chunk(p)}
+
+    def _rpc_push_commit(self, p):
+        asm = self._assembler
+        if asm is None:
+            raise ValueError("push_commit before push_begin")
+        path, sha = asm.commit()
+        self._assembler = None
+        pw = _load_serve_module("params_wire")
+        pw.prune_artifacts(self._artifact_dir, path)
+        with self._lock:
+            self._version = int(asm.manifest["version"])
+            self._sha = sha
+            self._salt = salt_for_sha(sha)
+        return {"version": self._version, "sha256": sha}
 
     def _rpc_submit(self, p):
         with self._lock:

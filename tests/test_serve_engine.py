@@ -460,3 +460,47 @@ class TestDeadlines:
 
         with pytest.raises(ValueError, match="ttl"):
             Request(prompt=_prompt(7, 6), max_new_tokens=2, ttl=-1.0)
+
+
+class TestUpdateParams:
+    """The rolling update's engine primitive: an in-place weight swap
+    that is only legal on a DRAINED engine (a live stream must never
+    mix weights) and never a geometry change."""
+
+    def test_swap_on_idle_engine_decodes_new_weights_exactly(self,
+                                                             params):
+        params2 = plm.init_lm_params(jax.random.PRNGKey(9), V, LMAX,
+                                     LAYERS, H, DH, FFN)
+        eng = ServeEngine(params, ServeConfig(page_size=8, num_pages=32,
+                                              decode_slots=2,
+                                              prefill_chunk=4))
+        p = _prompt(50, 6)
+        r1 = eng.submit(p, 6)
+        eng.run()
+        assert r1.output == _ref(params, p, 6)
+        eng.update_params(params2)
+        r2 = eng.submit(p, 6)
+        eng.run()
+        assert r2.output == _ref(params2, p, 6)
+        # ...and the jitted step re-traced nothing (same shapes): the
+        # old stream stays the old model's, the new one the new's.
+        assert r1.output != r2.output or params is params2
+
+    def test_swap_with_requests_in_flight_raises(self, params):
+        eng = ServeEngine(params, ServeConfig(page_size=8, num_pages=32,
+                                              decode_slots=2,
+                                              prefill_chunk=4))
+        eng.submit(_prompt(51, 6), 8)
+        eng.step()
+        with pytest.raises(RuntimeError, match="drain"):
+            eng.update_params(params)
+        eng.run()
+
+    def test_geometry_change_is_a_respawn_not_a_swap(self, params):
+        eng = ServeEngine(params, ServeConfig(page_size=8, num_pages=32,
+                                              decode_slots=2,
+                                              prefill_chunk=4))
+        small = plm.init_lm_params(jax.random.PRNGKey(3), V, LMAX // 2,
+                                   LAYERS, H, DH, FFN)
+        with pytest.raises(ValueError, match="geometry"):
+            eng.update_params(small)

@@ -723,3 +723,179 @@ class TestRestartPolicy:
         fl.step()
         assert fl.replicas[1].state == "healthy"
         assert 1 not in fl.watchdog.kills
+
+
+# ---------------------------------------------- versioned rolling updates
+
+
+class TestVersionedRollingUpdate:
+    """update_params() on the inproc fleet: drain → swap → readmit one
+    replica at a time, with the version pin making a mid-stream weight
+    mix impossible — a request decodes ENTIRELY under one params
+    version, across redispatch included."""
+
+    @pytest.fixture(scope="class")
+    def params2(self):
+        return plm.init_lm_params(jax.random.PRNGKey(7), V, LMAX,
+                                  LAYERS, H, DH, FFN)
+
+    def _drain(self, fl, clk):
+        guard = 0
+        while not fl.idle or fl.update_active:
+            if not fl.step():
+                clk.sleep(0.02)
+            guard += 1
+            assert guard < 3000, "fleet failed to drain"
+
+    def test_update_rolls_fleet_streams_stay_single_version(
+            self, params, params2):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        try:
+            p0, p1 = _prompt(20, 6), _prompt(21, 6)
+            r0 = fl.submit(p0, 8)
+            for _ in range(3):
+                fl.step()
+            assert r0.version == 1 and r0.output
+            assert fl.update_params(params2) == 2
+            with pytest.raises(RuntimeError, match="in progress"):
+                fl.update_params(params2)
+            r1 = fl.submit(p1, 8)
+            self._drain(fl, clk)
+            # r0 was mid-stream at the roll: its pin means its WHOLE
+            # output is the old model's, bit-identical to lm_decode
+            assert r0.state == "finished"
+            assert r0.output == _ref(params, p0, 8)
+            # r1 landed during the roll: either version is legal, but
+            # only ENTIRELY one of them
+            assert r1.output in (_ref(params, p1, 8),
+                                 _ref(params2, p1, 8))
+            f = fl.stats()["fleet"]
+            assert f["params_version"] == 2
+            assert not f["update_active"]
+            assert all(r["version"] == 2 for r in f["per_replica"])
+            assert len({r["params_sha"]
+                        for r in f["per_replica"]}) == 1
+            assert f["incidents_by_class"] == {}
+            # post-roll submissions can only decode the new weights
+            p2 = _prompt(22, 6)
+            r2 = fl.submit(p2, 8)
+            self._drain(fl, clk)
+            assert r2.output == _ref(params2, p2, 8)
+        finally:
+            fl.close()
+
+    def test_redispatch_rebases_only_onto_same_version(self, params):
+        """Both replicas on v1: a kill mid-decode redispatches with
+        the rebase (at-most-once), version pin intact."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        try:
+            p = _prompt(23, 6)
+            r = fl.submit(p, 10)
+            for _ in range(4):
+                fl.step()
+            assert r.version == 1 and r.output
+            victim = next(rep for rep in fl.replicas
+                          if any(q is r for q in rep.assigned))
+            fl.arm_fault_plan(f"kill:replica={victim.id},at=0s")
+            self._drain(fl, clk)
+            assert r.redispatches == 1
+            assert r.version == 1 and r.version_restarts == 0
+            assert r.output == _ref(params, p, 10)
+        finally:
+            fl.close()
+
+    def test_stranded_version_restarts_from_scratch(self, params,
+                                                    params2):
+        """The explicit cross-version policy: the ONLY v1 replica dies
+        mid-stream while the fleet has already rolled to v2 — the
+        pinned request can never continue (no v1 replica will ever
+        exist again), so it RESTARTS from its original prompt under v2
+        and its full stream is the new model's."""
+        clk = FakeClock()
+        fl = _fleet(params, clk, replicas=1, max_restarts=2)
+        try:
+            p = _prompt(24, 6)
+            r = fl.submit(p, 10)
+            for _ in range(4):
+                fl.step()
+            assert r.version == 1 and r.output
+            fl.update_params(params2)
+            # kill the (only) v1 replica before its drain completes:
+            # its relaunch wire-inits from the CURRENT artifact (v2)
+            fl.arm_fault_plan("kill:replica=0,at=0s")
+            self._drain(fl, clk)
+            assert r.state == "finished"
+            assert r.version_restarts == 1
+            assert r.version == 2
+            assert fl.version_recomputed == 1
+            # the restart is a FULL stream under v2 — never a splice
+            # of v1 and v2 tokens
+            assert r.output == _ref(params2, p, 10)
+            assert len(r.output) == 10
+        finally:
+            fl.close()
+
+    def test_updating_replica_stops_accepting_but_fleet_serves(
+            self, params, params2):
+        """Zero-downtime means the drained replica's traffic routes to
+        its peers: while replica 0 drains, a new request must dispatch
+        to replica 1 — never queue behind the roll."""
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        try:
+            p = _prompt(25, 6)
+            r0 = fl.submit(p, 30)
+            for _ in range(3):
+                fl.step()
+            fl.update_params(params2)
+            fl.step()   # picks the draining replica
+            draining = [rep for rep in fl.replicas
+                        if not rep.accepting]
+            assert len(draining) == 1
+            r1 = fl.submit(_prompt(26, 5), 3)
+            fl.step()
+            serving = next(rep for rep in fl.replicas
+                           if any(q is r1 for q in rep.assigned))
+            assert serving is not draining[0]
+            self._drain(fl, clk)
+            assert r0.state == r1.state == "finished"
+        finally:
+            fl.close()
+
+    def test_update_on_inproc_requires_no_wire_faults(self, params):
+        fl = _fleet(params, FakeClock())
+        try:
+            with pytest.raises(FaultPlanError, match="params-push"):
+                fl.arm_fault_plan("transfer:replica=0,at=1s")
+            with pytest.raises(FaultPlanError, match="params-push"):
+                fl.arm_fault_plan("corrupt:replica=0,at=1s")
+        finally:
+            fl.close()
+
+    def test_wrong_geometry_update_raises_before_any_mutation(
+            self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk)
+        try:
+            bad = plm.init_lm_params(jax.random.PRNGKey(5), V,
+                                     LMAX // 2, LAYERS, H, DH, FFN)
+            with pytest.raises(ValueError, match="geometry"):
+                fl.update_params(bad)
+            # structure matters too, not just leaf shapes: a renamed
+            # key with identical leaves is a different model
+            renamed = dict(params)
+            renamed["embedding"] = renamed.pop("embed")
+            with pytest.raises(ValueError, match="geometry"):
+                fl.update_params(renamed)
+            # NOTHING mutated: no roll armed, version/artifact intact,
+            # and the fleet still serves
+            assert not fl.update_active
+            assert fl.params_version == 1
+            assert fl.params is params
+            r = fl.submit(_prompt(27, 6), 4)
+            self._drain(fl, clk)
+            assert r.output == _ref(params, _prompt(27, 6), 4)
+        finally:
+            fl.close()

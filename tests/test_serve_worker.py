@@ -27,14 +27,20 @@ import pytest
 
 from horovod_tpu.serve import (FleetConfig, ProcessReplica, ServeConfig,
                                ServeFleet)
-from tests.serve_stub_worker import VOCAB, expected_stream
+from tests.serve_stub_worker import VOCAB, expected_stream, params_salt
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 STUB = os.path.join(HERE, "serve_stub_worker.py")
 
-#: The stub never touches the params/engine; the fleet only reads
-#: Lmax (admission geometry) off this.
+#: The stub never runs an engine off these, but the fleet ships them
+#: to every worker incarnation as the wire params artifact (the
+#: digest-derived salt below is the stub's "weights") and reads Lmax
+#: (admission geometry) off them.
 STUB_PARAMS = {"pos": np.zeros((64, 4), np.float32)}
+#: Salt every stub incarnation decodes with once the fleet's wire-init
+#: push lands — expected_stream(p, n, SALT) matching IS the proof the
+#: artifact arrived over the transport, digest-intact.
+SALT = params_salt(STUB_PARAMS)
 
 
 def _stub_cmd(extra_env=None, extra_args=(), per_rid_env=None):
@@ -100,7 +106,7 @@ class TestStubFleet:
             _run_until(fl, reqs)
             for p, r in zip(prompts, reqs):
                 assert r.state == "finished"
-                assert r.output == expected_stream(p, r.orig_max_new)
+                assert r.output == expected_stream(p, r.orig_max_new, SALT)
             f = fl.stats()["fleet"]
             assert f["transport"] == "process"
             assert f["rpc_ms"]["calls"] > 0
@@ -134,7 +140,7 @@ class TestStubFleet:
             for p, r in zip(prompts, reqs):
                 assert r.state == "finished"
                 # at-most-once + bit-exact continuation across the kill
-                assert r.output == expected_stream(p, 8), (
+                assert r.output == expected_stream(p, 8, SALT), (
                     pid, r.redispatches, r.output)
             assert any(r.redispatches for r in reqs)
         finally:
@@ -158,7 +164,7 @@ class TestStubFleet:
             assert f["incidents"][0]["transport_error"] == "FrameError"
             for p, r in zip(prompts, reqs):
                 assert r.state == "finished"
-                assert r.output == expected_stream(p, 8)
+                assert r.output == expected_stream(p, 8, SALT)
         finally:
             fl.close()
         _assert_reaped(fl)
@@ -235,7 +241,7 @@ class TestStubFleet:
             assert f["detect_s"] is not None and f["detect_s"] >= 0.6
             for p, r in zip(prompts, reqs):
                 assert r.state == "finished"
-                assert r.output == expected_stream(p, 12)
+                assert r.output == expected_stream(p, 12, SALT)
         finally:
             fl.close()
         _assert_reaped(fl)
@@ -302,8 +308,160 @@ class TestStubFleet:
             fl.arm_fault_plan("slow:replica=0,at=0s,factor=3")
             reqs = [fl.submit(np.asarray([5, 6, 7], np.int32), 4)]
             _run_until(fl, reqs)
-            assert reqs[0].output == expected_stream([5, 6, 7], 4)
+            assert reqs[0].output == expected_stream([5, 6, 7], 4, SALT)
             assert fl.stats()["fleet"]["incidents_by_class"] == {}
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+
+NEW_PARAMS = {"pos": np.ones((64, 4), np.float32) * 3.0}
+NEW_SALT = params_salt(NEW_PARAMS)
+
+
+def _run_update_until_done(fl, reqs, timeout=30.0):
+    t0 = time.monotonic()
+    while (not fl.idle or fl.update_active) \
+            and time.monotonic() - t0 < timeout:
+        if not fl.step():
+            time.sleep(0.005)
+    assert fl.idle and not fl.update_active, (
+        [r.state for r in reqs], fl.update_active)
+
+
+class TestStubRollingUpdate:
+    """The versioned rolling update over REAL worker OS processes (the
+    protocol stub): drain → chunked wire push → digest verify →
+    readmit, one replica at a time, with the transfer fault lanes.
+    NEW_PARAMS differ from STUB_PARAMS, so the salt CHANGES across the
+    version boundary — a stream that mixed versions mid-decode would
+    match neither expected_stream(..., SALT) nor (..., NEW_SALT)."""
+
+    def test_update_rolls_both_replicas_streams_never_mix(self):
+        assert SALT != NEW_SALT
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"]))
+        try:
+            prompts = _prompts(6)
+            reqs = [fl.submit(np.asarray(p, np.int32), 8)
+                    for p in prompts]
+            for _ in range(3):
+                fl.step()
+            assert fl.update_params(NEW_PARAMS) == 2
+            with pytest.raises(RuntimeError, match="in progress"):
+                fl.update_params(NEW_PARAMS)
+            late = [fl.submit(np.asarray(p, np.int32), 6)
+                    for p in _prompts(3, base=40)]
+            _run_update_until_done(fl, reqs + late)
+            f = fl.stats()["fleet"]
+            assert f["params_version"] == 2
+            assert f["incidents_by_class"] == {}, f
+            per = f["per_replica"]
+            assert all(r["version"] == 2 for r in per), per
+            shas = {r["params_sha"] for r in per}
+            assert len(shas) == 1 and None not in shas
+            # 2 spawn wire-inits + 2 update pushes (tests run with
+            # no bench-style metrics reset)
+            assert f["params_push"]["pushes"] == 4
+            assert f["params_push"]["retries"] == 0
+            # EVERY stream is entirely one version's output — the pin:
+            # a mixed stream would match neither reference.
+            for p, r in zip(prompts + _prompts(3, base=40),
+                            reqs + late):
+                assert r.state == "finished"
+                n = r.orig_max_new
+                old = expected_stream(p, n, SALT)
+                new = expected_stream(p, n, NEW_SALT)
+                assert r.output in (old, new), (p, r.output)
+            # ...and a request submitted AFTER the roll completed can
+            # only decode under the new version.
+            post = fl.submit(np.asarray([9, 9, 9], np.int32), 5)
+            _run_update_until_done(fl, [post])
+            assert post.output == expected_stream([9, 9, 9], 5,
+                                                  NEW_SALT)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_transfer_tear_classified_retry_resumes(self):
+        """kill-the-wire mid-push: the transfer: fault tears the FIRST
+        push attempt; the fleet classifies it, backs off, reconnects,
+        resumes from the worker's verified offset — exactly one
+        transfer retry, NO replica death, digests verified."""
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"]),
+            push_chunk_bytes=64)
+        try:
+            reqs = [fl.submit(np.asarray(p, np.int32), 6)
+                    for p in _prompts(4)]
+            fl.arm_fault_plan("transfer:replica=0,at=0s")
+            fl.update_params(NEW_PARAMS)
+            _run_update_until_done(fl, reqs)
+            f = fl.stats()["fleet"]
+            assert f["params_push"]["retries"] == 1, f["params_push"]
+            assert f["transfer_incidents"] == {"ConnectionLost": 1}, f
+            assert f["incidents_by_class"] == {}, f
+            assert all(r["version"] == 2 for r in f["per_replica"])
+            # the update was armed before the first tick, so the spawn
+            # wire-inits already shipped the v2 artifact: 2 pushes
+            assert f["params_push"]["pushes"] == 2
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_corrupt_chunk_is_typed_checksum_retry(self):
+        """A bit-flipped chunk must be REJECTED by the worker's
+        per-chunk CRC (typed ChecksumError riding back as the remote
+        error), retried, and the committed artifact digest-verified —
+        a corrupted transfer can never become a silently wrong
+        model."""
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"]),
+            push_chunk_bytes=64)
+        try:
+            reqs = [fl.submit(np.asarray(p, np.int32), 6)
+                    for p in _prompts(4)]
+            fl.arm_fault_plan("corrupt:replica=1,at=0s")
+            fl.update_params(NEW_PARAMS)
+            _run_update_until_done(fl, reqs)
+            f = fl.stats()["fleet"]
+            assert f["params_push"]["retries"] == 1, f["params_push"]
+            assert f["transfer_incidents"] == {"ChecksumError": 1}, f
+            assert f["incidents_by_class"] == {}, f
+            shas = {r["params_sha"] for r in f["per_replica"]}
+            assert len(shas) == 1 and None not in shas
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_kill_mid_push_consumes_budget_then_relaunch_updates(self):
+        """A worker that DIES mid-push (not just a torn wire) exhausts
+        the push's retry budget fast (the process is observably dead),
+        takes the classified replica-death path, and its relaunch
+        wire-inits straight onto the NEW version."""
+        fl = _stub_fleet(worker_cmd=_stub_cmd(
+            extra_args=["--tick-s", "0.02"],
+            per_rid_env={0: {"HVD_STUB_DIE_ON_PUSH_CHUNK": "2"}}),
+            push_chunk_bytes=64, max_restarts=2)
+        try:
+            reqs = [fl.submit(np.asarray(p, np.int32), 6)
+                    for p in _prompts(4)]
+            # let the doomed worker finish its spawn-time wire init
+            # (the die-hook counts push_chunk calls: the init push is
+            # chunk 1, the update push dies)... the init itself is
+            # chunk 1+2 with 64B chunks, so it dies DURING INIT —
+            # which is fine: a startup-window death is the same lane.
+            _run_update_until_done(fl, reqs, timeout=30.0)
+            f = fl.stats()["fleet"]
+            # the death was classified and budgeted, and the final
+            # state is a fully-updated fleet (the relaunch wire-inits
+            # from the current artifact)
+            assert f["incidents_by_class"].get("crashed", 0) >= 1, f
+            assert f["restarts_used"] >= 1
+            assert all(r["version"] is not None
+                       for r in f["per_replica"] if r["state"] == "healthy")
+            for r in reqs:
+                assert r.state == "finished"
         finally:
             fl.close()
         _assert_reaped(fl)
@@ -432,6 +590,49 @@ class TestRealWorkerE2E:
             assert f["host_incidents"] == 1
             assert f["failed"] == 0
             assert f["rpc_ms"]["p50"] is not None
+            for p, r in zip(prompts, reqs):
+                assert r.state == "finished"
+                assert r.output == _lm_ref(params, p, 10)
+        finally:
+            fl.close()
+        _assert_reaped(fl)
+
+    def test_tcp_rolling_update_torn_push_bit_exact_vs_lm_decode(self):
+        """Round-15 acceptance, real-worker edition: a 2-replica
+        loopback-TCP fleet (params/config over the wire only) rolls to
+        a new weights version mid-traffic with the FIRST push attempt
+        torn; the push classifies exactly one transfer retry and
+        resumes, both replicas digest-verify the new version, every
+        request finishes, and — the update re-pushing the same params
+        content — every greedy stream is bit-identical to lm_decode
+        within its pinned version."""
+        params, cfg, V = _lm_setup()
+        fl = ServeFleet(params, cfg,
+                        FleetConfig(replicas=2, transport="tcp",
+                                    backoff_base=0.01, max_restarts=4,
+                                    push_chunk_bytes=16384),
+                        worker_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            _warm(fl)
+            prompts = _lm_prompts(V, 6)
+            reqs = [fl.submit(p, 10) for p in prompts]
+            for _ in range(3):
+                fl.step()
+            fl.arm_fault_plan("transfer:replica=0,at=0s")
+            fl.update_params(params)
+            t0 = time.monotonic()
+            while (not fl.idle or fl.update_active) \
+                    and time.monotonic() - t0 < 120:
+                if not fl.step():
+                    time.sleep(0.005)
+            f = fl.stats()["fleet"]
+            assert f["params_push"]["retries"] == 1, f["params_push"]
+            assert sum(f["transfer_incidents"].values()) == 1, f
+            assert f["incidents_by_class"] == {}, f
+            assert f["params_version"] == 2
+            per = f["per_replica"]
+            assert all(r["version"] == 2 for r in per), per
+            assert len({r["params_sha"] for r in per}) == 1
             for p, r in zip(prompts, reqs):
                 assert r.state == "finished"
                 assert r.output == _lm_ref(params, p, 10)

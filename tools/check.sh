@@ -72,6 +72,20 @@
 #                               redispatched, all requests finish
 #                               redispatch-pin-exact, and zero worker
 #                               processes survive close())
+#   tools/check.sh --no-fleet-update  skip the rolling-update smoke
+#                               (round-15 tentpole: 2 loopback-TCP
+#                               workers — params/config arrive over
+#                               the wire ONLY — with a zero-downtime
+#                               rolling weight update triggered
+#                               mid-traffic whose FIRST push attempt
+#                               is torn mid-transfer; the push
+#                               classifies the tear, resumes from the
+#                               worker's verified offset with EXACTLY
+#                               one transfer retry, both replicas
+#                               digest-verify the new version's
+#                               sha256, zero requests drop or reject,
+#                               greedy streams stay bit-identical to
+#                               the clean run, zero leftover workers)
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -88,6 +102,7 @@ SERVE=1
 FLEET=1
 FLEET_PROC=1
 FLEET_TCP=1
+FLEET_UPDATE=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -98,9 +113,10 @@ for arg in "$@"; do
     --no-fleet) FLEET=0 ;;
     --no-fleet-proc) FLEET_PROC=0 ;;
     --no-fleet-tcp) FLEET_TCP=0 ;;
+    --no-fleet-update) FLEET_UPDATE=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -272,6 +288,58 @@ print("loopback-TCP fleet smoke: partition -> host_down x1, "
     exit 1
   fi
   echo "loopback-TCP fleet smoke: zero surviving worker processes"
+fi
+
+if [[ "$FLEET_UPDATE" == "1" ]]; then
+  echo "== rolling-update smoke (2 loopback-TCP workers, zero-downtime weight roll mid-traffic, torn first push resumed: exactly one transfer retry, digests verified, no zombies) =="
+  PRE_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  FLEETU_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 200 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --fleet 2 --fleet-transport tcp \
+    --fleet-max-restarts 4 --fleet-push-chunk-bytes 16384 \
+    --rolling-update-at 50% \
+    --fault-plan "transfer:replica=0,at=50%" \
+    --pin-exact --require-finished)
+  echo "$FLEETU_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "fleet_fault_ab", s["mode"]
+# zero dropped, zero rejected: the roll is genuinely zero-downtime
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+f = s["fleet"]
+assert f["transport"] == "tcp", f["transport"]
+# the torn first push attempt resolved as EXACTLY one classified
+# transfer retry — never a replica death, never a silent wrong model
+p = f["params_push"]
+assert p["retries"] == 1, p
+assert sum(f["transfer_incidents"].values()) == 1, f["transfer_incidents"]
+assert f["incidents_by_class"] == {}, f["incidents_by_class"]
+# the roll completed: both replicas digest-verified on version 2
+assert f["params_version"] == 2 and not f["update_active"], f
+shas = [r["params_sha"] for r in f["per_replica"]]
+assert all(r["version"] == 2 for r in f["per_replica"]), f["per_replica"]
+assert len(set(shas)) == 1 and shas[0], shas
+assert p["pushes"] == 2 and p["bytes"] > 0 and p["chunks"] > 2, p
+ab = s["fleet_ab"]
+assert ab["redispatch_pin"]["identical"] is True
+assert ab["redispatch_pin"]["compared"] == 8, ab["redispatch_pin"]
+print("rolling-update smoke: torn push -> 1 classified transfer retry "
+      "(%s), resumed + digest-verified, both replicas v2 sha %s..., "
+      "8/8 streams bit-identical, %d chunks/%dB pushed" % (
+          ",".join(f["transfer_incidents"]), shas[0][:12],
+          p["chunks"], p["bytes"]))
+'
+  POST_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  LEAKED=$(comm -13 <(echo "$PRE_WORKERS" | sort) <(echo "$POST_WORKERS" | sort) | tr -d '[:space:]')
+  if [[ -n "$LEAKED" ]]; then
+    echo "rolling-update smoke: ORPHANED worker processes survive:" >&2
+    pgrep -af "horovod_tpu.serve.worker" >&2
+    exit 1
+  fi
+  echo "rolling-update smoke: zero surviving worker processes"
 fi
 
 if [[ "$HIER" == "1" ]]; then

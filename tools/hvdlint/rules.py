@@ -989,6 +989,121 @@ def check_hvd011(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD012
+
+#: numpy artifact savers: ``np.save``/``np.savez``/... writing a
+#: params/checkpoint-shaped file ALWAYS counts as an artifact write.
+NUMPY_MODULE_NAMES = {"np", "numpy", "jnp"}
+NUMPY_SAVER_NAMES = {"save", "savez", "savez_compressed"}
+
+#: Receiver-name markers that make a binary ``open(..., "wb")`` an
+#: ARTIFACT write (ordinary binary writes — logs, sockets dumps — stay
+#: silent unless they look like weights/checkpoints).
+ARTIFACT_NAME_MARKERS = (
+    "param", "weight", "ckpt", "checkpoint", "snapshot", "artifact",
+    "manifest", "model", "npz", "npy", "state_dict",
+)
+
+#: Calls that commit a write atomically (write-to-temp THEN rename).
+COMMIT_CALL_NAMES = {"rename", "replace"}
+
+#: Identifier markers for a digest/checksum discipline in scope.
+DIGEST_NAME_MARKERS = ("sha256", "sha1", "sha512", "md5", "digest",
+                       "checksum", "crc32", "crc", "blake")
+
+
+def _hvd012_artifact_writes(nodes: List[ast.AST]) -> List[Tuple[ast.Call, str]]:
+    out: List[Tuple[ast.Call, str]] = []
+    for call in nodes:
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in NUMPY_SAVER_NAMES \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in NUMPY_MODULE_NAMES:
+            out.append((call, f"{f.value.id}.{f.attr}"))
+            continue
+        if trailing_name(f) != "open" or len(call.args) < 2:
+            continue
+        mode = call.args[1]
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and "b" in mode.value
+                and ("w" in mode.value or "x" in mode.value)):
+            continue
+        target_idents: List[str] = []
+        for n in ast.walk(call.args[0]):
+            if isinstance(n, ast.Name):
+                target_idents.append(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                target_idents.append(n.attr.lower())
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                target_idents.append(n.value.lower())
+        if any(m in t for t in target_idents
+               for m in ARTIFACT_NAME_MARKERS):
+            out.append((call, f"open(.., {mode.value!r})"))
+    return out
+
+
+def check_hvd012(tree: ast.AST) -> List[RawFinding]:
+    """Artifact file written without an atomic-rename commit or digest
+    check in scope — the torn-params-load shape.
+
+    A ``np.savez(path)`` (or a binary ``open(weights_path, "wb")``
+    write) that lands DIRECTLY at its final path is torn the moment
+    the writer crashes, is SIGKILLed, or the disk fills mid-write —
+    and a later load of that path parses the torn prefix into
+    silently wrong weights (numpy containers and raw-bytes blobs both
+    truncate "successfully"). The repo's own disciplines are the
+    fixture negatives: the elastic manifest's two-phase commit
+    (write ``.tmp`` then ``os.replace``) makes a torn write invisible,
+    and the serve/params_wire.py assembler digest-verifies the whole
+    artifact before its atomic rename, so a torn or corrupted file is
+    a typed error, never a load. Flagged: an artifact write (numpy
+    saver, or a binary ``open`` whose target names
+    params/weights/checkpoint/...) in a function with NEITHER a
+    ``rename``/``replace`` commit call NOR a digest identifier
+    (sha256/checksum/crc/...) in scope. Either discipline silences.
+    """
+    findings: List[RawFinding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        nodes = _own_scope_nodes(fn)
+        writes = _hvd012_artifact_writes(nodes)
+        if not writes:
+            continue
+        committed = any(
+            isinstance(n, ast.Call)
+            and trailing_name(n.func) in COMMIT_CALL_NAMES
+            for n in nodes)
+        if committed:
+            continue
+        idents: Set[str] = set()
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                idents.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr)
+            elif isinstance(n, ast.keyword) and n.arg:
+                idents.add(n.arg)
+        if any(m in i.lower() for i in idents
+               for m in DIGEST_NAME_MARKERS):
+            continue
+        for call, label in writes:
+            findings.append(RawFinding(
+                call.lineno, call.col_offset, "HVD012", "error",
+                f"artifact written via {label} with no atomic-rename "
+                "commit and no digest check in scope: a crash (or "
+                "SIGKILL) mid-write leaves a torn file a later load "
+                "parses into silently wrong weights — write to a temp "
+                "path and os.replace() it into place (the elastic "
+                "manifest two-phase commit), or digest-verify before "
+                "load (the serve/params_wire.py assembler discipline)"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -1001,4 +1116,5 @@ RULES = {
     "HVD009": check_hvd009,
     "HVD010": check_hvd010,
     "HVD011": check_hvd011,
+    "HVD012": check_hvd012,
 }

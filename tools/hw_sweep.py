@@ -160,6 +160,28 @@ LANES = [
                             "--fault-plan",
                             "partition:host=0,at=50%,secs=2",
                             "--require-finished"]),
+    # Rolling-update A/B (round-15 tentpole, serve/params_wire.py +
+    # fleet.update_params): the SAME workload through a 2-replica TCP
+    # fleet twice — clean, then with a mid-run ZERO-DOWNTIME rolling
+    # weight update whose FIRST push attempt is torn mid-transfer by
+    # the transfer: fault. The push must classify the tear, back off,
+    # reconnect, and resume from the worker's verified offset (exactly
+    # one transfer retry), both replicas must digest-verify the new
+    # version's sha256, no request may drop or reject, and every
+    # greedy stream stays bit-identical to the clean run (same params
+    # content re-pushed as v2, so the version pin is exercised while
+    # streams stay comparable). serve.fleet stamps params_push
+    # (bytes/chunks/ms/retries/version) on the faulted side — the
+    # record prices what a weight roll costs under live traffic.
+    ("serve_fleet_update_ab", ["tools/serve_bench.py", "--requests",
+                               "64", "--rate", "8", "--new-min", "16",
+                               "--new-max", "256", "--fleet", "2",
+                               "--fleet-transport", "tcp",
+                               "--fleet-max-restarts", "4",
+                               "--rolling-update-at", "50%",
+                               "--fault-plan",
+                               "transfer:replica=0,at=50%",
+                               "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

@@ -194,9 +194,12 @@ def fleet_cell(rec):
     stale-heartbeat time-to-detect, 2 requests shed, faulted-over-clean
     p99 TTFT from the fault A/B. TCP fleets render the ``tcp`` tag plus
     their host count ("2r tcp 1h ... host_down1 ...") — host_down
-    incidents ride the incidents_by_class render. Pre-transport records
-    carry no transport key and render untagged (they were inproc);
-    non-fleet records render as em-dash."""
+    incidents ride the incidents_by_class render. Records whose
+    measured window pushed weights over the wire (a rolling update)
+    append the version/push tag ("v2 push 0.94MB/58ck+1rt" = rolled to
+    params version 2, 0.94 MB in 58 chunks with 1 classified transfer
+    retry). Pre-transport records carry no transport key and render
+    untagged (they were inproc); non-fleet records render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -225,6 +228,13 @@ def fleet_cell(rec):
         cell += f" det {f['detect_s']:g}s"
     if f.get("shed"):
         cell += f" shed{f['shed']}"
+    push = f.get("params_push") or {}
+    if push.get("pushes"):
+        cell += (f" v{push.get('version', '?')} push "
+                 f"{push.get('bytes', 0) / 1e6:.2f}MB/"
+                 f"{push.get('chunks', '?')}ck")
+        if push.get("retries"):
+            cell += f"+{push['retries']}rt"
     ab = s.get("fleet_ab") or {}
     if ab.get("faulted_over_clean_p99_ttft") is not None:
         cell += f" f/c {ab['faulted_over_clean_p99_ttft']:g}"

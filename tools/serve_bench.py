@@ -63,6 +63,19 @@ Modes:
               deterministic injector) and ``serve.fleet`` additionally
               stamps ``hosts``/``host_incidents`` on both A/B sides.
 
+  --rolling-update-at T
+              (fleet only) trigger a mid-run ZERO-DOWNTIME rolling
+              weight update at offset T (seconds or % of the arrival
+              horizon): the fleet re-pushes the same params content as
+              version 2 over the wire — drain → chunked push →
+              digest-verify → readmit, one replica at a time, under
+              live traffic — and the record stamps
+              ``serve.fleet.params_push`` (bytes/chunks/ms/retries/
+              version). A fault-style A/B trigger: the clean lane runs
+              without it. Composes with the ``transfer:``/``corrupt:``
+              fault verbs, which tear or bit-flip the push so the
+              classified-retry + resume-from-offset lane runs in CI.
+
 ``--pin-exact`` re-decodes every finished request through
 ``models.parallel_lm.lm_decode`` and asserts bit-identical greedy
 tokens — the engine/decode-lane exactness gate CI runs on a tiny model
@@ -165,13 +178,20 @@ def run_static(params, cfg, workload, warm=True):
     return eng
 
 
-def run_fleet(params, cfg, fleet_cfg, workload, fault_plan="", warm=True):
+def run_fleet(params, cfg, fleet_cfg, workload, fault_plan="",
+              update_at=None, warm=True):
     """Open-loop Poisson load over a :class:`ServeFleet`; returns the
     drained fleet plus its requests in arrival order (the stable index
     the clean-vs-faulted redispatch pin compares by). ``fault_plan``
     (serving dialect) is armed AFTER warmup so fire offsets are
     measured from the first measured step; percent ``at=`` forms
-    resolve against the last workload arrival."""
+    resolve against the last workload arrival. ``update_at`` (seconds
+    from the measured start, already resolved) triggers a mid-run
+    ZERO-DOWNTIME rolling weight update — the same params content
+    re-pushed as version 2, so streams stay comparable to the clean
+    run while the whole drain → push → digest-verify → readmit roll
+    (plus any armed transfer:/corrupt: push fault) runs under live
+    traffic; the loop runs until the roll completes."""
     from horovod_tpu.serve import ServeFleet
 
     fl = ServeFleet(params, cfg, fleet_cfg)
@@ -191,7 +211,11 @@ def run_fleet(params, cfg, fleet_cfg, workload, fault_plan="", warm=True):
     reqs = []
     t0 = fl.clock()
     fl._t_start = t0
-    while pending or not fl.idle:
+    updated = update_at is None
+    while pending or not fl.idle or not updated or fl.update_active:
+        if not updated and fl.clock() - t0 >= update_at:
+            fl.update_params(params)
+            updated = True
         while pending and pending[0][0] <= fl.clock() - t0:
             arrival, prompt, n = pending.pop(0)
             reqs.append(fl.submit(prompt, n, arrival=t0 + arrival))
@@ -199,7 +223,7 @@ def run_fleet(params, cfg, fleet_cfg, workload, fault_plan="", warm=True):
             if pending:
                 time.sleep(min(0.001, max(0.0, pending[0][0]
                                           - (fl.clock() - t0))))
-            elif not fl.idle:
+            elif not fl.idle or not updated or fl.update_active:
                 time.sleep(0.001)   # stall/backoff: let wall time pass
     return fl, reqs
 
@@ -316,6 +340,24 @@ def main() -> int:
                          "'kill:replica=1,at=40%%'); runs clean THEN "
                          "faulted on the identical workload and pins "
                          "redispatched greedy output bit-identical")
+    ap.add_argument("--rolling-update-at", default="",
+                    help="trigger a mid-run ZERO-DOWNTIME rolling "
+                         "weight update at this offset (seconds, "
+                         "'2.5s', or '50%%' of the arrival horizon) — "
+                         "a fault-style A/B trigger: the clean lane "
+                         "runs without it, the faulted lane rolls the "
+                         "fleet to params version 2 (same content, so "
+                         "streams stay comparable) under live "
+                         "traffic; composes with transfer:/corrupt: "
+                         "push faults. Requires --fleet")
+    ap.add_argument("--fleet-push-chunk-bytes", type=int,
+                    default=1 << 20,
+                    help="params-transfer chunk size (wire "
+                         "transports; small values make the tear/"
+                         "resume lanes multi-chunk)")
+    ap.add_argument("--fleet-push-retries", type=int, default=2,
+                    help="budgeted resume-retries per params push "
+                         "before the replica takes the death path")
     ap.add_argument("--fleet-max-restarts", type=int, default=2,
                     help="fleet-wide replica relaunch budget")
     ap.add_argument("--fleet-watchdog-timeout", type=float, default=0.0,
@@ -353,6 +395,19 @@ def main() -> int:
     if args.fleet_hosts and args.fleet_transport != "tcp":
         ap.error("--fleet-hosts places workers over the network and "
                  "needs --fleet-transport tcp")
+    update_at_s = update_at_frac = None
+    if args.rolling_update_at:
+        if not args.fleet:
+            ap.error("--rolling-update-at rolls a FLEET's weights — "
+                     "it requires --fleet N")
+        from horovod_tpu.elastic.faults import FaultPlanError, _parse_at
+
+        try:
+            update_at_s, update_at_frac = _parse_at(
+                f"--rolling-update-at={args.rolling_update_at}",
+                args.rolling_update_at)
+        except FaultPlanError as e:
+            ap.error(str(e))
     if args.fault_plan:
         from horovod_tpu.elastic.faults import (FaultPlanError,
                                                 parse_serve_fault_plan)
@@ -382,6 +437,12 @@ def main() -> int:
             ap.error("stall: fault plans need --fleet-watchdog-timeout "
                      "> 0 — an unwatched stall hangs the lane forever "
                      "(which is the bug the watchdog exists to class)")
+        for a in plan_actions:
+            if a.kind in ("transfer", "corrupt") and \
+                    args.fleet_transport == "inproc":
+                ap.error(f"fault action {a}: {a.kind} faults address "
+                         "the params-push wire — use --fleet-transport "
+                         "process or tcp")
 
     from horovod_tpu.serve import ServeConfig
 
@@ -434,13 +495,21 @@ def main() -> int:
                 watchdog_timeout=args.fleet_watchdog_timeout,
                 transport=args.fleet_transport,
                 rpc_deadline=args.fleet_rpc_deadline,
+                push_chunk_bytes=args.fleet_push_chunk_bytes,
+                push_retries=args.fleet_push_retries,
                 hosts=hosts)
         except ValueError as e:
             ap.error(str(e))
 
-        def fleet_lane(tag, fault_plan=""):
+        horizon = max(w[0] for w in workload)
+        update_at = None
+        if args.rolling_update_at:
+            update_at = (update_at_s if update_at_s is not None
+                         else update_at_frac * horizon)
+
+        def fleet_lane(tag, fault_plan="", update=None):
             fl, reqs = run_fleet(params, cfg, fleet_cfg, workload,
-                                 fault_plan)
+                                 fault_plan, update_at=update)
             try:
                 stats = fl.stats()
                 f = stats["fleet"]
@@ -457,7 +526,15 @@ def main() -> int:
                          if f.get("host_incidents") else "")
                       + (f" rpc p50/p99 {f['rpc_ms']['p50']}/"
                          f"{f['rpc_ms']['p99']} ms"
-                         if f.get("rpc_ms") else ""),
+                         if f.get("rpc_ms") else "")
+                      + ((lambda p: f", params v{p['version']}: "
+                          f"{p['pushes']} push(es) {p['bytes']}B/"
+                          f"{p['chunks']}ck in {p['ms']:.1f}ms, "
+                          f"{p['retries']} transfer retr"
+                          + ("y" if p["retries"] == 1 else "ies"))
+                         (f["params_push"])
+                         if (f.get("params_push") or {}).get("pushes")
+                         else ""),
                       file=sys.stderr, flush=True)
                 if args.pin_exact:
                     pin_exact(params, fl)
@@ -474,10 +551,14 @@ def main() -> int:
             return stats, reqs
 
         clean, clean_reqs = fleet_lane(f"fleet x{args.fleet} clean")
-        if args.fault_plan:
+        if args.fault_plan or update_at is not None:
+            faulted_tag = f"fleet x{args.fleet} faulted"
+            if args.fault_plan:
+                faulted_tag += f" [{args.fault_plan}]"
+            if update_at is not None:
+                faulted_tag += f" [rolling update at {update_at:.2f}s]"
             faulted, faulted_reqs = fleet_lane(
-                f"fleet x{args.fleet} faulted [{args.fault_plan}]",
-                args.fault_plan)
+                faulted_tag, args.fault_plan, update=update_at)
             compared = pin_redispatch_exact(clean_reqs, faulted_reqs)
             print(f"[serve_bench] redispatch pin: {compared} greedy "
                   "streams bit-identical clean vs faulted",
@@ -488,7 +569,8 @@ def main() -> int:
             mode, headline = "fleet_fault_ab", faulted
             serve = dict(faulted, mode=mode, fleet_ab={
                 "clean": clean,
-                "fault_plan": args.fault_plan,
+                "fault_plan": args.fault_plan or None,
+                "rolling_update_at": args.rolling_update_at or None,
                 "redispatch_pin": {"compared": compared,
                                    "identical": True},
                 "p99_ttft_clean_ms": c99,
@@ -559,6 +641,9 @@ def main() -> int:
                 "max_queue": args.fleet_max_queue,
                 "backoff_base": args.fleet_backoff,
                 "fault_plan": args.fault_plan or None,
+                "rolling_update_at": args.rolling_update_at or None,
+                "push_chunk_bytes": args.fleet_push_chunk_bytes,
+                "push_retries": args.fleet_push_retries,
             } if args.fleet else None),
         },
     }), flush=True)
