@@ -18,6 +18,17 @@ steps without ever recompiling.
 * :mod:`~horovod_tpu.serve.scheduler` — request lifecycle
   (queued → prefill → decode → finished/evicted) and the SLO-knobbed
   scheduler (FCFS vs shortest-prompt-first, latency-vs-throughput);
+* :mod:`~horovod_tpu.serve.prefix` — copy-on-write prefix caching
+  (``ServeConfig.prefix_caching``): a radix-tree index over
+  page-aligned token chunks maps a prompt to the longest chain of
+  already-filled pages; admission maps them read-only into the new
+  request's table (refcounted sharing in the allocator — retain/
+  release; shared pages never re-enter the free list, never become
+  eviction victims), prefill starts at the first miss, any write to a
+  shared page copies-on-write first, and the fleet router rendezvous-
+  hashes the normalized prefix so prefix-mates land on the replica
+  already holding the pages — one cold prefill per unique prefix per
+  replica, hit streams bit-identical to the cold path;
 * :mod:`~horovod_tpu.serve.sampling` — vectorized per-slot sampling;
 * :mod:`~horovod_tpu.serve.metrics` — TTFT / per-token latency /
   page-occupancy accounting for the bench lane
@@ -63,6 +74,8 @@ from horovod_tpu.serve.fleet import (ProcessReplica, Replica, ServeFleet,
                                      TcpReplica)
 from horovod_tpu.serve.netfault import FaultableSocket, NetFaults
 from horovod_tpu.serve.kvcache import OutOfPages, PageAllocator, PagedKVCache
+from horovod_tpu.serve.prefix import (PrefixIndex, aligned_prefix_len,
+                                      prefix_route_key, rendezvous_rank)
 from horovod_tpu.serve.scheduler import Request, RequestState, Scheduler
 from horovod_tpu.serve.transport import (ChecksumError, ConnectionLost,
                                          DeadlineExceeded, FrameError,
@@ -79,6 +92,7 @@ __all__ = [
     "OutOfPages",
     "PageAllocator",
     "PagedKVCache",
+    "PrefixIndex",
     "ProcessReplica",
     "RemoteCallError",
     "Replica",
@@ -90,4 +104,7 @@ __all__ = [
     "ServeFleet",
     "TcpReplica",
     "TransportError",
+    "aligned_prefix_len",
+    "prefix_route_key",
+    "rendezvous_rank",
 ]

@@ -114,6 +114,15 @@ class ServeConfig:
     paged_attention_decode`; docs/serving.md "The paged-attention
     decode kernel"). Greedy token streams are bit-identical either
     way; the prefill lane keeps the full gather in both modes.
+
+    ``prefix_caching`` turns on the copy-on-write prefix cache
+    (:mod:`horovod_tpu.serve.prefix`; docs/serving.md "Prefix
+    caching"): admission maps a prompt's longest chain of
+    already-filled pages into the request's table read-only
+    (refcounted sharing — ``kvcache.PageAllocator.retain``), prefill
+    starts at the first miss, and the admission math counts only the
+    MISSED pages. Off by default: the cold path is the exactness
+    reference, and hit streams are pinned bit-identical to it.
     """
 
     page_size: int = 16
@@ -125,6 +134,9 @@ class ServeConfig:
     slo: str = "balanced"
     admission: str = "reserve"
     attention: str = "gather"
+    #: Copy-on-write prefix caching (serve/prefix.py). Off = seed
+    #: behavior: every request pays a full cold prefill.
+    prefix_caching: bool = False
     eos_token: Optional[int] = None
     max_queue: int = 0          # 0 = unbounded
     requeue_evicted: bool = True

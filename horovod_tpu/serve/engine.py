@@ -257,7 +257,21 @@ class ServeEngine:
         self.chips = chips
         self.clock = clock
         self.cache = PagedKVCache(params, config)
-        self.scheduler = Scheduler(self.cache, config)
+        if config.prefix_caching:
+            from horovod_tpu.serve.prefix import PrefixIndex
+
+            #: Radix prefix index (serve/prefix.py) — admission maps a
+            #: prompt's already-filled pages read-only, prefill starts
+            #: at the first miss.
+            self.prefix = PrefixIndex(self.cache.allocator,
+                                      config.page_size)
+        else:
+            self.prefix = None
+        self.scheduler = Scheduler(self.cache, config,
+                                   prefix=self.prefix)
+        #: Copy-on-write page copies performed (the backstop — 0 in
+        #: normal operation; see :meth:`_cow_guard`).
+        self.cow_copies = 0
         self.slots: List[Optional[Request]] = [None] * config.decode_slots
         self.ready: List[Request] = []      # prefilled, awaiting a slot
         self.prefilling: Optional[Request] = None
@@ -381,7 +395,14 @@ class ServeEngine:
     def _evict_for(self, requester: Request) -> bool:
         """Lazy-mode page pressure: evict the newest-admitted request
         that is not the requester (and not mid-prefill-chunk). False =
-        nothing else to evict; the caller evicts the requester."""
+        nothing else to evict; the caller evicts the requester.
+        Prefix-index-only holds go FIRST — reclaiming a cold cached
+        prefix costs a future re-prefill, evicting a live request
+        costs a certain recompute — and shared pages are never victims
+        either way (a victim's release only frees its exclusively-held
+        pages; the refcounted path keeps the rest alive)."""
+        if self.prefix is not None and self.prefix.reclaim(1):
+            return True
         candidates = [s for s in self.slots if s is not None] + \
             list(self.ready)
         victim = pick_victim(candidates, requester)
@@ -419,6 +440,39 @@ class ServeEngine:
             if not self.scheduler.ensure_pages(req, last,
                                                self._evict_for):
                 self._do_evict(req)
+
+    def _cow_guard(self) -> None:
+        """Copy-on-write backstop: no page this step WRITES may be
+        shared. By construction it never is — only FULL prompt pages
+        are indexable, a match never covers the whole prompt, and both
+        prefill (positions >= prefill_pos = matched tokens) and decode
+        (positions >= prompt_len) write past every shared slot — so
+        this sweep finds nothing in normal operation. It stays because
+        a shared write would silently corrupt every OTHER holder's
+        stream: any slip in the invariant becomes one counted page
+        copy (``cow_copies``) instead of a wrong token."""
+        if self.prefix is None:
+            return
+        for req in self.slots:
+            if req is not None and req.generated:
+                self._cow_range(req, req.next_pos, req.next_pos)
+        if self.prefilling is not None:
+            req = self.prefilling
+            chunk = min(self.config.prefill_chunk,
+                        req.prompt_len - req.prefill_pos)
+            self._cow_range(req, req.prefill_pos,
+                            req.prefill_pos + chunk - 1)
+
+    def _cow_range(self, req: Request, first_pos: int, last_pos: int
+                   ) -> None:
+        ps = self.config.page_size
+        for slot in range(first_pos // ps, last_pos // ps + 1):
+            page = int(req.page_table[slot])
+            if page and self.cache.allocator.is_shared(page):
+                new = self.cache.cow_page(page)
+                req.page_table[slot] = new
+                req.pages[req.pages.index(page)] = new
+                self.cow_copies += 1
 
     def _build_dec(self):
         S = self.config.decode_slots
@@ -484,6 +538,7 @@ class ServeEngine:
                 all(s is None for s in self.slots):
             return False
 
+        self._cow_guard()
         dec = self._build_dec()
         pre, chunk = self._build_pre()
         # Static traffic accounting for this step's decode lane (live
@@ -541,6 +596,12 @@ class ServeEngine:
             req = self.prefilling
             req.prefill_pos += chunk
             if pre_done:
+                if self.prefix is not None:
+                    # Index the now-filled prompt pages BEFORE the
+                    # first token can finish the request (max_new=1 —
+                    # _finish releases its pages; the insert's retain
+                    # must land while the request still holds them).
+                    self.prefix.insert(req.prompt, req.page_table)
                 self._accept_token(req, int(tokens[S]), now)
                 self.prefilling = None
                 if req.state != RequestState.FINISHED:
@@ -580,6 +641,10 @@ class ServeEngine:
                 "geometry change needs a fresh engine, not a weight "
                 "swap")
         self.params = params
+        if self.prefix is not None:
+            # K/V rows are a function of the weights: stale-version
+            # pages must never serve a new-version request.
+            self.prefix.flush()
 
     # ------------------------------------------------------------- run
 
@@ -606,6 +671,9 @@ class ServeEngine:
         self.occupancy_samples = []
         self.attn_len_samples = []
         self.steps = 0
+        self.cow_copies = 0
+        if self.prefix is not None:
+            self.prefix.reset_metrics()
         self._t_start = self.clock()
 
     def stats(self) -> Dict:
@@ -620,7 +688,26 @@ class ServeEngine:
         out = summarize(everything, self.clock() - self._t_start,
                         self.chips, self.occupancy_samples)
         out["attention"] = self.attention_stats()
+        ps = self.prefix_stats()
+        if ps is not None:
+            out["prefix"] = ps
         return out
+
+    def prefix_stats(self) -> Optional[Dict]:
+        """Prefix-cache accounting over the run (None when the cache
+        is off — consumers must tolerate the key's absence: pre-prefix
+        engines and stub workers never stamp it). ``hit_rate`` is
+        hits over ADMITTED requests; ``prefill_tokens_saved`` the
+        prompt tokens whose prefill compute a hit skipped."""
+        if self.prefix is None:
+            return None
+        s = self.prefix.stats()
+        s["hit_rate"] = (round(s["hits"] / s["lookups"], 4)
+                         if s["lookups"] else None)
+        s["prefill_tokens_saved"] = s["tokens_hit"]
+        s["cow_copies"] = self.cow_copies
+        s["pages_shared_now"] = self.cache.allocator.shared
+        return s
 
     def step_grid_info(self, lengths: List[int]) -> Dict:
         """One step's static decode-traffic accounting — exactly

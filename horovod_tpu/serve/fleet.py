@@ -413,6 +413,16 @@ class _EngineProxy:
         #: rid -> worker-output tokens already applied to the mirror.
         self._streamed: Dict[int, int] = {}
         self._by_rid: Dict[int, Request] = {}
+        #: Last step RPC's prefix-cache snapshot (None: caching off,
+        #: or a worker — e.g. the protocol stub — that never stamps
+        #: it; every consumer tolerates the absence).
+        self.last_prefix: Optional[Dict] = None
+        #: rid -> (hit_tokens, hit_pages) last seen from THIS worker
+        #: incarnation. Worker counters restart at 0 per incarnation
+        #: while the router mirror is cumulative across redispatches
+        #: (the drain baseline depends on it) — so stamps apply as
+        #: deltas, never overwrites.
+        self._prefix_seen: Dict[int, tuple] = {}
 
     def _free_slots(self) -> int:
         return self._free
@@ -433,6 +443,7 @@ class _EngineProxy:
         if r.get("accepted"):
             self._streamed[req.rid] = 0
             self._by_rid[req.rid] = req
+            self._prefix_seen[req.rid] = (0, 0)
             req.state = RequestState.QUEUED
             if req.t_admit is None:
                 req.t_admit = now
@@ -458,6 +469,8 @@ class _EngineProxy:
         self._in_flight = int(s["in_flight"])
         if s.get("hb") is not None:
             self.last_hb = int(s["hb"])
+        if s.get("prefix") is not None:
+            self.last_prefix = s["prefix"]
         stepped = int(s["ticks"]) > self._last_ticks
         self._last_ticks = int(s["ticks"])
         if not self._by_rid:
@@ -478,6 +491,7 @@ class _EngineProxy:
                 continue
             self._apply_tokens(req, pr.get("tokens") or [], now)
             req.prefill_pos = int(pr.get("prefill_pos", req.prefill_pos))
+            self._apply_prefix(req, pr)
         for ev in c.get("events", ()):
             rid = int(ev["rid"])
             req = self._by_rid.pop(rid, None)
@@ -487,6 +501,8 @@ class _EngineProxy:
             self._apply_tokens(req, ev.get("output", [])[done:], now)
             req.prefill_pos = int(ev.get("prefill_pos", 0))
             req.evictions = int(ev.get("evictions", req.evictions))
+            self._apply_prefix(req, ev)
+            self._prefix_seen.pop(rid, None)
             req.state = ev["state"]
             if req.state == RequestState.REJECTED:
                 req.reject_reason = ev.get("reject_reason")
@@ -501,6 +517,20 @@ class _EngineProxy:
                 req.t_finish = now
                 self.finished.append(req)
         return stepped
+
+    def _apply_prefix(self, req: Request, payload: Dict) -> None:
+        """Fold one progress/terminal payload's prefix stamps into the
+        mirror as DELTAS against what this incarnation already
+        reported (see ``_prefix_seen``). Payloads without the keys —
+        stub workers, pre-prefix workers — apply nothing."""
+        if "prefix_hit_tokens" not in payload:
+            return
+        seen_t, seen_p = self._prefix_seen.get(req.rid, (0, 0))
+        wt = int(payload["prefix_hit_tokens"])
+        wp = int(payload.get("prefix_hit_pages", seen_p))
+        req.prefix_hit_tokens += max(0, wt - seen_t)
+        req.prefix_hit_pages += max(0, wp - seen_p)
+        self._prefix_seen[req.rid] = (wt, wp)
 
     def _apply_tokens(self, req: Request, tokens, now: float) -> None:
         if not tokens:
@@ -581,6 +611,10 @@ class ServeFleet:
         self.incidents_by_class: Dict[str, int] = {}
         self.redispatched_total = 0
         self.tokens_recomputed_total = 0
+        #: Drain-time recompute tokens the surviving replica's prefix
+        #: cache actually SKIPPED (banked per completed redispatch
+        #: cycle; the live remainder is computed in stats()).
+        self.redispatch_prefix_saved = 0
         self.shed_total = 0
         self.restarts_used = 0
 
@@ -1516,6 +1550,15 @@ class ServeFleet:
             req.pages = []
             req.page_table = None
             recomputed += req.prefill_pos + len(req.generated)
+            # Redispatch-meets-prefix bookkeeping: `recomputed` is the
+            # honest PESSIMISTIC count at detection time; hits the
+            # survivor's prefix cache lands past this snapshot are
+            # tokens never actually recomputed, and stats() nets them
+            # out. A re-drain first banks the previous cycle's gains.
+            if req.prefix_hits_at_drain is not None:
+                self.redispatch_prefix_saved += max(
+                    0, req.prefix_hit_tokens - req.prefix_hits_at_drain)
+            req.prefix_hits_at_drain = req.prefix_hit_tokens
             if rebase_for_recompute(req):
                 req.state = RequestState.QUEUED
                 req.requeued = True
@@ -1638,10 +1681,22 @@ class ServeFleet:
                 and not any(r.healthy and r.version == req.version
                             for r in self.replicas))
 
+    def _route_key(self, req: Request) -> Optional[str]:
+        """The request's prefix-affinity key (None = no affinity /
+        prefix caching off). First-chunk hashing makes the key stable
+        under :func:`rebase_for_recompute` — a redispatched request
+        rendezvouses onto the same survivor as its prefix-mates."""
+        if not self.config.prefix_caching:
+            return None
+        from horovod_tpu.serve.prefix import prefix_route_key
+
+        return prefix_route_key(req.prompt, self.config.page_size)
+
     def _dispatch(self) -> None:
         while self.queue:
             req = self.queue[0]
-            rep = pick_replica(self.replicas, req)
+            rep = pick_replica(self.replicas, req,
+                               self._route_key(req))
             if rep is None:
                 if self._version_stranded(req):
                     # The explicit cross-version policy: the stream
@@ -1686,6 +1741,7 @@ class ServeFleet:
                     self.shed_total += 1
                 continue
             rep.assigned.append(req)
+            req.replica = rep.id
             if req.version is None:
                 # First dispatch pins the request's ENTIRE decode to
                 # this replica's params version — redispatch may only
@@ -1862,6 +1918,7 @@ class ServeFleet:
         self.incidents_by_class = {}
         self.redispatched_total = 0
         self.tokens_recomputed_total = 0
+        self.redispatch_prefix_saved = 0
         self.shed_total = 0
         self.occupancy_samples = []
         self.steps = 0
@@ -1915,6 +1972,35 @@ class ServeFleet:
                 "p50": round(percentile(s, 50), 4) if s else None,
                 "p99": round(percentile(s, 99), 4) if s else None,
             }
+        # Fleet-level prefix accounting off ROUTER bookkeeping (the
+        # per-request stamps), so one code path covers every transport
+        # — inproc engines and wire workers alike. ``tokens_saved``
+        # that landed PAST a drain baseline were part of the
+        # pessimistic drain-time recompute count and net out of the
+        # reported ``tokens_recomputed``.
+        prefix_block = None
+        recomputed_net = self.tokens_recomputed_total
+        if self.config.prefix_caching:
+            admitted = [r for r in everything if r.t_admit is not None]
+            hits = sum(1 for r in admitted if r.prefix_hit_tokens > 0)
+            live_saved = sum(
+                max(0, r.prefix_hit_tokens - r.prefix_hits_at_drain)
+                for r in everything
+                if r.prefix_hits_at_drain is not None)
+            redispatch_saved = self.redispatch_prefix_saved + live_saved
+            prefix_block = {
+                "requests": len(admitted),
+                "hits": hits,
+                "hit_rate": round(hits / len(admitted), 4)
+                if admitted else None,
+                "prefill_tokens_saved": sum(
+                    r.prefix_hit_tokens for r in admitted),
+                "pages_shared": sum(
+                    r.prefix_hit_pages for r in admitted),
+                "redispatch_tokens_saved": redispatch_saved,
+            }
+            recomputed_net = max(
+                0, self.tokens_recomputed_total - redispatch_saved)
         out["fleet"] = {
             "replicas": len(self.replicas),
             "transport": self.fleet.transport,
@@ -1936,7 +2022,12 @@ class ServeFleet:
                           if r.state == "failed"),
             "queued": len(self.queue),
             "redispatched": self.redispatched_total,
-            "tokens_recomputed": self.tokens_recomputed_total,
+            "tokens_recomputed": recomputed_net,
+            # the pessimistic drain-time count, before netting out the
+            # survivors' prefix hits (equal unless prefix caching is on
+            # and a redispatched request re-matched on its survivor)
+            "tokens_recomputed_raw": self.tokens_recomputed_total,
+            "prefix": prefix_block,
             "shed": self.shed_total,
             "rejected_by_reason": by_reason,
             "timeout": len(self.timed_out),

@@ -18,6 +18,19 @@ never fragment externally — exhaustion, not fragmentation, is the
 failure mode, and admission control (reserve worst case up front) or
 eviction (lazy mode) handles it; tests/test_serve_kvcache.py property-
 tests the invariants.
+
+Pages are REFCOUNTED so prefix caching (``serve/prefix.py``, SGLang's
+RadixAttention idea) can map one filled page read-only into many
+requests' page tables: :meth:`PageAllocator.retain` adds a holder,
+:meth:`PageAllocator.release` drops one and returns the page to the
+free list only at refcount zero — a shared page can never re-enter the
+free list while any holder remains. Everything outside this module
+releases through the refcounted path; a direct :meth:`PageAllocator.
+free` elsewhere is lint rule HVD013 (it would double-free under
+sharing). Writes to a shared page copy-on-write first
+(:meth:`PagedKVCache.cow_page`) — a page copy + table swap, cheap
+because the engine already threads pages functionally and never
+donates.
 """
 
 from __future__ import annotations
@@ -82,6 +95,14 @@ class PageAllocator:
     (LIFO — recently-freed pages are re-used first, which keeps the
     working set of physical pages small). ``alloc`` is all-or-nothing:
     either the full grant or :class:`OutOfPages` with no state change.
+
+    Every held page carries a REFCOUNT (1 at grant): ``retain`` adds a
+    holder (a prefix-cache hit mapping the page into another request's
+    table), ``release`` drops one and frees only at zero. ``free`` is
+    the strict single-holder teardown — it refuses shared pages, which
+    is what makes a stray direct free under sharing loud instead of a
+    corruption (and why callers outside kvcache.py must use ``release``
+    — lint rule HVD013).
     """
 
     def __init__(self, num_pages: int, reserved: int = 1):
@@ -93,6 +114,7 @@ class PageAllocator:
         self.reserved = reserved
         self._free: List[int] = list(range(num_pages - 1, reserved - 1, -1))
         self._held: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def capacity(self) -> int:
@@ -106,6 +128,19 @@ class PageAllocator:
     def in_use(self) -> int:
         return len(self._held)
 
+    @property
+    def shared(self) -> int:
+        """Pages currently held by MORE than one holder."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        """Holders of ``page`` (0 if not allocated)."""
+        return self._refs.get(page, 0)
+
+    def is_shared(self, page: int) -> bool:
+        """Whether a write to ``page`` must copy-on-write first."""
+        return self._refs.get(page, 0) > 1
+
     def alloc(self, n: int) -> List[int]:
         if n < 0:
             raise ValueError(f"alloc({n})")
@@ -115,14 +150,57 @@ class PageAllocator:
                 f"(capacity {self.capacity})")
         grant = [self._free.pop() for _ in range(n)]
         self._held.update(grant)
+        for p in grant:
+            self._refs[p] = 1
         return grant
 
+    def retain(self, pages: Sequence[int]) -> None:
+        """Add one holder to each (already-allocated) page — the
+        prefix-cache hit path mapping filled pages into a new request's
+        table read-only. All-or-nothing: an unallocated page raises
+        with no state change."""
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"retain of page {p} which is not allocated "
+                    "(a prefix hit can only share live pages)")
+        for p in pages:
+            self._refs[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one holder from each page; a page returns to the free
+        list only when its LAST holder releases — a shared page can
+        never re-enter the free list while refcount > 0. The ONLY
+        page-teardown path callers outside this module may use
+        (HVD013)."""
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"release of page {p} which is not allocated "
+                    "(double release, or a reserved/null page id)")
+            self._refs[p] -= 1
+            if self._refs[p] <= 0:
+                del self._refs[p]
+                self._held.discard(p)
+                self._free.append(p)
+
     def free(self, pages: Sequence[int]) -> None:
+        """Strict single-holder teardown: refuses shared pages (a
+        direct free under sharing would yank a page other holders'
+        tables still map — exactly the bug class refcounts exist to
+        prevent)."""
         for p in pages:
             if p not in self._held:
                 raise ValueError(
                     f"free of page {p} which is not allocated (double "
                     "free, or a reserved/null page id)")
+            if self._refs.get(p, 0) > 1:
+                raise ValueError(
+                    f"free of page {p} with refcount "
+                    f"{self._refs[p]} — shared pages must go through "
+                    "release() so remaining holders keep the page")
+        for p in pages:
+            del self._refs[p]
             self._held.discard(p)
             self._free.append(p)
 
@@ -172,6 +250,28 @@ class PagedKVCache:
         self.allocator = PageAllocator(config.num_pages,
                                        reserved=RESERVED_NULL_PAGES)
 
+    # -------------------------------------------------- copy-on-write
+
+    def cow_page(self, page: int) -> int:
+        """Copy-on-write: allocate a fresh page, copy ``page``'s K/V
+        contents into it across every layer, drop one holder from the
+        original, and return the new (exclusively-held) page id. The
+        caller swaps its page-table entry to the returned id. A page
+        copy + table swap is the WHOLE cost because the engine threads
+        pages functionally and never donates — the original stays
+        readable under any in-flight step. Raises :class:`OutOfPages`
+        (no state change) when no page is free."""
+        (new,) = self.allocator.alloc(1)
+        try:
+            for layer in self.pages:
+                for kv in ("k", "v"):
+                    layer[kv] = layer[kv].at[new].set(layer[kv][page])
+        except BaseException:
+            self.allocator.free([new])
+            raise
+        self.allocator.release([page])
+        return new
+
     # ------------------------------------------------------- page math
 
     def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
@@ -207,5 +307,6 @@ class PagedKVCache:
             "pages_total": self.allocator.capacity,
             "pages_in_use": self.allocator.in_use,
             "pages_free": self.allocator.available,
+            "pages_shared": self.allocator.shared,
             "occupancy": self.occupancy(),
         }

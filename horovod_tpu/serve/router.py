@@ -20,6 +20,17 @@ Routing is least-loaded with a deliberate key order:
 4. **replica id** — total order, so routing is deterministic for the
    bit-exactness pins.
 
+With prefix caching on, a request carrying a route key (the stable
+hash of its page-aligned prompt prefix — :func:`~horovod_tpu.serve.
+prefix.prefix_route_key`) ranks its **rendezvous weight** (desc)
+AHEAD of all four: requests sharing a prefix land on the replica that
+already holds its pages — one cold prefill per unique prefix per
+REPLICA — and the load keys only break exact-weight ties.
+Highest-random-weight hashing keeps the affinity stateless and
+deterministic: when the prefix's home replica dies (or is saturated —
+it simply drops out of the eligible set), the next-ranked survivor
+becomes the home, with no routing table to migrate.
+
 A replica is only *eligible* when healthy and when the request fits
 under its in-flight limit right now — the router holds backlog at the
 FLEET level (one queue to shed from, cheaper redispatch, better
@@ -80,21 +91,31 @@ def eligible(rep, req) -> bool:
     return not c.max_queue or len(eng.scheduler.queue) < c.max_queue
 
 
-def pick_replica(replicas: Sequence, req) -> Optional[object]:
+def pick_replica(replicas: Sequence, req,
+                 route_key: Optional[str] = None) -> Optional[object]:
     """The least-loaded eligible replica for ``req`` (None = every
     replica is down or saturated; the fleet queue's head WAITS — no
     skip — preserving arrival order the same way the scheduler's
-    reserve admission does)."""
+    reserve admission does). ``route_key`` (prefix caching on, prompt
+    at least one full page) ranks the rendezvous weight ahead of the
+    load keys — see the module docstring's key-order rationale."""
+    from horovod_tpu.serve.prefix import rendezvous_rank
+
     candidates = [r for r in replicas if eligible(r, req)]
     if not candidates:
         return None
     loads = {r.id: replica_load(r) for r in candidates}
+
+    def load_key(r):
+        return (-loads[r.id]["free_slots"],
+                loads[r.id]["occupancy"],
+                loads[r.id]["in_flight"],
+                r.id)
+
+    if route_key is None:
+        return min(candidates, key=load_key)
     return min(candidates, key=lambda r: (
-        -loads[r.id]["free_slots"],
-        loads[r.id]["occupancy"],
-        loads[r.id]["in_flight"],
-        r.id,
-    ))
+        (-rendezvous_rank(route_key, r.id),) + load_key(r)))
 
 
 def retry_after_hint(backlog: int, healthy_slots: int,

@@ -95,6 +95,11 @@ class Request:                     # tracked by `is` in slot lists
     #: redispatched to a survivor (fleet bookkeeping; eviction-recompute
     #: within one engine counts in ``evictions``).
     redispatches: int = 0
+    #: fleet placement: the replica currently (or last) serving this
+    #: request, stamped at every dispatch (None = single engine or
+    #: never dispatched). The ``--ab-prefix`` bench reads it to pin
+    #: "one cold prefill per unique prefix per REPLICA".
+    replica: Optional[int] = None
     #: params version this request's ENTIRE decode is pinned to (fleet
     #: bookkeeping, stamped at first dispatch). A redispatch rebases
     #: only onto a same-version replica; when that version can never
@@ -104,6 +109,19 @@ class Request:                     # tracked by `is` in slot lists
     #: times this request restarted from its original prompt under a
     #: newer params version (the explicit cross-version policy).
     version_restarts: int = 0
+    #: prompt tokens skipped via prefix-cache hits, cumulative across
+    #: re-admissions (eviction-requeue AND dead-replica redispatch both
+    #: re-match on the next replica — the redispatch-meets-prefix
+    #: accounting reads this to shrink ``tokens_recomputed``).
+    prefix_hit_tokens: int = 0
+    #: shared pages mapped via prefix-cache hits (same cumulation).
+    prefix_hit_pages: int = 0
+    #: ``prefix_hit_tokens`` snapshot taken when a dead replica's
+    #: drain requeued this request (None = never drained). Hits gained
+    #: PAST the snapshot happened on the survivor — the portion of the
+    #: pessimistic drain-time ``tokens_recomputed`` that was never
+    #: actually recomputed.
+    prefix_hits_at_drain: Optional[int] = None
 
     state: str = RequestState.QUEUED
     #: prompt tokens already prefilled (chunk progress).
@@ -187,9 +205,14 @@ class Scheduler:
     """Queue + admission + the prefill gate over one
     :class:`~horovod_tpu.serve.kvcache.PagedKVCache`."""
 
-    def __init__(self, cache: PagedKVCache, config: ServeConfig):
+    def __init__(self, cache: PagedKVCache, config: ServeConfig,
+                 prefix=None):
         self.cache = cache
         self.config = config
+        #: Optional :class:`~horovod_tpu.serve.prefix.PrefixIndex` —
+        #: when set, admission maps a prompt's matched pages read-only
+        #: (retain) and counts/allocates only the MISSED pages.
+        self.prefix = prefix
         self.queue: List[Request] = []
         self.rejected: List[Request] = []
 
@@ -282,21 +305,49 @@ class Scheduler:
         c = self.config
         if req.page_table is None:
             req.page_table = np.zeros(self.cache.pages_per_seq, np.int32)
+        # Prefix-cache probe: the longest chain of already-filled pages
+        # for this prompt. Pure lookup — pages are retained only once
+        # the admission is known to stick (the waiting queue head
+        # re-probes every step; a failed try must not leak holders).
+        hit, matched = [], 0
+        if self.prefix is not None:
+            hit, matched = self.prefix.match(req.prompt)
+        alloc = self.cache.allocator
         if c.admission == "reserve":
             need = self.cache.pages_needed(req.prompt_len,
                                            req.max_new_tokens)
-            if need > self.cache.allocator.available:
+            if need - len(hit) > alloc.available and \
+                    self.prefix is not None:
+                # Index-only holds are the lowest-priority pages:
+                # reclaim cold leaves before making the head wait —
+                # then RE-match, since a reclaimed leaf could have
+                # been part of this very chain.
+                self.prefix.reclaim(need - len(hit) - alloc.available)
+                hit, matched = self.prefix.match(req.prompt)
+            if need - len(hit) > alloc.available:
                 return False
-            grant = self.cache.allocator.alloc(need)
-            req.pages.extend(grant)
-            req.page_table[:need] = np.asarray(grant, np.int32)
-            return True
-        # lazy: start with the first page only; grow via ensure_pages.
-        if self.cache.allocator.available < 1:
-            return False
-        grant = self.cache.allocator.alloc(1)
+            grant = alloc.alloc(need - len(hit))
+        else:
+            # lazy: map the hits plus the FIRST missed page only; grow
+            # via ensure_pages.
+            if alloc.available < 1 and self.prefix is not None:
+                self.prefix.reclaim(1)
+                hit, matched = self.prefix.match(req.prompt)
+            if alloc.available < 1:
+                return False
+            grant = alloc.alloc(1)
+        if hit:
+            alloc.retain(hit)
+            req.pages.extend(hit)
+            req.page_table[:len(hit)] = np.asarray(hit, np.int32)
+            req.prefill_pos = matched
+            req.prefix_hit_tokens += matched
+            req.prefix_hit_pages += len(hit)
         req.pages.extend(grant)
-        req.page_table[0] = grant[0]
+        req.page_table[len(hit):len(hit) + len(grant)] = \
+            np.asarray(grant, np.int32)
+        if self.prefix is not None:
+            self.prefix.note_admission(len(hit), matched)
         return True
 
     def ensure_pages(self, req: Request, last_pos: int,
@@ -325,9 +376,13 @@ class Scheduler:
     # -------------------------------------------------------- release
 
     def release(self, req: Request) -> None:
-        """Free every page the request holds (finish OR evict)."""
+        """Drop the request's hold on every page it maps (finish OR
+        evict) — through the REFCOUNTED path, so a page the prefix
+        index (or another request) still holds stays alive and only
+        exclusively-held pages return to the free list (HVD013: the
+        strict ``free()`` is kvcache-internal)."""
         if req.pages:
-            self.cache.allocator.free(req.pages)
+            self.cache.allocator.release(req.pages)
             req.pages = []
         if req.page_table is not None:
             req.page_table[:] = 0

@@ -245,6 +245,14 @@ class WorkerHost:
             "prefill_pos": int(req.prefill_pos),
             "generated_len": len(req.generated),
             "evictions": int(req.evictions),
+            # Prefix-cache stamps (0 when caching is off) — the router
+            # mirror needs them for the redispatch-meets-prefix
+            # accounting; readers must tolerate their absence (stub
+            # workers and pre-prefix workers never send them).
+            "prefix_hit_tokens": int(getattr(req, "prefix_hit_tokens",
+                                             0)),
+            "prefix_hit_pages": int(getattr(req, "prefix_hit_pages",
+                                            0)),
             "reject_reason": req.reject_reason,
             "retry_after": req.retry_after,
         }
@@ -439,13 +447,25 @@ class WorkerHost:
         self._require_engine()
         with self._lock:
             eng = self.engine
-            return {"ticks": self._ticks,
-                    "hb": self._hb_seq,
-                    "free_slots": eng._free_slots(),
-                    "occupancy": float(eng.cache.occupancy()),
-                    "queue_len": len(eng.scheduler.queue),
-                    "in_flight": eng.in_flight,
-                    "idle": eng.idle}
+            out = {"ticks": self._ticks,
+                   "hb": self._hb_seq,
+                   "free_slots": eng._free_slots(),
+                   "occupancy": float(eng.cache.occupancy()),
+                   "queue_len": len(eng.scheduler.queue),
+                   "in_flight": eng.in_flight,
+                   "idle": eng.idle}
+            # Prefix-cache snapshot (absent when caching is off — the
+            # proxy, like every consumer, tolerates the missing key).
+            ps = eng.prefix_stats() if hasattr(eng, "prefix_stats") \
+                else None
+            if ps is not None:
+                out["prefix"] = {
+                    "lookups": ps["lookups"], "hits": ps["hits"],
+                    "tokens_hit": ps["tokens_hit"],
+                    "entries": ps["entries"],
+                    "pages_shared": ps["pages_shared"],
+                }
+            return out
 
     def _rpc_collect(self, p: Dict) -> Dict:
         since = p.get("since") or {}
@@ -463,6 +483,12 @@ class WorkerHost:
                     "tokens": [int(t) for t in req.output[int(n):]],
                     "prefill_pos": int(req.prefill_pos),
                     "generated_len": len(req.generated),
+                    # Live prefix stamps: the router mirror must see
+                    # them BEFORE a crash-drain reads its baseline.
+                    "prefix_hit_tokens": int(getattr(
+                        req, "prefix_hit_tokens", 0)),
+                    "prefix_hit_pages": int(getattr(
+                        req, "prefix_hit_pages", 0)),
                 })
         self._collects += 1
         return {"events": events, "progress": progress,

@@ -1,0 +1,16 @@
+"""HVD013 negative: the refcounted discipline — every holder outside
+the allocator's module drops pages through ``release()``, which
+decrements and frees only at zero. Shared prefix pages survive their
+first holder's teardown; exclusive pages free exactly as before.
+"""
+
+
+def teardown_request(cache, req):
+    req.page_table[:] = 0
+    cache.allocator.release(req.pages)
+    req.pages.clear()
+
+
+def reclaim_index_leaf(alloc, node):
+    alloc.release([node.page])
+    node.page = None

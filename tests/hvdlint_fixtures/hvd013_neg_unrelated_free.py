@@ -1,0 +1,17 @@
+"""HVD013 negative: ``free()`` on receivers that are not page
+allocators — a buffer pool, a C-level handle — plus free-shaped
+identifiers that never call through an allocator. The rule keys on
+allocator-named receivers, not on the method name alone.
+"""
+
+
+def drop_buffer(pool, buf):
+    pool.free(buf)           # a buffer pool, not a page allocator
+
+
+def close_handle(handle):
+    handle.free()            # C-level resource handle
+
+
+def report(stats):
+    return {"free": stats.available, "held": stats.held}

@@ -1,0 +1,16 @@
+"""HVD013 positive: an eviction path dropping a victim's pages via the
+bare allocator ``free()``.
+
+The victim's prompt pages are exactly the ones most likely to be
+shared — a prefix hit mapped them into a newer request's table, and
+the radix index pins them with its own hold. Eviction must be
+refcount-aware (``release()``): shared pages survive, exclusive ones
+actually free.
+"""
+
+
+def evict_victim(alloc, victim):
+    pages = list(victim.pages)
+    victim.pages.clear()
+    alloc.free(pages)  # EXPECT: HVD013
+    return len(pages)

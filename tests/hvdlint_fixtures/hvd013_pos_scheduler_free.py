@@ -1,0 +1,16 @@
+"""HVD013 positive: request teardown frees pages straight through the
+allocator.
+
+Under prefix caching the pages this request maps may be shared: hit
+pages live in other requests' tables too, and the radix index holds
+its own +1 on every indexed page. ``free()`` is the strict
+single-holder path — on a shared page it raises mid-teardown (and a
+weaker allocator would hand the page to a new request while the old
+holders still read it). Teardown must ``release()``.
+"""
+
+
+def teardown_request(cache, req):
+    req.page_table[:] = 0
+    cache.allocator.free(req.pages)  # EXPECT: HVD013
+    req.pages.clear()

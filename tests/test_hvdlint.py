@@ -156,6 +156,23 @@ def test_hvd008_path_exemption():
                lint_source(cls, "horovod_tpu/parallel/mesh.py"))
 
 
+def test_hvd013_path_exemption():
+    """serve/kvcache.py OWNS the strict single-holder free() (its COW
+    cleanup frees a page it provably never shared): HVD013 is
+    path-exempt there and fires everywhere else, while other rules
+    still apply to the exempt file."""
+    src = "def drop(cache, pages):\n    cache.allocator.free(pages)\n"
+    hits = [f for f in
+            lint_source(src, "horovod_tpu/serve/scheduler.py")
+            if f.rule == "HVD013"]
+    assert len(hits) == 1, hits
+    assert lint_source(src, "horovod_tpu/serve/kvcache.py") == []
+    # Exemption is per-rule: HVD004 still fires in kvcache.py.
+    cls = "class H:\n    def __del__(self):\n        pass\n"
+    assert any(f.rule == "HVD004" for f in
+               lint_source(cls, "horovod_tpu/serve/kvcache.py"))
+
+
 def test_repo_sweep_is_clean():
     """The shipping gate (acceptance criterion): zero unsuppressed
     findings across the swept surface."""

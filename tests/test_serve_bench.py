@@ -99,6 +99,49 @@ class TestServeBenchContract:
                      check=False)
             assert p.returncode == 2, (extra, p.stderr[-300:])
 
+    def test_ab_prefix_record_contract(self):
+        """--ab-prefix (round-16 acceptance, single-engine edition):
+        the many-users-one-system-prompt workload runs cold THEN
+        cached, the cached side must actually save prefill tokens with
+        exactly one cold prefill for the shared prefix, every greedy
+        stream is bit-identical off vs on AND pinned against lm_decode,
+        and the record stamps both sides + the hit accounting."""
+        p = _run("serve_bench.py", *TINY, "--ab-prefix",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_ab_prefix_tokens_per_sec_per_chip"
+        s = rec["serve"]
+        assert s["mode"] == "ab_prefix"
+        assert s["by_state"] == {"finished": 6}
+        pb = s["prefix"]
+        assert pb["hit_rate"] > 0
+        assert pb["prefill_tokens_saved"] > 0
+        assert pb["cow_copies"] == 0     # decode never lands on shared
+        ab = s["ab_prefix"]
+        assert ab["off"]["prefix"] is None   # explicit off-side stamp
+        assert ab["off"]["by_state"] == {"finished": 6}
+        assert ab["system_prompt_tokens"] == 32   # auto: 4 pages
+        assert ab["unique_prefixes"] == 1         # one system prompt
+        assert ab["cold_prefills"] == 1           # exactly one cold
+        assert ab["exact_pin"]["identical"] is True
+        assert ab["exact_pin"]["compared"] == 6
+        assert rec["config"]["prefix_caching"] == "ab"
+        assert rec["config"]["system_prompt_len"] == 32
+        # the perf_summary prefix column renders this record
+        from tools.perf_summary import prefix_cell
+
+        cell = prefix_cell(rec)
+        assert cell.startswith("hit ") and "a/b" in cell
+
+    def test_ab_prefix_is_exclusive_with_other_modes(self):
+        for extra in (["--ab"], ["--static"], ["--ab-attention"],
+                      ["--prefix"],
+                      ["--fleet", "2", "--fault-plan",
+                       "kill:replica=1,at=50%"]):
+            p = _run("serve_bench.py", *TINY, "--ab-prefix", *extra,
+                     check=False)
+            assert p.returncode == 2, (extra, p.stderr[-300:])
+
     def test_require_finished_fails_loudly(self):
         # capacity of ONE page (8 positions): several drawn requests
         # can never fit and hard-reject -> --require-finished exits 1
@@ -211,6 +254,31 @@ class TestFleetBenchContract:
         assert f["healthy"] == 2
         assert "fleet_ab" not in s
 
+    def test_fleet_ab_prefix_record_contract(self):
+        """--fleet 2 --ab-prefix: the cold pin tightens to one cold
+        prefill per (prefix, REPLICA) — rendezvous routing sends every
+        prefix-mate to one home unless saturation spills, and each
+        replica that serves the prefix pays for it exactly once."""
+        p = _run("serve_bench.py", *TINY, "--fleet", "2", "--ab-prefix",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_ab_prefix_tokens_per_sec_per_chip"
+        s = rec["serve"]
+        assert s["mode"] == "ab_prefix"
+        assert s["by_state"] == {"finished": 6}
+        pb = s["fleet"]["prefix"]
+        assert pb["hits"] > 0 and pb["prefill_tokens_saved"] > 0
+        ab = s["ab_prefix"]
+        assert ab["off"]["fleet"]["prefix"] is None
+        assert ab["unique_prefixes"] == 1
+        # one cold prefill per replica the prefix landed on, no more
+        assert ab["cold_prefills"] == ab["replica_homes"] >= 1
+        assert ab["exact_pin"]["identical"] is True
+        assert ab["exact_pin"]["compared"] == 6
+        from tools.perf_summary import prefix_cell
+
+        assert prefix_cell(rec).startswith("hit ")
+
     def test_fleet_arg_validation(self):
         cases = [
             # faults address replicas: need --fleet
@@ -266,6 +334,34 @@ def test_fleet_cell_renders_synthetic_record():
         "tokens_recomputed": 18}}}
     assert fleet_cell(tcp) == \
         "2r tcp 2h rpc 0.4/3ms host_down1 rd4/18tok"
+
+
+def test_prefix_cell_renders_synthetic_record():
+    """tools/perf_summary.py prefix column (fast, no subprocess)."""
+    from tools.perf_summary import prefix_cell
+
+    assert prefix_cell({}) == "—"
+    assert prefix_cell({"serve": {"ttft_ms": {}}}) == "—"
+    assert prefix_cell({"serve": {"prefix": None}}) == "—"
+    # single-engine --ab-prefix record: hit accounting + A/B ratio
+    eng = {"serve": {
+        "prefix": {"hit_rate": 0.88, "prefill_tokens_saved": 224,
+                   "pages_shared": 14, "cow_copies": 0},
+        "ab_prefix": {"cached_over_cold": 1.05, "cold_prefills": 1,
+                      "unique_prefixes": 1},
+    }}
+    assert prefix_cell(eng) == "hit 0.88 sv 224tok/14pg a/b 1.05 1cold x1"
+    # fleet records read the router-side block and append the
+    # redispatch-meets-prefix savings
+    fl = {"serve": {"fleet": {"prefix": {
+        "hit_rate": 0.75, "prefill_tokens_saved": 48,
+        "pages_shared": 6, "redispatch_tokens_saved": 16}}}}
+    assert prefix_cell(fl) == "hit 0.75 sv 48tok/6pg rd16tok"
+    # COW copies surface when the defensive path ever fired
+    cow = {"serve": {"prefix": {"hit_rate": 0.5,
+                                "prefill_tokens_saved": 8,
+                                "cow_copies": 2}}}
+    assert prefix_cell(cow) == "hit 0.5 sv 8tok cow2"
 
 
 class TestDecodeBenchSatellites:

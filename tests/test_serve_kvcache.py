@@ -91,6 +91,120 @@ class TestAllocator:
         with pytest.raises(ValueError):
             a.alloc(-1)
 
+    # ------------------------------------------- refcounting (PR 16)
+
+    def test_retain_release_lifecycle(self):
+        a = PageAllocator(8)
+        (p,) = a.alloc(1)
+        assert a.refcount(p) == 1 and not a.is_shared(p)
+        a.retain([p])
+        assert a.refcount(p) == 2 and a.is_shared(p)
+        assert a.shared == 1
+        a.release([p])                  # first holder out: still live
+        assert a.refcount(p) == 1 and a.available == 6
+        a.release([p])                  # last holder out: actually free
+        assert a.refcount(p) == 0 and a.available == 7
+
+    def test_free_refuses_shared_pages(self):
+        """free() is the strict single-holder path (HVD013: everyone
+        outside serve/kvcache.py must release()): a shared page must
+        never be yanked from under its other holders."""
+        a = PageAllocator(8)
+        g = a.alloc(2)
+        a.retain(g)
+        with pytest.raises(ValueError, match="release"):
+            a.free(g)
+        assert a.in_use == 2            # the refusal took nothing
+        a.release(g)
+        a.free(g)                       # sole holder again: fine
+        assert a.available == 7
+
+    def test_retain_is_all_or_nothing(self):
+        a = PageAllocator(8)
+        (p,) = a.alloc(1)
+        with pytest.raises(ValueError):
+            a.retain([p, 5])            # 5 was never allocated
+        assert a.refcount(p) == 1       # nothing was retained
+
+    def test_release_unallocated_rejected(self):
+        a = PageAllocator(8)
+        with pytest.raises(ValueError):
+            a.release([3])
+
+    def test_refcount_churn_property(self):
+        """Randomized alloc/share/COW/free churn over the refcounted
+        allocator — the prefix-caching extension of
+        test_churn_property. Invariants held at EVERY step:
+
+        * page conservation: in_use + available == capacity, where
+          in_use counts pages, not holders;
+        * no double-free: the free list never holds a page any holder
+          still maps (a shared page never re-enters the free list
+          while refcount > 0);
+        * the model's per-page holder count matches the allocator's
+          exactly;
+        * strict free() on a shared page always refuses.
+
+        COW is modeled as the engine does it: alloc a fresh page,
+        release the shared one.
+        """
+        rng = random.Random(16)
+        a = PageAllocator(64)
+        holders = {}                    # page -> model refcount
+        for _ in range(1000):
+            roll = rng.random()
+            if holders and roll < 0.30:           # drop one holder
+                page = rng.choice(list(holders))
+                if holders[page] == 1 and rng.random() < 0.5:
+                    a.free([page])                # exclusive fast path
+                else:
+                    a.release([page])
+                holders[page] -= 1
+                if not holders[page]:
+                    del holders[page]
+            elif holders and roll < 0.55:         # prefix hit: share
+                page = rng.choice(list(holders))
+                a.retain([page])
+                holders[page] += 1
+            elif holders and roll < 0.65:         # write hit: COW
+                page = rng.choice(list(holders))
+                if a.is_shared(page):
+                    if a.available:
+                        (new,) = a.alloc(1)
+                        holders[new] = 1
+                        a.release([page])
+                        holders[page] -= 1
+                    else:
+                        with pytest.raises(ValueError):
+                            a.free([page])        # shared: must refuse
+                elif rng.random() < 0.5:
+                    a.free([page])                # exclusive: no COW
+                    del holders[page]
+            else:                                 # admission
+                n = rng.randint(1, 4)
+                if n > a.available:
+                    with pytest.raises(OutOfPages):
+                        a.alloc(n)
+                else:
+                    for p in a.alloc(n):
+                        holders[p] = 1
+            # -- invariants, every iteration --
+            assert a.in_use == len(holders)
+            assert a.in_use + a.available == a.capacity
+            for page, n_holders in holders.items():
+                assert a.refcount(page) == n_holders
+            assert a.shared == sum(1 for c in holders.values() if c > 1)
+            # a live page must never be grantable: drain the free list
+            # and check no held page came back
+            if rng.random() < 0.05 and a.available:
+                grant = a.alloc(a.available)
+                assert not set(grant) & set(holders)
+                a.free(grant)
+        # drain: release every remaining holder; everything comes back
+        for page, n_holders in list(holders.items()):
+            a.release([page] * n_holders)
+        assert a.available == a.capacity and a.shared == 0
+
 
 @pytest.fixture(scope="module")
 def cache():

@@ -38,6 +38,16 @@
 #                               fleet run, the incident classifies as
 #                               "crashed", and the record stamps the
 #                               recovery metrics)
+#                               + the prefix smoke (tools/serve_bench.py
+#                               --fleet 2 --ab-prefix: 8 requests
+#                               sharing one 32-token system prompt
+#                               through a 2-replica fleet, cold then
+#                               cached — the cached side must show
+#                               hit_rate > 0 with shared pages and
+#                               saved prefill tokens, pay exactly ONE
+#                               cold prefill per (prefix, replica),
+#                               and stream bit-identical to the
+#                               uncached side AND lm_decode)
 #                               + the hierarchical smoke (a 2x2 virtual
 #                               hybrid ICI x DCN mesh on CPU: the
 #                               hybrid_mesh factory builds, the bucket
@@ -86,6 +96,7 @@
 #                               sha256, zero requests drop or reject,
 #                               greedy streams stay bit-identical to
 #                               the clean run, zero leftover workers)
+#   tools/check.sh --no-prefix  skip the prefix-caching smoke
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -103,6 +114,7 @@ FLEET=1
 FLEET_PROC=1
 FLEET_TCP=1
 FLEET_UPDATE=1
+PREFIX=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -114,9 +126,10 @@ for arg in "$@"; do
     --no-fleet-proc) FLEET_PROC=0 ;;
     --no-fleet-tcp) FLEET_TCP=0 ;;
     --no-fleet-update) FLEET_UPDATE=0 ;;
+    --no-prefix) PREFIX=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -340,6 +353,43 @@ print("rolling-update smoke: torn push -> 1 classified transfer retry "
     exit 1
   fi
   echo "rolling-update smoke: zero surviving worker processes"
+fi
+
+if [[ "$PREFIX" == "1" ]]; then
+  echo "== prefix smoke (2 CPU replicas, shared system prompt, cold vs cached: hit_rate > 0, one cold prefill per (prefix, replica), streams bit-identical) =="
+  PREFIX_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --fleet 2 --ab-prefix \
+    --pin-exact --require-finished)
+  echo "$PREFIX_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "ab_prefix", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+p = s["fleet"]["prefix"]
+assert p["hit_rate"] > 0, p
+assert p["prefill_tokens_saved"] > 0 and p["pages_shared"] > 0, p
+ab = s["ab_prefix"]
+# the cold side ran genuinely uncached (explicit off-side stamp)
+assert ab["off"]["fleet"]["prefix"] is None, ab["off"]["fleet"]
+assert ab["off"]["by_state"] == {"finished": 8}, ab["off"]["by_state"]
+# every greedy stream bit-identical cached vs cold (and vs lm_decode
+# via --pin-exact inside the bench)
+assert ab["exact_pin"]["identical"] is True
+assert ab["exact_pin"]["compared"] == 8, ab["exact_pin"]
+# one unique system prompt; each replica it landed on paid exactly
+# one cold prefill — never two
+assert ab["unique_prefixes"] == 1, ab
+assert ab["cold_prefills"] == ab["replica_homes"] >= 1, ab
+print("prefix smoke: hit_rate %s, %d prefill tokens saved over %d "
+      "shared pages, %d cold prefill(s) on %d replica home(s), "
+      "8/8 streams bit-identical cold vs cached" % (
+          p["hit_rate"], p["prefill_tokens_saved"], p["pages_shared"],
+          ab["cold_prefills"], ab["replica_homes"]))
+'
 fi
 
 if [[ "$HIER" == "1" ]]; then

@@ -639,6 +639,10 @@ MESH_AXIS_LITERALS = {"hvd", "ici", "dcn"}  # hvdlint: disable=HVD008 (the rule 
 #: (core.lint_source) since rules themselves see only the AST.
 PATH_EXEMPT = {
     "HVD008": ("parallel/mesh.py", "common/config.py"),
+    # The allocator's own module is the single place allowed to call
+    # the strict single-holder free() fast path (COW failure cleanup);
+    # everyone else must go through refcounted release().
+    "HVD013": ("serve/kvcache.py",),
 }
 
 
@@ -1104,6 +1108,59 @@ def check_hvd012(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD013
+
+#: Identifier markers that make a ``.free(...)`` receiver a page
+#: allocator (``alloc.free(...)``, ``self.cache.allocator.free(...)``).
+#: A ``.free()`` on anything not named allocator-like stays silent.
+ALLOCATOR_NAME_MARKER = "alloc"
+
+
+def check_hvd013(tree: ast.AST) -> List[RawFinding]:
+    """Direct page-allocator ``free()`` call outside serve/kvcache.py —
+    the double-free / shared-page-leak shape under prefix caching.
+
+    Since KV pages became refcounted (copy-on-write prefix caching),
+    ``PageAllocator.free`` is the strict SINGLE-HOLDER fast path: it
+    raises on a page any second holder still maps. Call sites outside
+    the allocator's module cannot see refcounts — a page that looks
+    exclusively owned may be mapped read-only into another request's
+    table via a prefix hit, or pinned by the radix index's own +1 hold.
+    Freeing it there either throws mid-release (the raise) or, were the
+    check ever weakened, hands the page to a new request while the old
+    holders still read it — silent KV corruption. Every holder outside
+    serve/kvcache.py must drop pages through ``release()`` (decrement,
+    free at zero), which is exactly what ``Scheduler.release`` and the
+    prefix index do. ``serve/kvcache.py`` itself is path-exempt via
+    ``PATH_EXEMPT``: the allocator's own COW-failure cleanup frees a
+    page it just allocated and provably never shared.
+    """
+    findings: List[RawFinding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call) \
+                or not isinstance(call.func, ast.Attribute) \
+                or call.func.attr != "free":
+            continue
+        receiver_idents = []
+        for n in ast.walk(call.func.value):
+            if isinstance(n, ast.Name):
+                receiver_idents.append(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                receiver_idents.append(n.attr.lower())
+        if not any(ALLOCATOR_NAME_MARKER in i for i in receiver_idents):
+            continue
+        findings.append(RawFinding(
+            call.lineno, call.col_offset, "HVD013", "error",
+            "direct page-allocator free() outside serve/kvcache.py: "
+            "pages are refcounted (prefix caching shares them across "
+            "requests and the radix index holds its own +1), and this "
+            "call site cannot see the refcount — a shared page here is "
+            "a raise at best, KV corruption at worst; drop pages via "
+            "release() (decrement, free at zero) like "
+            "Scheduler.release does"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -1117,4 +1174,5 @@ RULES = {
     "HVD010": check_hvd010,
     "HVD011": check_hvd011,
     "HVD012": check_hvd012,
+    "HVD013": check_hvd013,
 }

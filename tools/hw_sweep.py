@@ -182,6 +182,23 @@ LANES = [
                                "--fault-plan",
                                "transfer:replica=0,at=50%",
                                "--require-finished"]),
+    # Prefix-caching A/B (round-16 tentpole, horovod_tpu/serve/
+    # prefix.py): the many-users-one-system-prompt workload — every
+    # prompt opens with the SAME 256-token system prompt — through a
+    # 2-replica fleet twice, cold then cached. The cached side maps the
+    # shared prompt's full pages read-only out of the radix index
+    # (refcount++, copy-on-write on any overlap), rendezvous routing
+    # keeps prefix-mates on one home, and the bench ABORTS unless every
+    # greedy stream is bit-identical off vs on AND each (prefix,
+    # replica) paid exactly ONE cold prefill. serve.prefix /
+    # serve.fleet.prefix stamp hit_rate + prefill_tokens_saved +
+    # pages_shared; serve.ab_prefix.cached_over_cold carries the
+    # throughput verdict.
+    ("serve_prefix_ab", ["tools/serve_bench.py", "--requests", "64",
+                         "--rate", "8", "--new-min", "16",
+                         "--new-max", "256", "--fleet", "2",
+                         "--system-prompt-len", "256", "--ab-prefix",
+                         "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

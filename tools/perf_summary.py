@@ -241,6 +241,45 @@ def fleet_cell(rec):
     return cell
 
 
+def prefix_cell(rec):
+    """Compact render of the record's prefix-cache stamps (tools/
+    serve_bench.py --prefix/--ab-prefix; horovod_tpu/serve/prefix.py):
+    "hit 0.88 sv 224tok/14pg a/b 1.05 1cold x1" = 88% of admitted
+    requests re-used indexed pages, 224 prompt tokens of prefill
+    skipped over 14 shared pages, cached side 1.05x the cold side's
+    throughput, and the A/B pin held (exactly one cold prefill per
+    unique prefix per replica). Fleet records read the router-side
+    block and append "rdNtok" when redispatched requests re-matched on
+    a survivor. Prefix-off (and pre-prefix) records render as
+    em-dash."""
+    s = rec.get("serve")
+    if not isinstance(s, dict):
+        return "—"
+    p = s.get("prefix")
+    if p is None and isinstance(s.get("fleet"), dict):
+        p = s["fleet"].get("prefix")
+    ab = s.get("ab_prefix") or {}
+    if not p and not ab:
+        return "—"
+    cell = ""
+    if p:
+        cell = f"hit {p.get('hit_rate', '?')}"
+        if p.get("prefill_tokens_saved") is not None:
+            cell += f" sv {p['prefill_tokens_saved']}tok"
+            if p.get("pages_shared"):
+                cell += f"/{p['pages_shared']}pg"
+        if p.get("cow_copies"):
+            cell += f" cow{p['cow_copies']}"
+        if p.get("redispatch_tokens_saved"):
+            cell += f" rd{p['redispatch_tokens_saved']}tok"
+    if ab:
+        if ab.get("cached_over_cold") is not None:
+            cell += f" a/b {ab['cached_over_cold']:g}"
+        cell += (f" {ab.get('cold_prefills', '?')}cold "
+                 f"x{ab.get('unique_prefixes', '?')}")
+    return cell.strip() or "—"
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
@@ -248,9 +287,10 @@ def main():
     args = ap.parse_args()
     ok, err = load(args.today)
     print("| lane | value | unit | window | overlap | wire | collectives "
-          "| flash grid | snapshot | elastic | serve | fleet | peak "
-          "| probe TF | stamp (UTC) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "| flash grid | snapshot | elastic | serve | fleet | prefix "
+          "| peak | probe TF | stamp (UTC) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+          "---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -268,6 +308,7 @@ def main():
               f"| {elastic_cell(rec)} "
               f"| {serve_cell(rec)} "
               f"| {fleet_cell(rec)} "
+              f"| {prefix_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")

@@ -33,6 +33,27 @@ Modes:
               ``paged_over_gather`` throughput ratio (the
               gather-vs-paged A/B as one record; exclusive with
               --ab/--static)
+  --prefix    enable copy-on-write prefix caching
+              (``ServeConfig.prefix_caching`` — the radix index in
+              horovod_tpu/serve/prefix.py) for whatever mode runs;
+              the record then stamps hit rate / pages shared /
+              prefill tokens saved (``serve.prefix`` single-engine,
+              ``serve.fleet.prefix`` fleet-wide)
+  --ab-prefix run prefix caching OFF then ON over the IDENTICAL
+              many-users-one-system-prompt workload
+              (``--system-prompt-len`` shared tokens prepended to
+              every prompt; auto = 4 pages) and stamp both sides +
+              the throughput ratio. Three pins ride the lane: every
+              greedy stream bit-identical across the two sides (a
+              cache hit must not change a single token), EXACTLY ONE
+              cold prefill per unique prefix per replica on the
+              cached side (every other request hit the index —
+              ``prefill_tokens_saved > 0``), and ``--pin-exact``
+              additionally re-decodes both sides through
+              ``lm_decode``. Composes with --fleet N (prefix-aware
+              rendezvous routing co-locates prefix-mates); exclusive
+              with --ab/--static/--ab-attention/--fault-plan/
+              --rolling-update-at (one A/B per record)
   --fleet N   drive a fault-tolerant N-replica fleet
               (horovod_tpu/serve/fleet.py: least-loaded router,
               classified replica incidents, drain/redispatch, load
@@ -94,20 +115,27 @@ if REPO not in sys.path:   # `python tools/serve_bench.py` puts tools/
     sys.path.insert(0, REPO)  # on sys.path, not the repo root
 
 
-def make_workload(args):
+def make_workload(args, system_prompt_len=0):
     """Pre-drawn open-loop workload: (arrival_offset_s, prompt,
-    max_new) triples, arrivals cumsum'd exponential gaps."""
+    max_new) triples, arrivals cumsum'd exponential gaps. With
+    ``system_prompt_len`` > 0 every prompt is SYSTEM + unique tail —
+    the many-users-one-system-prompt shape prefix caching exists for
+    (the tail keeps its ``--prompt-min/max`` draw, so total prompt
+    length grows by the shared prefix)."""
     import numpy as np
 
     rng = np.random.default_rng(args.seed)
+    system = rng.integers(0, args.vocab,
+                          size=system_prompt_len).astype(np.int32)
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
     arrivals = np.cumsum(gaps)
     out = []
     for i in range(args.requests):
         lp = int(rng.integers(args.prompt_min, args.prompt_max + 1))
         n = int(rng.integers(args.new_min, args.new_max + 1))
-        prompt = rng.integers(0, args.vocab, size=lp).astype(np.int32)
-        out.append((float(arrivals[i]), prompt, n))
+        tail = rng.integers(0, args.vocab, size=lp).astype(np.int32)
+        out.append((float(arrivals[i]),
+                    np.concatenate([system, tail]), n))
     return out
 
 
@@ -248,6 +276,67 @@ def pin_redispatch_exact(clean_reqs, faulted_reqs):
     return compared
 
 
+def pin_prefix_sides(off_reqs, on_reqs):
+    """The --ab-prefix exactness pin: the i-th submitted request must
+    emit the bit-identical greedy stream with the prefix cache OFF and
+    ON — a hit serves the SAME K/V values out of shared pages, so not
+    one token may move. Returns pairs compared."""
+    if len(off_reqs) != len(on_reqs):
+        raise SystemExit(
+            f"PREFIX AB PIN FAILED: {len(off_reqs)} requests off-side "
+            f"vs {len(on_reqs)} on-side")
+    compared = 0
+    for i, (ro, rn) in enumerate(zip(off_reqs, on_reqs)):
+        if list(ro.prompt[:ro.orig_prompt_len]) != \
+                list(rn.prompt[:rn.orig_prompt_len]):
+            raise SystemExit(
+                f"PREFIX AB PIN FAILED: request #{i} prompts differ "
+                "across sides (workload must be identical)")
+        if ro.temperature > 0 or \
+                ro.state != "finished" or rn.state != "finished":
+            continue
+        if ro.output != rn.output:
+            raise SystemExit(
+                f"PREFIX AB PIN FAILED: request #{i} cold={ro.output} "
+                f"cached={rn.output}")
+        compared += 1
+    return compared
+
+
+def pin_prefix_cold(reqs, page_size, label):
+    """The --ab-prefix efficiency pin: group finished requests by
+    (route key, serving replica) — EXACTLY ONE request per group may
+    have paid a cold prefill (``prefix_hit_tokens == 0``); every other
+    prefix-mate must have hit the index. Holds deterministically
+    because each engine admits through ONE prefill lane: request B's
+    admission match runs only after request A's prefill completed and
+    indexed its pages. Returns (unique_prefixes, replica_homes,
+    cold_prefills)."""
+    from horovod_tpu.serve.prefix import prefix_route_key
+
+    groups = {}
+    for r in reqs:
+        if r.state != "finished":
+            continue
+        key = prefix_route_key(r.prompt[:r.orig_prompt_len], page_size)
+        if key is None:
+            continue
+        groups.setdefault((key, r.replica), []).append(r)
+    cold_total = 0
+    for (key, home), grp in sorted(groups.items(),
+                                   key=lambda kv: str(kv[0])):
+        cold = sum(1 for r in grp if r.prefix_hit_tokens == 0)
+        if cold != 1:
+            raise SystemExit(
+                f"PREFIX COLD PIN FAILED ({label}): {cold} cold "
+                f"prefill(s) for prefix {key[:12]} on replica {home} "
+                f"({len(grp)} requests; want exactly 1 — one cold "
+                "prefill per unique prefix per replica)")
+        cold_total += cold
+    return (len({k for k, _ in groups}),
+            len({h for _, h in groups}), cold_total)
+
+
 def pin_exact(params, eng):
     """Every finished greedy request must match its own lm_decode."""
     import jax.numpy as jnp
@@ -301,6 +390,24 @@ def main() -> int:
                     help="continuous engine with BOTH attention paths "
                          "on the same workload; stamp both + the "
                          "paged_over_gather ratio")
+    ap.add_argument("--prefix", action="store_true",
+                    help="enable copy-on-write prefix caching "
+                         "(ServeConfig.prefix_caching) for whatever "
+                         "mode runs")
+    ap.add_argument("--ab-prefix", action="store_true",
+                    help="prefix caching OFF then ON on the identical "
+                         "many-users-one-system-prompt workload; pins "
+                         "bit-identical streams across sides and "
+                         "exactly one cold prefill per unique prefix "
+                         "per replica; stamps both sides + the ratio "
+                         "(composes with --fleet; exclusive with the "
+                         "other A/Bs and fault/update triggers)")
+    ap.add_argument("--system-prompt-len", type=int, default=-1,
+                    help="shared system-prompt tokens prepended to "
+                         "EVERY prompt (the prefix-cache workload "
+                         "shape; tails keep their --prompt-min/max "
+                         "draw). -1 = auto: 4 pages under --ab-prefix, "
+                         "0 otherwise")
     ap.add_argument("--static", action="store_true",
                     help="static-batching baseline instead of "
                          "continuous")
@@ -384,6 +491,19 @@ def main() -> int:
     if args.ab_attention and (args.ab or args.static):
         ap.error("--ab-attention is exclusive with --ab/--static (one "
                  "A/B per record)")
+    if args.ab_prefix and (args.ab or args.static or args.ab_attention):
+        ap.error("--ab-prefix is exclusive with --ab/--static/"
+                 "--ab-attention (one A/B per record)")
+    if args.ab_prefix and args.prefix:
+        ap.error("--ab-prefix runs both prefix sides itself; drop "
+                 "--prefix")
+    if args.ab_prefix and (args.fault_plan or args.rolling_update_at):
+        ap.error("--ab-prefix is exclusive with --fault-plan/"
+                 "--rolling-update-at (one A/B per record; the "
+                 "redispatch-meets-prefix lane lives in the test "
+                 "matrix)")
+    if args.system_prompt_len < -1:
+        ap.error("--system-prompt-len must be >= 0 (-1 = auto)")
     if args.fleet < 0:
         ap.error("--fleet must be >= 0 (0 = single engine)")
     if args.fleet and (args.ab or args.static or args.ab_attention):
@@ -446,9 +566,13 @@ def main() -> int:
 
     from horovod_tpu.serve import ServeConfig
 
-    # Lmax covers the worst request, rounded up to whole pages.
+    # Lmax covers the worst request (incl. the shared system prompt),
+    # rounded up to whole pages.
     ps = args.page_size
-    lmax = -(-(args.prompt_max + args.new_max) // ps) * ps
+    spl = args.system_prompt_len
+    if spl < 0:
+        spl = 4 * ps if args.ab_prefix else 0
+    lmax = -(-(spl + args.prompt_max + args.new_max) // ps) * ps
     pages_per_seq = lmax // ps
     num_pages = args.num_pages
     if num_pages <= 0:
@@ -458,10 +582,11 @@ def main() -> int:
         decode_slots=args.decode_slots,
         prefill_chunk=args.prefill_chunk, policy=args.policy,
         slo=args.slo, admission=args.admission,
-        attention=args.attention)
+        attention=args.attention,
+        prefix_caching=args.prefix)
 
     params = build_params(args, lmax)
-    workload = make_workload(args)
+    workload = make_workload(args, system_prompt_len=spl)
 
     def lane(runner, tag, lane_cfg=cfg):
         eng = runner(params, lane_cfg, workload)
@@ -507,9 +632,9 @@ def main() -> int:
             update_at = (update_at_s if update_at_s is not None
                          else update_at_frac * horizon)
 
-        def fleet_lane(tag, fault_plan="", update=None):
-            fl, reqs = run_fleet(params, cfg, fleet_cfg, workload,
-                                 fault_plan, update_at=update)
+        def fleet_lane(tag, fault_plan="", update=None, lane_cfg=None):
+            fl, reqs = run_fleet(params, lane_cfg or cfg, fleet_cfg,
+                                 workload, fault_plan, update_at=update)
             try:
                 stats = fl.stats()
                 f = stats["fleet"]
@@ -524,6 +649,11 @@ def main() -> int:
                       f"shed {f['shed']}, transport {f['transport']}"
                       + (f" ({f['host_incidents']} host incident(s))"
                          if f.get("host_incidents") else "")
+                      + ((lambda p: f", prefix hit_rate {p['hit_rate']}"
+                          f" ({p['prefill_tokens_saved']} prefill "
+                          f"tokens saved, {p['pages_shared']} pages "
+                          "shared)")(f["prefix"])
+                         if f.get("prefix") else "")
                       + (f" rpc p50/p99 {f['rpc_ms']['p50']}/"
                          f"{f['rpc_ms']['p99']} ms"
                          if f.get("rpc_ms") else "")
@@ -550,8 +680,50 @@ def main() -> int:
                 fl.close()   # one namespaced heartbeat dir per fleet
             return stats, reqs
 
-        clean, clean_reqs = fleet_lane(f"fleet x{args.fleet} clean")
-        if args.fault_plan or update_at is not None:
+        if args.ab_prefix:
+            import dataclasses
+
+            off, off_reqs = fleet_lane(
+                f"fleet x{args.fleet} prefix=off",
+                lane_cfg=dataclasses.replace(cfg, prefix_caching=False))
+            on, on_reqs = fleet_lane(
+                f"fleet x{args.fleet} prefix=on",
+                lane_cfg=dataclasses.replace(cfg, prefix_caching=True))
+            compared = pin_prefix_sides(off_reqs, on_reqs)
+            uniq, homes, colds = pin_prefix_cold(
+                on_reqs, ps, "fleet cached side")
+            pb = (on.get("fleet") or {}).get("prefix") or {}
+            if not pb.get("prefill_tokens_saved"):
+                raise SystemExit(
+                    "PREFIX AB FAILED: the cached fleet side saved no "
+                    f"prefill tokens ({pb or 'no prefix block'})")
+            print(f"[serve_bench] prefix pins: {compared} greedy "
+                  f"streams bit-identical off vs on; {colds} cold "
+                  f"prefill(s) for {uniq} unique prefix(es) across "
+                  f"{homes} replica home(s) — exactly one per "
+                  "(prefix, replica)", file=sys.stderr, flush=True)
+            off = dict(off)
+            off.setdefault("prefix", None)   # explicit off-side stamp
+            ratio = None
+            if off["tokens_per_sec_per_chip"] and \
+                    on["tokens_per_sec_per_chip"]:
+                ratio = round(on["tokens_per_sec_per_chip"]
+                              / off["tokens_per_sec_per_chip"], 3)
+            mode, headline = "ab_prefix", on
+            serve = dict(on, mode="ab_prefix", ab_prefix={
+                "off": off,
+                "system_prompt_tokens": spl,
+                "unique_prefixes": uniq,
+                "replica_homes": homes,
+                "cold_prefills": colds,
+                "exact_pin": {"compared": compared, "identical": True},
+                "cached_over_cold": ratio,
+            })
+            clean = None
+        else:
+            clean, clean_reqs = fleet_lane(f"fleet x{args.fleet} clean")
+        if clean is not None and \
+                (args.fault_plan or update_at is not None):
             faulted_tag = f"fleet x{args.fleet} faulted"
             if args.fault_plan:
                 faulted_tag += f" [{args.fault_plan}]"
@@ -577,9 +749,69 @@ def main() -> int:
                 "p99_ttft_faulted_ms": f99,
                 "faulted_over_clean_p99_ttft": ratio,
             })
-        else:
+        elif clean is not None:
             mode = "fleet"
             headline = serve = dict(clean, mode="fleet")
+    elif args.ab_prefix:
+        import dataclasses
+
+        def prefix_lane(tag, lane_cfg):
+            eng = run_continuous(params, lane_cfg, workload)
+            stats = eng.stats()
+            p = stats.get("prefix")
+            print(f"[serve_bench] {tag}: "
+                  f"{stats['tokens_per_sec_per_chip']} tok/s/chip, "
+                  f"ttft p50/p99 {stats['ttft_ms']['p50']}/"
+                  f"{stats['ttft_ms']['p99']} ms, "
+                  f"{stats['by_state']}"
+                  + (f", prefix hit_rate {p['hit_rate']} "
+                     f"({p['prefill_tokens_saved']} prefill tokens "
+                     f"saved, {p['pages_shared']} pages shared, "
+                     f"{p['cow_copies']} COW copies)" if p else ""),
+                  file=sys.stderr, flush=True)
+            if args.pin_exact:
+                pin_exact(params, eng)
+            if args.require_finished and \
+                    stats["by_state"].get("finished") != args.requests:
+                raise SystemExit(
+                    f"not all requests finished: {stats['by_state']}")
+            reqs = sorted(eng.finished + eng.evicted + eng.timed_out
+                          + eng.scheduler.rejected,
+                          key=lambda r: r.rid)
+            return stats, reqs
+
+        off, off_reqs = prefix_lane(
+            "prefix=off",
+            dataclasses.replace(cfg, prefix_caching=False))
+        on, on_reqs = prefix_lane(
+            "prefix=on",
+            dataclasses.replace(cfg, prefix_caching=True))
+        compared = pin_prefix_sides(off_reqs, on_reqs)
+        uniq, homes, colds = pin_prefix_cold(on_reqs, ps, "cached side")
+        if not (on.get("prefix") or {}).get("prefill_tokens_saved"):
+            raise SystemExit(
+                "PREFIX AB FAILED: the cached side saved no prefill "
+                f"tokens ({on.get('prefix') or 'no prefix block'})")
+        print(f"[serve_bench] prefix pins: {compared} greedy streams "
+              f"bit-identical off vs on; {colds} cold prefill(s) for "
+              f"{uniq} unique prefix(es) — exactly one per prefix",
+              file=sys.stderr, flush=True)
+        off = dict(off)
+        off.setdefault("prefix", None)   # explicit off-side stamp
+        ratio = None
+        if off["tokens_per_sec_per_chip"] and \
+                on["tokens_per_sec_per_chip"]:
+            ratio = round(on["tokens_per_sec_per_chip"]
+                          / off["tokens_per_sec_per_chip"], 3)
+        mode, headline = "ab_prefix", on
+        serve = dict(on, mode="ab_prefix", ab_prefix={
+            "off": off,
+            "system_prompt_tokens": spl,
+            "unique_prefixes": uniq,
+            "cold_prefills": colds,
+            "exact_pin": {"compared": compared, "identical": True},
+            "cached_over_cold": ratio,
+        })
     elif args.ab_attention:
         import dataclasses
 
@@ -630,6 +862,9 @@ def main() -> int:
             "admission": args.admission,
             "attention": ("ab" if args.ab_attention
                           else args.attention),
+            "prefix_caching": ("ab" if args.ab_prefix
+                               else args.prefix),
+            "system_prompt_len": spl,
             "rate": args.rate,
             "requests": args.requests,
             "fleet": ({
