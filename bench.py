@@ -732,6 +732,7 @@ def supervise(argv, args):
             "vs_baseline": None, "peak": None, "probe_tflops": None,
             "window": getattr(args, "steps_per_dispatch", 1),
             "overlap": getattr(args, "overlap", None),
+            "mesh": getattr(args, "mesh", None),
             "hierarchical": None,
             "wire": None,
             "snapshot": None,
@@ -836,6 +837,7 @@ def supervise(argv, args):
         "vs_baseline": None, "peak": None, "probe_tflops": None,
         "window": getattr(args, "steps_per_dispatch", 1),
         "overlap": getattr(args, "overlap", None),
+        "mesh": getattr(args, "mesh", None),
         "hierarchical": None,
         "wire": None,
         "snapshot": None,
@@ -845,12 +847,34 @@ def supervise(argv, args):
     return 0
 
 
+def _mesh_config(text):
+    """argparse type for --mesh: parse + canonicalize through the
+    logical-axis vocabulary (horovod_tpu.parallel.logical), so the
+    record always carries the canonical spelling ('tp=4,dp=8' and
+    'dp=8,tp=4' stamp identically) and an invalid config is a usage
+    error, not a mid-run crash."""
+    from horovod_tpu.parallel.logical import (
+        format_mesh_config,
+        parse_mesh_config,
+    )
+
+    try:
+        return format_mesh_config(parse_mesh_config(text))
+    except Exception as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
 def build_parser():
     """The bench CLI (exposed so tests/test_sweep_lanes.py can statically
     validate every tools/hw_sweep.py lane's arg wiring — a round-3
     hardware window died to a wiring bug no CPU test had covered)."""
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--mesh", default=None, type=_mesh_config,
+                        help="logical mesh config this lane ran under, "
+                             "e.g. 'dp=8,tp=4,sp=2' — canonicalized and "
+                             "stamped as the record's \"mesh\" field "
+                             "(null when unconfigured)")
     parser.add_argument("--batch-size", type=int, default=None,
                         help="per-chip batch (default: 64 images, or 8 "
                              "sequences for transformer_lm)")
@@ -1091,6 +1115,7 @@ def main():
             "peak": round(peak, 2),
             "probe_tflops": probe,
             "window": args.steps_per_dispatch,
+            "mesh": args.mesh,
             # LM lanes append the resolved attention implementation and
             # (flash only) the grid/K-V-bytes accounting — the evidence
             # chain for the truncated-vs-full A/B records.

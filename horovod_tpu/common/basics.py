@@ -124,9 +124,9 @@ def init(comm: Optional[Sequence[int]] = None, devices=None) -> None:
         from jax.sharding import Mesh
         import numpy as np
 
-        # LogicalMesh work list: the default DP mesh names its axis
-        # here instead of via the mesh factory.
-        state.mesh = Mesh(np.asarray(state.devices), ("hvd",))  # hvdlint: disable=HVD008
+        from horovod_tpu.parallel.logical import DATA_AXIS
+
+        state.mesh = Mesh(np.asarray(state.devices), (DATA_AXIS,))
 
         from horovod_tpu.utils.timeline import Timeline
 

@@ -120,13 +120,20 @@ def allreduce_gradients_transform(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def ef_state_partition_specs(opt_state, axis_name: str = "hvd"):  # hvdlint: disable=HVD008 (LogicalMesh work list)
+def ef_state_partition_specs(opt_state, axis_name: Optional[str] = None):
     """Partition specs for an optimizer state that may contain
     :class:`_AllreduceState` error-feedback residuals: residual vectors
     get ``P(axis)`` (rank-local shards), everything else replicated.
+    ``axis_name=None`` resolves the data axis through the bound
+    :class:`~horovod_tpu.parallel.logical.LogicalMesh` rules table
+    (legacy ``"hvd"`` when none is bound).
     ``models.state_partition_specs`` composes this with the ZeRO spec
     derivation; use directly when hand-building specs."""
     from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.logical import module_axis
+
+    axis_name = module_axis("data", axis_name)
 
     def spec_for(node):
         if isinstance(node, _AllreduceState):

@@ -36,11 +36,13 @@ do), same parameter/optimizer trajectories, same metric means.
 from __future__ import annotations
 
 import functools
-from typing import Any, Iterable, List, Tuple
+from typing import Any, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.logical import module_axis
 
 
 def windowed(step_fn, steps_per_dispatch: int):
@@ -105,15 +107,18 @@ def repeat_batch(batch, steps_per_dispatch: int):
 
 
 def stage_synthetic_window(step_fn, batch, steps_per_dispatch: int,
-                           # LogicalMesh work list: default batch spec
-                           # spells the DP axis.
-                           batch_specs: Any = P("hvd")):  # hvdlint: disable=HVD008
+                           batch_specs: Any = None):
     """Synthetic-benchmark window staging, in one place for every timing
     harness (bench.py, tools/profile_step.py): wrap the step in the scan
     window, broadcast the single reusable batch under the K-long window
     axis, and shift the batch partition specs to the stacked layout.
     Returns ``(step_fn, batch, batch_specs)``; K=1 is the identity
-    triple — the reference protocol's per-step dispatch, untouched."""
+    triple — the reference protocol's per-step dispatch, untouched.
+    ``batch_specs=None`` shards the batch over the data axis resolved
+    through the bound LogicalMesh (legacy ``"hvd"`` when none is
+    bound)."""
+    if batch_specs is None:
+        batch_specs = P(module_axis("data"))
     k = int(steps_per_dispatch)
     if k < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
@@ -140,9 +145,9 @@ def run_steps(
     steps_per_dispatch: int = 1,
     *,
     mesh=None,
-    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis_name: Optional[str] = None,
     state_specs: Any = P(),
-    batch_specs: Any = P("hvd"),  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    batch_specs: Any = None,
     metric_specs: Any = P(),
     donate: bool = True,
     prefetch: int = 2,
@@ -184,6 +189,9 @@ def run_steps(
     from horovod_tpu.utils import timeline as _tl
     from horovod_tpu.utils.devsync import window_sync
 
+    axis_name = module_axis("data", axis_name)
+    if batch_specs is None:
+        batch_specs = P(axis_name)
     k = int(steps_per_dispatch)
     if k < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")

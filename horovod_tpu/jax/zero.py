@@ -56,6 +56,7 @@ from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.state import current_spmd_axis, global_state
+from horovod_tpu.parallel.logical import module_axis
 
 
 class ZeroState:
@@ -120,7 +121,7 @@ def _split_group(flat, leaves, idxs, out: list) -> None:
 def sharded_distributed_optimizer(
     optimizer: optax.GradientTransformation,
     average: bool = True,
-    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis_name: Optional[str] = None,
     compression=None,
 ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` with ZeRO-1 sharding over the ``axis_name`` mesh
@@ -141,6 +142,7 @@ def sharded_distributed_optimizer(
     """
     from horovod_tpu.jax.compression import Compression
 
+    axis_name = module_axis("data", axis_name)
     if compression is None:
         compression = Compression.none
 
@@ -265,16 +267,21 @@ def sharded_distributed_optimizer(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def state_partition_specs(opt_state, axis_name: str = "hvd"):  # hvdlint: disable=HVD008 (LogicalMesh work list)
+def state_partition_specs(opt_state, axis_name: Optional[str] = None):
     """Partition specs for a (possibly nested) optimizer state containing
     :class:`ZeroState` nodes: the flat sharded vectors get ``P(axis)``,
     everything else (scalar counts, non-ZeRO states) stays replicated.
+
+    ``axis_name=None`` resolves the data axis through the bound
+    :class:`~horovod_tpu.parallel.logical.LogicalMesh` rules table
+    (legacy ``"hvd"`` when none is bound).
 
     Use for both ``in_specs`` and ``out_specs`` of the training step::
 
         spec = TrainState(params=P(), batch_stats=P(), step=P(),
                           opt_state=zero.state_partition_specs(opt_state))
     """
+    axis_name = module_axis("data", axis_name)
 
     def spec_for(node):
         if isinstance(node, ZeroState):

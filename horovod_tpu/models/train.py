@@ -223,10 +223,13 @@ def state_partition_specs(state: TrainState):
     """Partition-spec pytree for a :class:`TrainState`: everything
     replicated except the rank-sharded optimizer-state vectors —
     ZeRO-sharded flats and hierarchical error-feedback residuals — which
-    get ``P("hvd")``. Pass as both ``in_specs`` and the state half of
-    ``out_specs`` when training with ``create_train_state(..., zero=True)``
-    or with a low-bit DCN wire codec (``compression=Compression.int8`` /
-    ``.fp8`` + hierarchical)."""
+    shard over the data axis resolved through the bound
+    :class:`~horovod_tpu.parallel.logical.LogicalMesh` rules table
+    (legacy ``P("hvd")`` when none is bound). Pass as both ``in_specs``
+    and the state half of ``out_specs`` when training with
+    ``create_train_state(..., zero=True)`` or with a low-bit DCN wire
+    codec (``compression=Compression.int8`` / ``.fp8`` +
+    hierarchical)."""
     import jax as _jax
     from jax.sharding import PartitionSpec as P
 
@@ -235,12 +238,15 @@ def state_partition_specs(state: TrainState):
         _AllreduceState,
         ef_state_partition_specs,
     )
+    from horovod_tpu.parallel.logical import module_axis
+
+    data_axis = module_axis("data")
 
     def spec_for(node):
         if isinstance(node, _zero.ZeroState):
-            return _zero.state_partition_specs(node)
+            return _zero.state_partition_specs(node, axis_name=data_axis)
         if isinstance(node, _AllreduceState):
-            return ef_state_partition_specs(node)
+            return ef_state_partition_specs(node, axis_name=data_axis)
         return P()
 
     opt_spec = _jax.tree_util.tree_map(

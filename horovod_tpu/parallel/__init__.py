@@ -9,6 +9,19 @@ parallelism, plus the hierarchical ICI x DCN mesh that replaces the
 reference's node-local/cross-node communicator split.
 """
 
+from horovod_tpu.parallel.logical import (  # noqa: F401
+    DATA_AXIS,
+    DCN_AXIS,
+    DEFAULT_RULES,
+    ICI_AXIS,
+    LogicalMesh,
+    bind,
+    current_logical_mesh,
+    format_mesh_config,
+    logical_partition_specs,
+    module_axis,
+    parse_mesh_config,
+)
 from horovod_tpu.parallel.spmd import axis_size, spmd, spmd_run  # noqa: F401
 from horovod_tpu.parallel.mesh import (  # noqa: F401
     hierarchical_allreduce,

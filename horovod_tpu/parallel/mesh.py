@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from horovod_tpu.common.exceptions import InvalidArgumentError
+from horovod_tpu.parallel.logical import DCN_AXIS, ICI_AXIS
 
 
 def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
@@ -108,9 +109,9 @@ def hybrid_mesh(ici_axes: Optional[Dict[str, int]] = None,
     devices = list(devices) if devices is not None else list(jax.devices())
     domains, per = slice_topology(devices)
     if ici_axes is None:
-        ici_axes = {"ici": per}
+        ici_axes = {ICI_AXIS: per}
     if dcn_axes is None:
-        dcn_axes = {"dcn": domains}
+        dcn_axes = {DCN_AXIS: domains}
     ici_n = math.prod(ici_axes.values())
     dcn_n = math.prod(dcn_axes.values())
     if ici_n * dcn_n != len(devices):
@@ -144,8 +145,8 @@ def hybrid_mesh(ici_axes: Optional[Dict[str, int]] = None,
 
 
 def hierarchical_mesh(devices=None, inner: Optional[int] = None,
-                      outer_axis: str = "dcn",
-                      inner_axis: str = "ici") -> Mesh:
+                      outer_axis: str = DCN_AXIS,
+                      inner_axis: str = ICI_AXIS) -> Mesh:
     """Two-level mesh for hierarchical collectives.
 
     ``inner`` defaults to the chips-per-process count, so the inner axis
@@ -265,8 +266,8 @@ def hierarchical_allgather_in_axis(x, axis: str, inner: int):
                           axis_index_groups=outer_groups(size, inner))
 
 
-def hierarchical_allreduce(x, outer_axis: str = "dcn",
-                           inner_axis: str = "ici", average: bool = False):
+def hierarchical_allreduce(x, outer_axis: str = DCN_AXIS,
+                           inner_axis: str = ICI_AXIS, average: bool = False):
     """Two-phase allreduce over a hierarchical mesh, inside shard_map.
 
     Semantics of the reference's hierarchical path (operations.cc:

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel.logical import module_axis
+
 
 def top1_routing(x, gate_w, num_experts: int, capacity: int):
     """Switch top-1 routing. x [T, D] -> (dispatch [T, E, C] one-hot,
@@ -44,7 +46,7 @@ def top1_routing(x, gate_w, num_experts: int, capacity: int):
 
 
 def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
-              axis: str = "ep", capacity_factor: float = 1.25,
+              axis: Optional[str] = None, capacity_factor: float = 1.25,
               return_aux: bool = False):
     """Expert-parallel MoE layer inside shard_map.
 
@@ -56,6 +58,7 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
         (pass stacked [E, ...] with ``P("ep")`` in_specs).
     Returns y [T, D] (+ aux loss when ``return_aux``).
     """
+    axis = module_axis("expert", axis)
     size = lax.axis_size(axis)
     T, D = x.shape
     e_leaves = jax.tree_util.tree_leaves(expert_params)

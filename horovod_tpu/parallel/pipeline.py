@@ -13,15 +13,17 @@ Stage weights are passed stacked over the leading axis and sharded with
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel.logical import module_axis
 
-def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp",
-                   remat: bool = False):
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x,
+                   axis: Optional[str] = None, remat: bool = False):
     """Run a P-stage pipeline over microbatches inside shard_map.
 
     Args:
@@ -43,6 +45,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, axis: str = "pp",
     Returns [M, ...out_shape]: outputs of the final stage, replicated via
     a final broadcast psum so every chip returns the same value.
     """
+    axis = module_axis("stage", axis)
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
     size = lax.axis_size(axis)

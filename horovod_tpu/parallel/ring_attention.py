@@ -26,9 +26,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.ops.attention import NEG_INF
+from horovod_tpu.parallel.logical import module_axis
 
 
-def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
+def ring_attention(q, k, v, axis: Optional[str] = None, causal: bool = False,
                    scale: Optional[float] = None,
                    skip_dead_blocks: Optional[bool] = None):
     """Exact multi-head attention over a sequence-sharded mesh axis.
@@ -45,6 +46,7 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     explicit values exist for A/B and for CI on legacy runtimes, where
     the cond path is only legal under ``check_vma=False`` regions.
     """
+    axis = module_axis("seq", axis)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     size = lax.axis_size(axis)

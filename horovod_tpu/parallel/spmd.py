@@ -28,6 +28,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.common import state as _state
+from horovod_tpu.parallel.logical import DATA_AXIS
 
 # jax.shard_map is the public top-level API on current jax (with the
 # varying-manual-axes checker spelled ``check_vma``); older jax ships
@@ -56,7 +57,7 @@ def _default_mesh() -> Mesh:
     return st.mesh
 
 
-def axis_size(mesh: Optional[Mesh] = None, axis: str = "hvd") -> int:  # hvdlint: disable=HVD008 (LogicalMesh work list)
+def axis_size(mesh: Optional[Mesh] = None, axis: str = DATA_AXIS) -> int:
     mesh = mesh or _default_mesh()
     return mesh.shape[axis]
 
@@ -65,7 +66,7 @@ def spmd_fn(
     fn,
     *,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis_name: str = DATA_AXIS,
     in_specs: Any = P(),
     out_specs: Any = P(),
     # False BY DESIGN (not a leftover): this harness implements the
@@ -261,7 +262,7 @@ def spmd_run(
     fn,
     *args,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis_name: str = DATA_AXIS,
     in_specs: Any = P(),
     out_specs: Any = P(),
     check_vma: bool = False,
@@ -305,7 +306,7 @@ def spmd(
     fn=None,
     *,
     mesh: Optional[Mesh] = None,
-    axis_name: str = "hvd",  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis_name: str = DATA_AXIS,
     in_specs: Any = P(),
     out_specs: Any = P(),
     check_vma: bool = False,

@@ -23,6 +23,8 @@ from jax import lax
 
 from functools import partial
 
+from horovod_tpu.parallel.logical import module_axis
+
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def tp_region_input(x, axis: str):
@@ -95,14 +97,17 @@ tp_region_output.defvjp(_tp_out_fwd, _tp_out_bwd)
 sum_across = tp_region_output
 
 
-def column_parallel(x, w, b=None, axis: str = "tp",
+def column_parallel(x, w, b=None, axis: Optional[str] = None,
                     gather_output: bool = False):
     """y_local = x @ W_local where W is column-sharded [Din, Dout/P].
 
     No communication; each chip produces its slice of the output features.
     ``gather_output=True`` all-gathers feature slices (when the next layer
-    is not row-parallel).
+    is not row-parallel). ``axis=None`` resolves the tensor axis through
+    the bound :class:`~horovod_tpu.parallel.logical.LogicalMesh` (legacy
+    ``"tp"`` when none is bound).
     """
+    axis = module_axis("tensor", axis)
     y = x @ w
     if b is not None:
         y = y + b
@@ -111,7 +116,7 @@ def column_parallel(x, w, b=None, axis: str = "tp",
     return y
 
 
-def row_parallel(x, w, b=None, axis: str = "tp"):
+def row_parallel(x, w, b=None, axis: Optional[str] = None):
     """y = psum_p(x_local @ W_local) where W is row-sharded [Din/P, Dout]
     and x is feature-sharded to match a preceding column-parallel layer.
 
@@ -119,16 +124,18 @@ def row_parallel(x, w, b=None, axis: str = "tp"):
     once after the reduction. The sum rides :func:`tp_region_output` so
     gradients through it are exact (identity backward), not axis-size
     scaled."""
+    axis = module_axis("tensor", axis)
     y = tp_region_output(x @ w, axis)
     if b is not None:
         y = y + b
     return y
 
 
-def tp_mlp(x, w_up, b_up, w_down, b_down, axis: str = "tp",
+def tp_mlp(x, w_up, b_up, w_down, b_down, axis: Optional[str] = None,
            activation: Callable = jax.nn.gelu):
     """The canonical 2-layer TP block: column-parallel up (no comm), local
     activation, row-parallel down (one psum)."""
+    axis = module_axis("tensor", axis)
     h = activation(column_parallel(x, w_up, b_up, axis))
     return row_parallel(h, w_down, b_down, axis)
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.ops.attention import dot_product_attention
+from horovod_tpu.parallel.logical import module_axis
 
 
 def _seq_to_heads(x, axis: str):
@@ -31,7 +32,8 @@ def _heads_to_seq(x, axis: str):
     return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
+def ulysses_attention(q, k, v, axis: Optional[str] = None,
+                      causal: bool = False,
                       scale: Optional[float] = None,
                       attn_fn: Optional[Callable] = None,
                       use_flash: bool = False):
@@ -46,6 +48,7 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
     so causal flash here runs the packed at-or-below-diagonal grid —
     the truncated-K/V-traffic causal path — with no offset plumbing.
     """
+    axis = module_axis("seq", axis)
     size = lax.axis_size(axis)
     H = q.shape[2]
     if H % size != 0:

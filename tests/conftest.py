@@ -148,13 +148,17 @@ _SLOW_TESTS = {
     "test_bench.py::test_snapshot_stamp_in_record",
     "test_bench.py::test_lm_attention_auto_policy",
     "test_bench.py::test_overlap_and_bucket_stamps_in_record",
+    # ~25s whole-bench subprocess wrapper (a real LM lane + a degraded
+    # attempt-timeout run); stand-in: the parser-level --mesh
+    # canonicalization + mesh_cell pins in
+    # test_mesh_flag_canonicalizes_and_rejects_invalid (fast).
+    "test_bench.py::test_mesh_stamp_in_record",
     # 42s TF keras multi-process wrapper; its three TestMultiProcess
     # siblings are already slow-marked with the same justification
     # (single-process keras coverage stays fast).
     "test_tf_binding.py::TestMultiProcess::test_keras_lr_callbacks_and_load_model",
-    # 30s + 20s: the even-vocab (32/8) vocab-parallel xent pair; the
-    # harder ragged 28/8 pair (uneven shards) stays fast and covers the
-    # same chunk math.
+    # 30s + 20s: the even-vocab (32/8) vocab-parallel xent pair (the
+    # ragged 28/8 pair joined them in round 17 — see below).
     "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense[32-8]",
     "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense_in_region[32-8]",
     # 30s + 24s torch multi-process integration depth; test_ops[2] and
@@ -196,9 +200,14 @@ _SLOW_TESTS = {
     # tests (identity env, collectives through the launcher) stay fast,
     # and the restart-path CLI tests were already slow-marked.
     "test_launcher.py::TestCLI::test_launch_command_success",
-    # 22s: the in-region ragged-vocab grads variant; its through-
-    # boundary twin test_loss_and_grads_match_dense[28-8] (fast) runs
-    # the same chunk math and ragged shard geometry end-to-end.
+    # Round-17 re-budget (fast lane at ~900s > the 870s window): the
+    # ragged 28/8 pair joins its even 32/8 twin — the through-boundary
+    # variant had grown to 55s — so the whole vocab-parallel grads
+    # matrix is slow-lane/CI-gate. Fast stand-ins:
+    # test_loss_identical_on_every_rank (the vocab-parallel loss pin,
+    # every rank, stays fast) and the dense fused-CE matrix incl. the
+    # ragged 60/16 pad path (test_fused_ce_matches_dense).
+    "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense[28-8]",
     "test_xent.py::TestVocabParallel::test_loss_and_grads_match_dense_in_region[28-8]",
     # 12s 4-process launcher collective round-trip; test_identity_env
     # pins the in-process launcher plumbing fast, and the elastic e2e
@@ -209,6 +218,43 @@ _SLOW_TESTS = {
     # request, max_new=1) stay fast in both attention modes, and the
     # check.sh serve smoke re-pins greedy==lm_decode end-to-end.
     "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[gather]",
+    # Round-17 re-budget: the paged twin (21s) joins it on the same
+    # grounds — the other exactness classes keep both attention modes
+    # fast.
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[paged]",
+    # 35s + 38s whole-bench ab-prefix subprocess wrappers (each runs a
+    # cold AND a warm serve/fleet bench): stand-ins are the fast
+    # in-process prefix pins — test_serve_prefix.py TestEngineHits
+    # hit==cold==lm_decode and TestFleetPrefix co-location /
+    # redispatch-savings — and the check.sh prefix smoke, which runs
+    # the single-engine --ab-prefix contract end-to-end.
+    "test_serve_bench.py::TestServeBenchContract::test_ab_prefix_record_contract",
+    "test_serve_bench.py::TestFleetBenchContract::test_fleet_ab_prefix_record_contract",
+    # 13s np=2 torch multi-process ops: the torch TestMultiProcess
+    # matrix goes fully slow-lane, matching the tf-binding precedent
+    # (its whole TestMultiProcess class has been slow-marked for
+    # rounds) — single-process torch op/optimizer tests stay fast.
+    "test_torch_binding.py::TestMultiProcess::test_ops[2]",
+    # 8s: the lazy-admission hit-stream twin; the reserve variant stays
+    # fast and pins the same hit==cold==lm_decode exactness, and
+    # test_admission_counts_only_missed_pages keeps the lazy-path
+    # accounting fast.
+    "test_serve_prefix.py::TestEngineHits::test_hit_stream_bit_identical_to_cold_and_lm_decode[lazy]",
+    # 8s real wall-clock stall e2e (whole-job relaunch wrapper): the
+    # kill[1] e2e stays fast covering the supervision path, and
+    # test_native_core.py::TestStallDetection pins the watchdog
+    # mechanics fast.
+    "test_elastic.py::TestEndToEnd::test_stall_fault_terminates_via_watchdog",
+    # 8s + 7s + 6s + 6s rolling-update/stall composition depth: the
+    # core roll pin test_update_rolls_fleet_streams_stay_single_version
+    # stays fast (clean roll, per-stream single-version), the stranded/
+    # rebase/draining variants and the bounded-stall resume move to the
+    # slow lane with the real-worker and tcp variants already there;
+    # version-eligibility unit pins (TestRouter/TestRebase) stay fast.
+    "test_serve_fleet.py::TestVersionedRollingUpdate::test_stranded_version_restarts_from_scratch",
+    "test_serve_fleet.py::TestVersionedRollingUpdate::test_redispatch_rebases_only_onto_same_version",
+    "test_serve_fleet.py::TestVersionedRollingUpdate::test_updating_replica_stops_accepting_but_fleet_serves",
+    "test_serve_fleet.py::TestStallWatchdog::test_bounded_stall_resumes_without_watchdog",
     # 12s whole-tf.keras rewrap wrapper; the settings plumbing it pins
     # is asserted by the fast native-core knob tests, full run in CI.
     "test_review_regressions.py::test_tf_keras_rewrap_honors_new_settings",

@@ -1,0 +1,18 @@
+"""HVD008 negative, post-LogicalMesh shape: sharding expressed in
+LOGICAL axis names resolved through the rules table — no physical
+hvd/ici/dcn spelling anywhere, so the call site survives any mesh
+relayout. This is the idiom the hard-fail gate enforces."""
+
+from horovod_tpu.parallel.logical import DATA_AXIS, LogicalMesh, module_axis
+
+
+def batch_spec(lm: LogicalMesh):
+    return lm.spec("batch", "embed")
+
+
+def data_axis():
+    return module_axis("data")
+
+
+def legacy_axis_constant():
+    return DATA_AXIS

@@ -1,0 +1,16 @@
+"""HVD008 positive, post-LogicalMesh shape: a raw physical-axis literal
+passed where a LOGICAL axis name is expected. ``LogicalMesh.spec`` and
+``module_axis`` take logical names ("batch", "heads", ...) or role names
+("data", "tensor", ...); smuggling the physical spelling back in
+re-couples the call site to the mesh layout the rules table exists to
+hide."""
+
+from horovod_tpu.parallel.logical import LogicalMesh, module_axis
+
+
+def batch_spec(lm: LogicalMesh):
+    return lm.spec("hvd", None)  # EXPECT: HVD008
+
+
+def data_axis():
+    return module_axis("data", "hvd")  # EXPECT: HVD008

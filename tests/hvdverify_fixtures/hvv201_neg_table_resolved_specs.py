@@ -1,0 +1,38 @@
+"""HVV201 negative: every declared spec comes FROM the rules table
+(``lm.spec``), so the reconciliation is exact by construction — the
+idiom the LogicalMesh layer exists for."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, shmap  # noqa: F401
+
+EXPECT = ()
+
+
+def _lm():
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 4, "tp": 2}, devices=jax.devices()[:8])
+
+
+def SHARDINGS():
+    from tools.hvdverify.rules import ShardingSpec
+
+    lm = _lm()
+    return ShardingSpec(mesh=lm, entries=(
+        ("x", ("batch", "embed"), lm.spec("batch", "embed")),
+        ("w", ("embed", "mlp"), lm.spec("embed", "mlp")),
+        ("out", ("batch",), lm.spec("batch")),
+    ))
+
+
+def build():
+    lm = _lm()
+    tp = lm.role_axis("tensor")
+    fn = shmap(lambda x, w: lax.psum(x @ w, tp), lm.mesh,
+               in_specs=(lm.spec("batch", "embed"),
+                         lm.spec("embed", "mlp")),
+               out_specs=lm.spec("batch"))
+    return fn, (f32(8, 16), f32(16, 4))

@@ -1,0 +1,36 @@
+"""HVV201 negative: trailing-None spec spellings are sharding-identical
+(``P("dp")`` == ``P("dp", None)``), so a hand-padded declared spec
+still reconciles with the table's shorter resolution."""
+
+from tests.hvdverify_fixtures._common import P, f32, shmap
+
+EXPECT = ()
+
+
+def _lm():
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+def SHARDINGS():
+    from tools.hvdverify.rules import ShardingSpec
+
+    # Declared with an explicit trailing None; the table resolves
+    # ("batch", "embed") -> P("dp", None) -> same sharding.
+    return ShardingSpec(mesh=_lm(), entries=(
+        ("x", ("batch", "embed"), P("dp", None)),
+        ("y", ("batch",), P("dp", None)),
+    ))
+
+
+def build():
+    from jax import lax
+
+    lm = _lm()
+    dp = lm.role_axis("data")
+    fn = shmap(lambda x: lax.psum(x, dp), lm.mesh,
+               in_specs=P("dp", None), out_specs=P())
+    return fn, (f32(8, 16),)

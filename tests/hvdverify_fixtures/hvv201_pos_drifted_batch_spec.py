@@ -1,0 +1,37 @@
+"""HVV201 positive: the program claims its input is batch-sharded
+("batch" resolves to "dp" on this mesh) but declares a REPLICATED spec
+— the sharding drifted from the rules table. This is the fixture that
+fails without the layer: nothing except the table knows "batch" means
+P("dp") here."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, shmap
+
+EXPECT = ("HVV201",)
+
+
+def _lm():
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+def SHARDINGS():
+    from tools.hvdverify.rules import ShardingSpec
+
+    # Claims logical dims ("batch",) — the table resolves P("dp") —
+    # while the program actually declares P() (replicated): drift.
+    return ShardingSpec(mesh=_lm(), entries=(
+        ("x", ("batch",), P()),
+    ))
+
+
+def build():
+    lm = _lm()
+    dp = lm.role_axis("data")
+    fn = shmap(lambda x: lax.psum(x, dp), lm.mesh,
+               in_specs=P(), out_specs=P())
+    return fn, (f32(4, 8),)

@@ -1,0 +1,35 @@
+"""HVV201 positive: a raw PHYSICAL axis spelling ("hvd") passed where
+the rules table expects a LOGICAL dim name. The table cannot resolve
+it — the exact shape hvdlint's HVD008 regression fixture pins at the
+AST level, caught here at the spec-reconciliation level."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, shmap
+
+EXPECT = ("HVV201",)
+
+
+def _lm():
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+def SHARDINGS():
+    from tools.hvdverify.rules import ShardingSpec
+
+    # "hvd" is a physical axis, not a logical dim: unresolvable.
+    return ShardingSpec(mesh=_lm(), entries=(
+        ("x", ("hvd",), P("dp")),
+    ))
+
+
+def build():
+    lm = _lm()
+    dp = lm.role_axis("data")
+    fn = shmap(lambda x: lax.psum(x, dp), lm.mesh,
+               in_specs=P("dp"), out_specs=P())
+    return fn, (f32(8, 4),)

@@ -1,0 +1,28 @@
+"""HVV202 negative: the vocabulary is mesh-driven, not hardcoded — a
+LogicalMesh built on the legacy data axis ("hvd") defines that axis, so
+collectives over it are in-vocabulary."""
+
+import jax
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, shmap
+
+EXPECT = ()
+
+
+def _lm():
+    from horovod_tpu.parallel.logical import DATA_AXIS, LogicalMesh
+
+    return LogicalMesh({DATA_AXIS: 8}, devices=jax.devices()[:8])
+
+
+def LOGICAL_MESH():
+    return _lm()
+
+
+def build():
+    lm = _lm()
+    ax = lm.role_axis("data")
+    fn = shmap(lambda x: lax.pmean(x, ax), lm.mesh,
+               in_specs=P(ax), out_specs=P())
+    return fn, (f32(8, 4),)

@@ -1,0 +1,36 @@
+"""HVV202 negative: every collective axis and every constraint axis is
+in the bound LogicalMesh's vocabulary — the composed dp×tp idiom."""
+
+import jax
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32
+
+EXPECT = ()
+
+
+def _lm():
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 4, "tp": 2}, devices=jax.devices()[:8])
+
+
+def LOGICAL_MESH():
+    return _lm()
+
+
+def build():
+    from tests.hvdverify_fixtures._common import shmap
+
+    lm = _lm()
+    sh = jax.sharding.NamedSharding(lm.mesh, lm.spec("batch"))
+
+    def body(x):
+        return lax.psum(x, lm.role_axis("tensor"))
+
+    inner = shmap(body, lm.mesh, in_specs=P("dp", "tp"), out_specs=P("dp"))
+
+    def fn(x):
+        return jax.lax.with_sharding_constraint(inner(x), sh)
+
+    return fn, (f32(8, 4),)

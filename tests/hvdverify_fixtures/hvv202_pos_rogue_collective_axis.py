@@ -1,0 +1,25 @@
+"""HVV202 positive: a collective over a shard_map-bound axis the bound
+LogicalMesh does not define. The program traces fine — the enclosing
+shard_map binds "rogue" — which is exactly why HVV102 cannot catch the
+smuggled physical spelling; only the vocabulary check can."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV202",)
+
+
+def LOGICAL_MESH():
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+def build():
+    m = mesh(rogue=8)
+    fn = shmap(lambda x: lax.psum(x, "rogue"), m,
+               in_specs=P("rogue"), out_specs=P())
+    return fn, (f32(8, 4),)

@@ -1,0 +1,26 @@
+"""HVV202 positive: a ``with_sharding_constraint`` spelling a mesh axis
+the bound LogicalMesh does not define. Constraints never show up in the
+collective schedule, so this is the one place the rogue spelling is
+visible statically."""
+
+import jax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh
+
+EXPECT = ("HVV202",)
+
+
+def LOGICAL_MESH():
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    return LogicalMesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+def build():
+    m = mesh(rogue=8)
+    sh = jax.sharding.NamedSharding(m, P("rogue"))
+
+    def fn(x):
+        return jax.lax.with_sharding_constraint(x * 2.0, sh)
+
+    return fn, (f32(8, 4),)

@@ -1,0 +1,33 @@
+"""HVV203 negative: the reference spells the data axis the legacy way
+("hvd") while the composed stack uses the registry's "dp" —
+``axis_map`` bridges the rename and the schedules still match."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+
+def _ref():
+    from horovod_tpu.parallel.logical import DATA_AXIS
+
+    m = mesh(**{DATA_AXIS: 8})
+    fn = shmap(lambda g: lax.psum(g, DATA_AXIS), m,
+               in_specs=P(DATA_AXIS), out_specs=P())
+    return fn, (f32(8, 4),)
+
+
+def EQUIVALENCE():
+    from horovod_tpu.parallel.logical import DATA_AXIS
+    from tools.hvdverify.rules import EquivalenceSpec
+
+    return [EquivalenceSpec(reference=_ref, axes=("dp",),
+                            axis_map={"dp": DATA_AXIS}, name="dp_ref")]
+
+
+def build():
+    m = mesh(dp=8)
+    fn = shmap(lambda g: lax.psum(g, "dp"), m,
+               in_specs=P("dp"), out_specs=P())
+    return fn, (f32(8, 4),)

@@ -1,0 +1,49 @@
+"""HVV203 negative: a dp×tp composed stack whose per-axis collectives
+are op-identical to both single-strategy references — composition
+through the rules table changed nothing on the wire."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ()
+
+_B, _E = 8, 16
+
+
+def _step(x, tp_ax, dp_ax):
+    y = lax.psum(x, tp_ax)          # tensor-parallel reduction
+    return lax.pmean(y, dp_ax)      # data-parallel average
+
+
+def _tp_ref():
+    # Same local shape as the composed program: batch already divided
+    # by dp=2.
+    m = mesh(tp=4)
+    fn = shmap(lambda x: lax.psum(x, "tp"), m,
+               in_specs=P(None, "tp"), out_specs=P())
+    return fn, (f32(_B // 2, _E),)
+
+
+def _dp_ref():
+    # Local shape: the embed dim already divided by tp=4.
+    m = mesh(dp=2)
+    fn = shmap(lambda y: lax.pmean(y, "dp"), m,
+               in_specs=P("dp"), out_specs=P("dp"))
+    return fn, (f32(_B, _E // 4),)
+
+
+def EQUIVALENCE():
+    from tools.hvdverify.rules import EquivalenceSpec
+
+    return [
+        EquivalenceSpec(reference=_tp_ref, axes=("tp",), name="tp_ref"),
+        EquivalenceSpec(reference=_dp_ref, axes=("dp",), name="dp_ref"),
+    ]
+
+
+def build():
+    m = mesh(dp=2, tp=4)
+    fn = shmap(lambda x: _step(x, "tp", "dp"), m,
+               in_specs=P("dp", "tp"), out_specs=P("dp"))
+    return fn, (f32(_B, _E),)

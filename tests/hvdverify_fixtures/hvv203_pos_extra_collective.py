@@ -1,0 +1,32 @@
+"""HVV203 positive: the composed stack issues an EXTRA collective over
+the tensor axis (a second psum the per-module reference never traces) —
+a count mismatch against the reference schedule."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV203",)
+
+_E = 8
+
+
+def _ref():
+    m = mesh(tp=2)
+    fn = shmap(lambda x: lax.psum(x, "tp"), m,
+               in_specs=P(None, "tp"), out_specs=P())
+    return fn, (f32(4, _E),)
+
+
+def EQUIVALENCE():
+    from tools.hvdverify.rules import EquivalenceSpec
+
+    return [EquivalenceSpec(reference=_ref, axes=("tp",), name="tp_ref")]
+
+
+def build():
+    m = mesh(tp=2)
+    # Composition bug: the partial sum is psummed twice.
+    fn = shmap(lambda x: lax.psum(lax.psum(x, "tp"), "tp"), m,
+               in_specs=P(None, "tp"), out_specs=P())
+    return fn, (f32(4, _E),)

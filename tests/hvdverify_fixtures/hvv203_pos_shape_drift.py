@@ -1,0 +1,32 @@
+"""HVV203 positive: the composed stack psums the WRONG local shape —
+same op count, same kind and axis, but the per-shard payload drifted
+from the per-module reference (op-key shape mismatch at op #0)."""
+
+from jax import lax
+
+from tests.hvdverify_fixtures._common import P, f32, mesh, shmap
+
+EXPECT = ("HVV203",)
+
+
+def _ref():
+    # Reference reduces the full [4, 8] local block.
+    m = mesh(tp=2)
+    fn = shmap(lambda x: lax.psum(x, "tp"), m,
+               in_specs=P(None, "tp"), out_specs=P())
+    return fn, (f32(4, 16),)
+
+
+def EQUIVALENCE():
+    from tools.hvdverify.rules import EquivalenceSpec
+
+    return [EquivalenceSpec(reference=_ref, axes=("tp",), name="tp_ref")]
+
+
+def build():
+    # Composed drops half the block before the exchange: psum payload
+    # is [2, 8] instead of the reference's [4, 8].
+    m = mesh(tp=2)
+    fn = shmap(lambda x: lax.psum(x[:2], "tp"), m,
+               in_specs=P(None, "tp"), out_specs=P())
+    return fn, (f32(4, 16),)

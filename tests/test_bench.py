@@ -304,6 +304,57 @@ def test_snapshot_stamp_in_record():
     assert out_off["snapshot"] is None
 
 
+def test_mesh_flag_canonicalizes_and_rejects_invalid():
+    """--mesh is parsed through the logical-axis vocabulary at argparse
+    time: any axis order canonicalizes to the registry's spelling
+    ('tp=4,dp=8' and 'dp=8,tp=4' stamp identically), an invalid config
+    is a usage error (exit 2, the supervisor's fail-fast class) rather
+    than a mid-run crash, and the perf_summary mesh column renders the
+    stamp (em-dash for unconfigured/pre-registry records)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mesh_mod", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    parser = bench.build_parser()
+    assert parser.parse_args(["--mesh", "tp=4,dp=8"]).mesh == "dp=8,tp=4"
+    assert parser.parse_args([]).mesh is None
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--mesh", "dp=banana"])
+
+    from tools.perf_summary import mesh_cell
+
+    assert mesh_cell({"mesh": "dp=8,tp=4"}) == "dp=8,tp=4"
+    assert mesh_cell({"mesh": None}) == "—"
+    assert mesh_cell({}) == "—"
+
+
+def test_mesh_stamp_in_record():
+    """--mesh stamps the canonical config into the JSON record, and a
+    record without the flag carries an explicit null — degraded error
+    records included, so a mesh-configured lane that dies still says
+    what stack it ran under."""
+    out, _ = _run_bench(
+        "--model", "transformer_lm", "--mesh", "tp=2,dp=4",
+        "--batch-size", "2", "--seq-len", "64", "--vocab", "256",
+        "--lm-layers", "1", "--lm-dim", "32", "--lm-heads", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1")
+    assert out["mesh"] == "dp=4,tp=2"
+    assert out["value"] > 0
+    # Unconfigured + degraded: the supervisor's error record carries
+    # the explicit null (same attempt-timeout shape as
+    # test_hung_backend_degrades_to_error_json, kept to one attempt).
+    degraded, _ = _run_bench(
+        "--batch-size", "2", "--image-size", "64",
+        extra_env={"HVD_BENCH_ATTEMPTS": "1",
+                   "HVD_BENCH_ATTEMPT_TIMEOUT": "1",
+                   "HVD_BENCH_BACKOFF": "0.1"})
+    assert degraded["value"] is None
+    assert degraded["mesh"] is None
+
+
 def test_compile_only_lane_contract():
     """--compile-only (the sweep's *_warm lanes): one first step, metric
     <model>_first_step_secs, vs_baseline null — the warm-cache pass big

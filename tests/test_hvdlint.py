@@ -140,20 +140,21 @@ def test_select_filters_rules():
     assert lint_file(path, select=["HVD004"])
 
 
-def test_hvd008_path_exemption():
-    """parallel/mesh.py and common/config.py OWN axis naming: HVD008 is
-    path-exempt there (PATH_EXEMPT in rules.py) and fires anywhere
-    else, while other rules still apply to the exempt files."""
+def test_hvd008_has_no_path_exemption():
+    """The LogicalMesh layer made HVD008 a hard regression gate: the
+    former parallel/mesh.py + common/config.py carve-out is GONE from
+    PATH_EXEMPT (only logical.py's three vocabulary constants carry a
+    justified inline suppression). The rule now fires everywhere,
+    including the formerly-exempt files."""
+    from tools.hvdlint.rules import PATH_EXEMPT
+
+    assert "HVD008" not in PATH_EXEMPT
     src = 'AXES = ("hvd", "ici")\n'
-    hits = [f for f in lint_source(src, "horovod_tpu/parallel/spmd.py")
-            if f.rule == "HVD008"]
-    assert len(hits) == 2, hits
-    assert lint_source(src, "horovod_tpu/parallel/mesh.py") == []
-    assert lint_source(src, "horovod_tpu/common/config.py") == []
-    # Exemption is per-rule, not per-file: HVD004 still fires in mesh.py.
-    cls = "class H:\n    def __del__(self):\n        pass\n"
-    assert any(f.rule == "HVD004" for f in
-               lint_source(cls, "horovod_tpu/parallel/mesh.py"))
+    for path in ("horovod_tpu/parallel/spmd.py",
+                 "horovod_tpu/parallel/mesh.py",
+                 "horovod_tpu/common/config.py"):
+        hits = [f for f in lint_source(src, path) if f.rule == "HVD008"]
+        assert len(hits) == 2, (path, hits)
 
 
 def test_hvd013_path_exemption():

@@ -4,11 +4,14 @@ the negatives, and the repo's real program registry must sweep clean.
 
 Fixture contract: each module defines ``build() -> (fn, args)`` plus an
 ``EXPECT`` tuple of rule ids (empty for ``*_neg_*`` files), with
-optional ``FORBID_DONATION``/``FORBID_DONATION_WHY`` and ``RECONCILE``
-(zero-arg callable -> ReconcileSpec). The corpus includes the two named
-incidents: the PR-3 ring-attention rotation-inside-the-rank-divergent-
-cond shape (hvv101_pos_ring_rotation_in_cond) and the PR-5 elastic
-donating-window variant (hvv104_pos_elastic_donating_window).
+optional ``FORBID_DONATION``/``FORBID_DONATION_WHY`` and zero-arg
+callables ``RECONCILE`` (-> ReconcileSpec), ``SHARDINGS``
+(-> ShardingSpec, HVV201), ``LOGICAL_MESH`` (-> LogicalMesh, HVV202)
+and ``EQUIVALENCE`` (-> [EquivalenceSpec], HVV203). The corpus includes
+the two named incidents: the PR-3 ring-attention rotation-inside-the-
+rank-divergent-cond shape (hvv101_pos_ring_rotation_in_cond) and the
+PR-5 elastic donating-window variant
+(hvv104_pos_elastic_donating_window).
 """
 
 import importlib
@@ -47,11 +50,17 @@ def _load(path: Path):
 def _verify_fixture(mod, name):
     fn, args = mod.build()
     reconcile = getattr(mod, "RECONCILE", None)
+    shardings = getattr(mod, "SHARDINGS", None)
+    logical_mesh = getattr(mod, "LOGICAL_MESH", None)
+    equivalence = getattr(mod, "EQUIVALENCE", None)
     return verify(
         fn, args, name=name,
         forbid_donation=getattr(mod, "FORBID_DONATION", False),
         forbid_donation_why=getattr(mod, "FORBID_DONATION_WHY", ""),
-        reconcile=reconcile() if reconcile else None)
+        reconcile=reconcile() if reconcile else None,
+        shardings=shardings() if shardings else None,
+        logical_mesh=logical_mesh() if logical_mesh else None,
+        equivalence=equivalence() if equivalence else None)
 
 
 @pytest.mark.parametrize("path", _fixture_modules(),
@@ -76,7 +85,8 @@ def test_fixture(path, hvd):
 
 def test_corpus_covers_every_rule_both_ways():
     """>= 2 positive and >= 2 negative fixtures per rule (the ISSUE's
-    corpus floor), counting hvv10X-prefixed files."""
+    corpus floor), counting hvv-prefixed files — the HVV2xx sharding
+    rules included."""
     for rule in RULES:
         prefix = rule.lower()
         pos = list(FIXTURES.glob(f"{prefix}_pos_*.py"))
@@ -121,6 +131,15 @@ def test_registry_shape():
     assert {p.name for p in serve} == {"serve.step", "serve.step_paged"}
     assert all(p.forbid_donation for p in serve)
     assert all(p.reconcile is not None for p in by_group["optimizer"])
+    # The composed-stack lanes (logical-axis registry): each carries
+    # the full HVV2xx surface — a sharding table, a bound LogicalMesh
+    # and per-module equivalence references.
+    composed = by_group["composed"]
+    assert {p.name for p in composed} == {
+        "composed.dp_tp", "composed.dp_ulysses", "composed.tp_pp"}
+    assert all(p.shardings is not None for p in composed)
+    assert all(p.logical_mesh is not None for p in composed)
+    assert all(p.equivalence is not None for p in composed)
 
 
 def test_repo_sweep_core_is_clean(hvd):

@@ -1,0 +1,402 @@
+"""The logical-axis sharding layer (horovod_tpu/parallel/logical.py):
+LogicalMesh resolution semantics, the canonical config string, the
+bind()/module_axis thin-shim contract — and the ISSUE-17 acceptance
+pins: composed stacks (dp x tp, dp x sp ulysses, tp x pp) built through
+the registry must be BIT-EXACT against the pre-registry per-module
+paths on the 8-way virtual CPU mesh, and the int8-EF/ZeRO state
+sharding specs that now flow through the rules table must be unchanged
+vs PR-10."""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.parallel as par
+from horovod_tpu.common.exceptions import InvalidArgumentError
+from horovod_tpu.parallel.logical import (
+    DATA_AXIS,
+    DEFAULT_RULES,
+    LogicalMesh,
+    bind,
+    current_logical_mesh,
+    format_mesh_config,
+    logical_partition_specs,
+    module_axis,
+    parse_mesh_config,
+)
+
+
+# ------------------------------------------------------------ config string
+
+
+class TestMeshConfig:
+    def test_parse_roundtrip_canonicalizes_order(self):
+        axes = parse_mesh_config("tp=4,dp=8,sp=2")
+        assert axes == {"tp": 4, "dp": 8, "sp": 2}
+        assert format_mesh_config(axes) == "dp=8,tp=4,sp=2"
+
+    def test_unknown_axes_sort_after_known(self):
+        assert (format_mesh_config({"zz": 2, "tp": 4})
+                == "tp=4,zz=2")
+
+    @pytest.mark.parametrize("bad", [
+        "", "dp", "dp=banana", "dp=0", "dp=2,dp=4", "2=dp"])
+    def test_invalid_configs_raise(self, bad):
+        with pytest.raises(InvalidArgumentError):
+            parse_mesh_config(bad)
+
+
+# ----------------------------------------------------------- LogicalMesh
+
+
+class TestLogicalMesh:
+    def test_spec_resolves_through_rules_table(self, hvd):
+        lm = LogicalMesh({"dp": 4, "tp": 2})
+        assert lm.spec("batch") == P("dp")
+        assert lm.spec("heads") == P("tp")
+        assert lm.spec("mlp") == P("tp")
+        assert lm.spec("batch", None, "heads") == P("dp", None, "tp")
+        # Rules mapping to None, or to axes this mesh lacks, replicate.
+        assert lm.spec("kv") == P(None)
+        assert lm.spec("embed") == P(None)
+        assert lm.spec("seq") == P(None)
+        assert lm.spec() == P()
+
+    def test_first_defined_rule_wins(self, hvd):
+        # batch tries dp first, then the flat harness axis: on a
+        # DATA_AXIS-only mesh the fallback rule resolves.
+        lm = LogicalMesh({DATA_AXIS: 8})
+        assert lm.spec("batch") == P(DATA_AXIS)
+        assert lm.role_axis("data") == DATA_AXIS
+
+    def test_unknown_logical_axis_raises(self, hvd):
+        lm = LogicalMesh({"dp": 8})
+        with pytest.raises(InvalidArgumentError, match="rules table"):
+            lm.spec("hvd")  # raw physical axis where a logical name goes
+
+    def test_duplicate_physical_mapping_raises(self, hvd):
+        lm = LogicalMesh({"dp": 4, "tp": 2})
+        with pytest.raises(InvalidArgumentError, match="more than one"):
+            lm.spec("heads", "mlp")  # both resolve to tp
+
+    def test_config_and_defines(self, hvd):
+        lm = LogicalMesh.from_config("tp=2,dp=4")
+        assert lm.config == "dp=4,tp=2"
+        assert lm.defines("dp") and lm.defines("tp")
+        assert not lm.defines("sp") and not lm.defines(DATA_AXIS)
+
+    def test_wildcard_axis(self, hvd):
+        lm = LogicalMesh({"dp": -1, "tp": 2},
+                         devices=jax.devices()[:8])
+        assert lm.axes == {"dp": 4, "tp": 2}
+
+    def test_virtual_submesh_prefix(self, hvd):
+        # dp=2,tp=2 on 8 exposed devices: a 4-device prefix sub-mesh.
+        lm = LogicalMesh({"dp": 2, "tp": 2})
+        assert math.prod(lm.axes.values()) == 4
+        assert lm.mesh.devices.size == 4
+
+    def test_custom_rules_table(self, hvd):
+        rules = tuple(r for r in DEFAULT_RULES if r[0] != "embed") + (
+            ("embed", "tp"),)
+        lm = LogicalMesh({"dp": 4, "tp": 2}, rules=rules)
+        assert lm.spec("embed") == P("tp")
+
+    def test_logical_partition_specs_tree(self, hvd):
+        lm = LogicalMesh({"dp": 4, "tp": 2})
+        tree = {"x": ("batch", "embed"), "w": ("embed", "mlp")}
+        specs = logical_partition_specs(tree, lm)
+        assert specs == {"x": P("dp", None), "w": P(None, "tp")}
+        with pytest.raises(InvalidArgumentError, match="bind"):
+            logical_partition_specs(tree)
+
+
+# -------------------------------------------------- bind() / module_axis
+
+
+class TestModuleAxis:
+    def test_unbound_legacy_fallbacks(self):
+        assert current_logical_mesh() is None
+        assert module_axis("data") == DATA_AXIS
+        assert module_axis("tensor") == "tp"
+        assert module_axis("seq") == "sp"
+        assert module_axis("stage") == "pp"
+        assert module_axis("expert") == "ep"
+
+    def test_explicit_override_always_wins(self, hvd):
+        lm = LogicalMesh({"dp": 8})
+        with bind(lm):
+            assert module_axis("data", "my_axis") == "my_axis"
+
+    def test_bound_mesh_resolves_roles(self, hvd):
+        lm = LogicalMesh({"dp": 4, "tp": 2})
+        with bind(lm):
+            assert current_logical_mesh() is lm
+            assert module_axis("data") == "dp"
+            assert module_axis("tensor") == "tp"
+        assert current_logical_mesh() is None
+
+    def test_bound_mesh_without_role_axis_raises(self, hvd):
+        lm = LogicalMesh({"dp": 8})
+        with bind(lm):
+            with pytest.raises(InvalidArgumentError, match="role"):
+                module_axis("tensor")
+
+    def test_bind_nests_innermost_wins(self, hvd):
+        outer = LogicalMesh({"dp": 8})
+        inner = LogicalMesh({"tp": 8})
+        with bind(outer):
+            with bind(inner):
+                assert module_axis("tensor") == "tp"
+            assert module_axis("data") == "dp"
+
+
+# ----------------------------------------- composed-stack equivalence pins
+#
+# The tentpole acceptance: stacks composed THROUGH the registry (bound
+# LogicalMesh, axis defaults resolved by module_axis, in/out specs from
+# lm.spec) must reproduce the pre-registry per-module paths (raw
+# make_mesh + hand-spelled axis literals) bit-for-bit. np.array_equal,
+# not allclose: the shims resolve to the same axis names before any
+# tracing happens, so the compiled programs are identical.
+
+from horovod_tpu.models import parallel_lm as plm  # noqa: E402
+
+V, LMAX, LAYERS, H, DH, FFN = 32, 32, 4, 4, 8, 16
+B, L = 4, 16
+
+
+@pytest.fixture(scope="module")
+def lm_setup():
+    rng = jax.random.PRNGKey(7)
+    params = plm.init_lm_params(rng, V, LMAX, LAYERS, H, DH, FFN)
+    tokens = jax.random.randint(jax.random.fold_in(rng, 1), (B, L), 0, V)
+    return params, tokens
+
+
+class TestComposedEquivalence:
+    def test_dp_tp_lm_bit_exact(self, hvd, lm_setup):
+        """dp x tp transformer_lm: registry-composed forward equals the
+        per-module path bit-for-bit."""
+        params, tokens = lm_setup
+
+        legacy_mesh = par.make_mesh({"dp": 4, "tp": 2})
+        legacy = jax.jit(jax.shard_map(
+            lambda p, t: plm.lm_apply(p, t, tp="tp"),
+            mesh=legacy_mesh,
+            in_specs=(plm.lm_param_specs(LAYERS, "tp"), P("dp", None)),
+            out_specs=P("dp", None, None)))(params, tokens)
+
+        lm = LogicalMesh.from_config("dp=4,tp=2")
+        with bind(lm):
+            tp_ax = module_axis("tensor")
+            composed = jax.jit(jax.shard_map(
+                lambda p, t: plm.lm_apply(p, t, tp=tp_ax),
+                mesh=lm.mesh,
+                in_specs=(plm.lm_param_specs(LAYERS, tp_ax),
+                          lm.spec("batch")),
+                out_specs=lm.spec("batch", None, None)))(params, tokens)
+
+        assert np.array_equal(np.asarray(composed), np.asarray(legacy))
+
+    def test_dp_ulysses_lm_bit_exact(self, hvd, lm_setup):
+        """dp x sp(ulysses) on the LM's own q/k/v: the registry-composed
+        ulysses attention (axis resolved from the bound mesh) equals the
+        explicit-axis per-module path bit-for-bit."""
+        params, tokens = lm_setup
+        # Real transformer_lm activations: the first layer's projected
+        # q/k/v at the dense path's values.
+        x = params["embed"][tokens] + params["pos"][None, :L]
+        q, k, v = plm._project_qkv(params["layers"][0], x, None)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+        legacy_mesh = par.make_mesh({"dp": 2, "sp": 4})
+        legacy = jax.jit(jax.shard_map(
+            lambda a, b, c: par.ulysses_attention(
+                a, b, c, axis="sp", causal=True, scale=scale),
+            mesh=legacy_mesh,
+            in_specs=(P("dp", "sp"),) * 3,
+            out_specs=P("dp", "sp")))(q, k, v)
+
+        lm = LogicalMesh.from_config("dp=2,sp=4")
+        with bind(lm):
+            composed = jax.jit(jax.shard_map(
+                lambda a, b, c: par.ulysses_attention(
+                    a, b, c, causal=True, scale=scale),
+                mesh=lm.mesh,
+                in_specs=(lm.spec("batch", "seq"),) * 3,
+                out_specs=lm.spec("batch", "seq")))(q, k, v)
+
+        assert np.array_equal(np.asarray(composed), np.asarray(legacy))
+
+    def test_tp_pp_lm_bit_exact(self, hvd, lm_setup):
+        """tp x pp transformer_lm: one tp-sharded transformer block per
+        pipeline stage, composed through the registry (pipeline axis AND
+        tensor axis from the bound mesh) vs explicit literals."""
+        params, tokens = lm_setup
+        rest, stacked = plm.stack_layers(params)
+
+        from horovod_tpu.ops.attention import dot_product_attention
+
+        def stage(tp_ax, layer, a):
+            q, kk, vv = plm._project_qkv(layer, a, tp_ax)
+            scale = 1.0 / math.sqrt(q.shape[-1])
+            attn = dot_product_attention(q, kk, vv, causal=True,
+                                         scale=scale)
+            a = plm._attn_out_residual(layer, attn, a, tp_ax)
+            return plm._ffn_residual(layer, a, tp_ax)
+
+        def run(pp_ax, tp_ax, re, st, t):
+            x = re["embed"][t] + re["pos"][None, :L]
+            xm = x.reshape(2, B // 2, L, x.shape[-1])
+            out = par.pipeline_apply(functools.partial(stage, tp_ax),
+                                     st, xm, axis=pp_ax)
+            return plm._logits(re, out.reshape(B, L, x.shape[-1]))
+
+        def stacked_specs(pp_ax, tp_ax):
+            per_layer = plm.lm_param_specs(1, tp_ax)["layers"][0]
+
+            def lead(s):
+                return P(pp_ax, *s)
+
+            return {k: ({kk: lead(vv) for kk, vv in v.items()}
+                        if isinstance(v, dict) else lead(v))
+                    for k, v in per_layer.items()}
+
+        rest_specs = {k: (P() if not isinstance(v, dict)
+                          else {kk: P() for kk in v})
+                      for k, v in rest.items()}
+
+        legacy_mesh = par.make_mesh({"tp": 2, "pp": 4})
+        legacy = jax.jit(jax.shard_map(
+            functools.partial(run, "pp", "tp"), mesh=legacy_mesh,
+            in_specs=(rest_specs, stacked_specs("pp", "tp"), P()),
+            out_specs=P()))(rest, stacked, tokens)
+
+        lm = LogicalMesh.from_config("tp=2,pp=4")
+        with bind(lm):
+            tp_ax = module_axis("tensor")
+            pp_ax = module_axis("stage")
+            composed = jax.jit(jax.shard_map(
+                # axis=None inside: pipeline_apply resolves "stage"
+                # from the bound mesh at trace time.
+                functools.partial(run, None, tp_ax), mesh=lm.mesh,
+                in_specs=(rest_specs, stacked_specs(pp_ax, tp_ax), P()),
+                out_specs=lm.spec()))(rest, stacked, tokens)
+
+        assert np.array_equal(np.asarray(composed), np.asarray(legacy))
+
+    def test_dp_tp_matches_dense_single_device(self, hvd, lm_setup):
+        """The composed stack is not just self-consistent: it reproduces
+        the dense single-device math (fp32 tolerance — the collective
+        reduction order differs from the dense einsum's)."""
+        params, tokens = lm_setup
+        dense = plm.lm_apply(params, tokens)
+        lm = LogicalMesh.from_config("dp=4,tp=2")
+        with bind(lm):
+            tp_ax = module_axis("tensor")
+            composed = jax.jit(jax.shard_map(
+                lambda p, t: plm.lm_apply(p, t, tp=tp_ax),
+                mesh=lm.mesh,
+                in_specs=(plm.lm_param_specs(LAYERS, tp_ax),
+                          lm.spec("batch")),
+                out_specs=lm.spec("batch", None, None)))(params, tokens)
+        np.testing.assert_allclose(np.asarray(composed),
+                                   np.asarray(dense),
+                                   rtol=2e-4, atol=2e-5)
+
+
+# -------------------------------------- EF/ZeRO state specs via the table
+
+
+class TestStateSpecsThroughRegistry:
+    @staticmethod
+    def _int8_ef_state(hvd):
+        """An int8-EF hierarchical train state with real residual leaves
+        (the ladder needs an inner domain > 1 to engage, same as
+        test_hierarchical's _inner_size discipline)."""
+        import contextlib
+
+        import optax
+
+        from horovod_tpu import models
+        from horovod_tpu.common import state as _state
+
+        @contextlib.contextmanager
+        def inner_size(inner):
+            st = _state.global_state()
+            saved = st.config.hierarchical_inner_size
+            st.config.hierarchical_inner_size = inner
+            try:
+                yield
+            finally:
+                st.config.hierarchical_inner_size = saved
+
+        with inner_size(4):
+            model = models.MNISTNet()
+            state, _ = models.create_train_state(
+                jax.random.PRNGKey(0), model,
+                optax.sgd(0.1, momentum=0.9),
+                jnp.zeros((1, 28, 28, 1)),
+                compression=hvd.Compression.int8, hierarchical="on")
+        return state
+
+    def test_int8_ef_residual_specs_unchanged_vs_pr10(self, hvd):
+        """models.state_partition_specs consults the registry for the
+        data axis; unbound, the int8-EF residual specs must be exactly
+        PR-10's P(DATA_AXIS) — and every other leaf spec is unchanged
+        too (the whole spec tree is compared, not just residuals)."""
+        from horovod_tpu import models
+        from horovod_tpu.jax.optimizer import ef_state_partition_specs
+
+        state = self._int8_ef_state(hvd)
+        spec = models.state_partition_specs(state)
+        # PR-10 contract: rank-local residual leaves shard over the
+        # flat harness axis, everything else replicates.
+        expected = ef_state_partition_specs(state["opt_state"],
+                                            axis_name=DATA_AXIS)
+        got = ef_state_partition_specs(state["opt_state"])
+        assert jax.tree_util.tree_structure(expected) \
+            == jax.tree_util.tree_structure(got)
+        assert jax.tree_util.tree_leaves(expected) \
+            == jax.tree_util.tree_leaves(got)
+        leaves = jax.tree_util.tree_leaves(spec)
+        assert P(DATA_AXIS) in leaves
+        assert set(leaves) <= {P(), P(DATA_AXIS)}
+
+    def test_state_specs_follow_bound_mesh(self, hvd):
+        """With a dp-stack LogicalMesh bound, the same state's specs
+        resolve through the rules table to the stack's data axis."""
+        from horovod_tpu import models
+
+        state = self._int8_ef_state(hvd)
+        lm = LogicalMesh({"dp": 8})
+        with bind(lm):
+            spec = models.state_partition_specs(state)
+        leaves = jax.tree_util.tree_leaves(spec)
+        assert P("dp") in leaves
+        assert P(DATA_AXIS) not in leaves
+
+    def test_zero_state_specs_follow_bound_mesh(self, hvd):
+        """sharded_distributed_optimizer's scatter specs resolve the
+        data axis the same way (zero.state_partition_specs)."""
+        import optax
+
+        from horovod_tpu.jax import zero
+
+        params = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((4,))}
+        opt = zero.sharded_distributed_optimizer(optax.adam(1e-3))
+        opt_state = opt.init(params)
+        unbound = zero.state_partition_specs(opt_state)
+        assert P(DATA_AXIS) in jax.tree_util.tree_leaves(unbound)
+        lm = LogicalMesh({"dp": 8})
+        with bind(lm):
+            bound = zero.state_partition_specs(opt_state)
+        leaves = jax.tree_util.tree_leaves(bound)
+        assert P("dp") in leaves
+        assert P(DATA_AXIS) not in leaves

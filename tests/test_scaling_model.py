@@ -131,3 +131,13 @@ def test_efficiency_table_renders_markdown():
     assert lines[0].startswith("| model | buckets | grad MB | step ms |")
     assert len(lines) == 3
     assert "resnet50" in lines[2] and "%" in lines[2]
+
+
+def test_efficiency_table_mesh_chip_restriction():
+    """--mesh restricts the ladder to the config's device product: one
+    prediction column at exactly that chip count, not the full sweep."""
+    table = efficiency_table(DEFAULT_FUSION_THRESHOLD,
+                             models=["resnet50"], chips=[32])
+    header = table.splitlines()[0]
+    assert header.endswith("| 32c |")
+    assert header.count("c |") == 1

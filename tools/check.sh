@@ -8,8 +8,10 @@
 #                               hvdlint fixture/suppression test suite +
 #                               the hvdverify rule fixtures + fast-group
 #                               registry sweep (optimizer/dp/parallel/
-#                               elastic/serve programs at zero
-#                               unsuppressed findings) +
+#                               composed/elastic/serve programs at zero
+#                               unsuppressed findings — the composed
+#                               lanes carry the HVV2xx logical-axis
+#                               sharding checks) +
 #                               the elastic fault-injection smoke (real
 #                               `hvdrun --elastic` jobs: rank 1 lost to a
 #                               HOROVOD_FAULT_PLAN SIGKILL mid-run must
@@ -59,7 +61,9 @@
 #   tools/check.sh --verify     additionally run the FULL hvdverify sweep
 #                               (`python -m tools.hvdverify --sweep`): all
 #                               registry programs incl. the 9 driver gate
-#                               lanes traced at zero unsuppressed findings
+#                               lanes and the composed.dp_tp/dp_ulysses/
+#                               tp_pp logical-axis stacks traced at zero
+#                               unsuppressed findings
 #                               + the process-fleet smoke (the round-13
 #                               tentpole: the same 2-replica kill A/B
 #                               with --fleet-transport process — each

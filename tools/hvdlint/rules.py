@@ -627,18 +627,19 @@ def check_hvd007(tree: ast.AST) -> List[RawFinding]:
 
 # ----------------------------------------------------------------- HVD008
 
-#: The mesh-axis names the repo's modules currently hardcode. Scoped to
-#: the data-parallel / hierarchical axes (the ones every module spells
-#: identically today); the per-module axes ("tp"/"pp"/"sp"/"ep") are
-#: parameters already.
+#: The physical mesh-axis names the repo once hardcoded everywhere.
+#: Scoped to the data-parallel / hierarchical axes (the ones every
+#: module used to spell identically); the per-module axes
+#: ("tp"/"pp"/"sp"/"ep") are parameters resolved through the
+#: LogicalMesh rules table.
 MESH_AXIS_LITERALS = {"hvd", "ici", "dcn"}  # hvdlint: disable=HVD008 (the rule owns its vocabulary)
 
-#: Path suffixes allowed to own axis-name literals: the mesh factory
-#: and the config surface — exactly where ROADMAP item 2's LogicalMesh
-#: refactor will centralize axis naming. Consumed by the engine
-#: (core.lint_source) since rules themselves see only the AST.
+#: Path suffixes allowed to own specific findings. Consumed by the
+#: engine (core.lint_source) since rules themselves see only the AST.
+#: HVD008 has NO entry: the axis vocabulary lives solely in
+#: parallel/logical.py's DATA_AXIS/ICI_AXIS/DCN_AXIS constants, whose
+#: three definitions carry the one justified suppression each.
 PATH_EXEMPT = {
-    "HVD008": ("parallel/mesh.py", "common/config.py"),
     # The allocator's own module is the single place allowed to call
     # the strict single-holder free() fast path (COW failure cleanup);
     # everyone else must go through refcounted release().
@@ -647,17 +648,18 @@ PATH_EXEMPT = {
 
 
 def check_hvd008(tree: ast.AST) -> List[RawFinding]:
-    """Hardcoded mesh-axis string literal outside the mesh/config layer:
-    a bare ``"hvd"``/``"ici"``/``"dcn"`` constant names a mesh axis at
-    the use site, so six parallel modules plus every harness must agree
-    on spellings by convention alone — the exact coupling the
-    LogicalMesh refactor (ROADMAP item 2) must unwind. Every finding
-    (or its justified suppression) is one site that refactor rewrites;
-    the suppression inventory IS the work list.
+    """Hardcoded mesh-axis string literal: a bare ``"hvd"``/``"ici"``/
+    ``"dcn"`` constant names a physical mesh axis at the use site, so
+    every module and harness must agree on spellings by convention
+    alone. The LogicalMesh layer (``parallel/logical.py``) unwound that
+    coupling: import ``DATA_AXIS``/``ICI_AXIS``/``DCN_AXIS`` or resolve
+    a logical axis through the rules table (``module_axis``,
+    ``LogicalMesh.spec``). This rule is a hard regression gate — there
+    is no path exemption; only logical.py's three constant definitions
+    carry a justified suppression.
 
     Only exact-match constants fire (a log message *containing* "hvd"
-    is not an axis name); ``parallel/mesh.py`` and ``common/config.py``
-    are path-exempt via ``PATH_EXEMPT`` — axis naming is their job.
+    is not an axis name).
     """
     findings: List[RawFinding] = []
     for node in ast.walk(tree):
@@ -667,11 +669,11 @@ def check_hvd008(tree: ast.AST) -> List[RawFinding]:
             continue
         findings.append(RawFinding(
             node.lineno, node.col_offset, "HVD008", "warning",
-            f"hardcoded mesh-axis literal '{node.value}' outside "
-            "parallel/mesh.py / common/config.py: axis naming by "
-            "string convention couples every module to every other; "
-            "route through the mesh factory / config (the LogicalMesh "
-            "refactor's work list, ROADMAP item 2)"))
+            f"hardcoded mesh-axis literal '{node.value}': axis naming "
+            "by string convention couples every module to every other; "
+            "import the constant from parallel/logical.py (DATA_AXIS/"
+            "ICI_AXIS/DCN_AXIS) or resolve a logical axis through the "
+            "LogicalMesh rules table"))
     return findings
 
 

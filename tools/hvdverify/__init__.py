@@ -19,6 +19,13 @@ wire bytes — is checked against the HVV rule catalogue
   snapshot-in-flight invariant).
 * **HVV105** — static wire-byte accounting must reconcile exactly with
   ``horovod_tpu.jax.fusion.plan_buckets``.
+* **HVV201** — declared in/out/param partition specs must reconcile
+  with the LogicalMesh axis-rules table (the sharding analogue of
+  HVV105).
+* **HVV202** — every collective / ``with_sharding_constraint`` axis
+  must be in the bound LogicalMesh's vocabulary.
+* **HVV203** — a composed stack's collective schedule must be
+  op-identical to its per-module reference traces.
 
 Usage::
 
@@ -44,7 +51,13 @@ from tools.hvdverify.registry import (
     abstractify,
     programs,
 )
-from tools.hvdverify.rules import RULES, Finding, ReconcileSpec
+from tools.hvdverify.rules import (
+    EquivalenceSpec,
+    Finding,
+    ReconcileSpec,
+    RULES,
+    ShardingSpec,
+)
 from tools.hvdverify.schedule import (
     COLLECTIVE_PRIMS,
     CollectiveOp,
@@ -56,6 +69,7 @@ from tools.hvdverify.schedule import (
 __all__ = [
     "COLLECTIVE_PRIMS",
     "CollectiveOp",
+    "EquivalenceSpec",
     "FAST_GROUPS",
     "Finding",
     "Program",
@@ -63,6 +77,7 @@ __all__ = [
     "RULES",
     "ReconcileSpec",
     "ScheduleWalker",
+    "ShardingSpec",
     "VerifiedProgram",
     "abstractify",
     "audit_collectives",

@@ -23,14 +23,20 @@ import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from tools.hvdverify.rules import (
+    EquivalenceSpec,
     Finding,
     ReconcileSpec,
+    ShardingSpec,
+    check_axis_vocabulary,
+    check_equivalence,
     check_reconciliation,
+    check_shardings,
     from_raw,
 )
 from tools.hvdverify.schedule import (
     CollectiveOp,
     ScheduleWalker,
+    sharding_constraint_refs,
     summarize,
 )
 
@@ -74,6 +80,9 @@ def verify(
     forbid_donation: bool = False,
     forbid_donation_why: str = "",
     reconcile: Optional[ReconcileSpec] = None,
+    shardings: Optional[ShardingSpec] = None,
+    logical_mesh: Any = None,
+    equivalence: Optional[Sequence[EquivalenceSpec]] = None,
     suppress: Optional[Dict[str, str]] = None,
 ) -> VerifiedProgram:
     """Trace ``fn(*args)`` and verify its collective schedule.
@@ -90,6 +99,14 @@ def verify(
     flight — donation would let XLA reuse a buffer the d2h copy is
     still reading): ANY donating call in the trace is an HVV104
     finding, not just use-after-donation.
+
+    The HVV2xx sharding pass: ``shardings`` (a :class:`ShardingSpec`)
+    reconciles declared partition specs against the LogicalMesh rules
+    table (HVV201); ``logical_mesh`` (a LogicalMesh) checks every
+    collective axis and ``with_sharding_constraint`` against the mesh's
+    vocabulary (HVV202); ``equivalence`` (a sequence of
+    :class:`EquivalenceSpec`) pins the composed schedule op-identical
+    to per-module reference traces (HVV203).
     """
     import jax
 
@@ -139,6 +156,18 @@ def verify(
         findings.extend(
             check_reconciliation(name, walker.schedule, reconcile))
 
+    if shardings is not None:
+        findings.extend(check_shardings(name, shardings))
+
+    if logical_mesh is not None:
+        findings.extend(check_axis_vocabulary(
+            name, walker.schedule, sharding_constraint_refs(closed),
+            logical_mesh))
+
+    if equivalence:
+        findings.extend(
+            check_equivalence(name, walker.schedule, equivalence))
+
     return VerifiedProgram(
         name=name,
         schedule=walker.schedule,
@@ -173,6 +202,11 @@ def verify_programs(programs) -> List[VerifiedProgram]:
             forbid_donation=prog.forbid_donation,
             forbid_donation_why=prog.forbid_donation_why,
             reconcile=prog.reconcile() if prog.reconcile else None,
+            shardings=prog.shardings() if prog.shardings else None,
+            logical_mesh=(prog.logical_mesh() if prog.logical_mesh
+                          else None),
+            equivalence=(prog.equivalence() if prog.equivalence
+                         else None),
             suppress=prog.suppress,
         ))
     return out

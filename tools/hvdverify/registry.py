@@ -23,6 +23,11 @@ structure). Groups:
                  (spmd collectives, tp, pipeline, ulysses,
                  ring_attention, moe), gradients included where the
                  module ships custom VJPs.
+* ``composed`` — LogicalMesh-composed stacks (dp x tp, dp x
+                 sp(ulysses), tp x pp) built entirely through the
+                 axis-rules table, with the full HVV2xx pass: sharding
+                 reconciliation (HVV201), axis vocabulary (HVV202) and
+                 per-module schedule equivalence (HVV203).
 * ``elastic``  — the PR-5 windowed loop program with the
                  no-donation-while-snapshot-in-flight invariant
                  enforced (``forbid_donation``).
@@ -44,7 +49,11 @@ import dataclasses
 import functools
 from typing import Callable, Dict, List, Optional, Tuple
 
-from tools.hvdverify.rules import ReconcileSpec
+from tools.hvdverify.rules import (
+    EquivalenceSpec,
+    ReconcileSpec,
+    ShardingSpec,
+)
 
 #: Virtual mesh size every program traces under (matches the test
 #: harness's 8-device CPU mesh, tests/conftest.py).
@@ -62,6 +71,15 @@ class Program:
     forbid_donation: bool = False
     forbid_donation_why: str = ""
     reconcile: Optional[Callable[[], ReconcileSpec]] = None
+    #: HVV201: zero-arg -> ShardingSpec reconciling the program's
+    #: declared partition specs against the LogicalMesh rules table.
+    shardings: Optional[Callable[[], ShardingSpec]] = None
+    #: HVV202: zero-arg -> the LogicalMesh whose vocabulary every
+    #: collective axis / sharding constraint must come from.
+    logical_mesh: Optional[Callable] = None
+    #: HVV203: zero-arg -> [EquivalenceSpec] pinning the composed
+    #: schedule op-identical to per-module reference traces.
+    equivalence: Optional[Callable[[], List[EquivalenceSpec]]] = None
     #: rule id -> justification; suppressed findings never fail the gate
     #: but are always reported (the hvdlint suppression discipline).
     suppress: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -165,9 +183,9 @@ def _image_lane(model_name, *, image=64, per_chip=2, overlap=None,
                 (per_chip * n, image, image, 3), jnp.float32),
             "label": jax.ShapeDtypeStruct((per_chip * n,), jnp.int32),
         }
-        # hvdlint: disable=HVD008 (the verifier traces today's
-        # hand-rolled axis spellings; rewrites with LogicalMesh)
-        batch_spec = P("hvd")  # hvdlint: disable=HVD008
+        from horovod_tpu.parallel.logical import DATA_AXIS
+
+        batch_spec = P(DATA_AXIS)
         if window > 1:
             # The --steps-per-dispatch lane: the scan window over a
             # K-stacked batch (bench.py stages concrete arrays through
@@ -239,12 +257,14 @@ def _lm_lane(*, fused_ce=False, seq=256, per_chip=1, layers=4, dim=256,
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             return models.apply_gradients(optimizer, state, grads), loss
 
+        from horovod_tpu.parallel.logical import DATA_AXIS
+
         n = hvd.size()
         batch = {"tokens": jax.ShapeDtypeStruct((per_chip * n, seq),
                                                 jnp.int32)}
         run = hvd.spmd_fn(
             step_fn,
-            in_specs=(P(), P("hvd")),  # hvdlint: disable=HVD008 (LogicalMesh work list)
+            in_specs=(P(), P(DATA_AXIS)),
             out_specs=(P(), P()),
             donate_argnums=(0,),
         )
@@ -555,6 +575,320 @@ def _build_parallel_moe():
     return fn, args
 
 
+# ------------------------------------------------------------- composed
+#
+# LogicalMesh-composed stacks (the PR-17 tentpole): each program builds
+# its mesh + every partition spec through the axis-rules table, then the
+# full HVV2xx pass runs — HVV201 reconciles the declared specs against
+# the table, HVV202 checks every collective/constraint axis against the
+# mesh vocabulary, HVV203 pins the composed schedule op-identical to the
+# per-module reference traces (built at the composed program's LOCAL
+# shapes, the other strategies' axes divided out).
+
+
+def _logical_mesh(config: str):
+    import jax
+
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    _require_world()
+    return LogicalMesh.from_config(config, devices=jax.devices()[:WORLD])
+
+
+def _composed_dp_tp():
+    """dp=2 x tp=4: the Megatron MLP under grad with the DP gradient
+    exchange — the canonical 2-axis stack."""
+    B, L, E, F = 4, 8, 16, 32  # global batch; local batch B/dp = 2
+
+    def _loss(x, wu, bu, wd, bd, tp_ax):
+        import horovod_tpu.parallel as par
+
+        return par.tp_mlp(x, wu, bu, wd, bd, axis=tp_ax).sum()
+
+    def build():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        _init()
+        lm = _logical_mesh("dp=2,tp=4")
+        dp_ax = lm.role_axis("data")
+        tp_ax = lm.role_axis("tensor")
+
+        def step(x, wu, bu, wd, bd):
+            gwu, gwd = jax.grad(
+                functools.partial(_loss, tp_ax=tp_ax),
+                argnums=(1, 3))(x, wu, bu, wd, bd)
+            # DP gradient exchange: average over the data axis.
+            n = lax.axis_size(dp_ax)
+            return (lax.psum(gwu, dp_ax) / n, lax.psum(gwd, dp_ax) / n)
+
+        fn = _shmapped(
+            step, lm.mesh,
+            in_specs=(lm.spec("batch"), lm.spec("embed", "mlp"),
+                      lm.spec("mlp"), lm.spec("mlp", "embed"),
+                      lm.spec("embed")),
+            out_specs=(lm.spec("embed", "mlp"), lm.spec("mlp", "embed")))
+        args = (jax.ShapeDtypeStruct((B, L, E), jnp.float32),
+                jax.ShapeDtypeStruct((E, F), jnp.float32),
+                jax.ShapeDtypeStruct((F,), jnp.float32),
+                jax.ShapeDtypeStruct((F, E), jnp.float32),
+                jax.ShapeDtypeStruct((E,), jnp.float32))
+        return fn, args
+
+    def shardings():
+        lm = _logical_mesh("dp=2,tp=4")
+        return ShardingSpec(mesh=lm, entries=(
+            ("x", ("batch",), lm.spec("batch")),
+            ("w_up", ("embed", "mlp"), lm.spec("embed", "mlp")),
+            ("b_up", ("mlp",), lm.spec("mlp")),
+            ("w_down", ("mlp", "embed"), lm.spec("mlp", "embed")),
+            ("b_down", ("embed",), lm.spec("embed")),
+        ))
+
+    def logical_mesh():
+        return _logical_mesh("dp=2,tp=4")
+
+    def equivalence():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.parallel.logical import DATA_AXIS
+
+        def tp_ref():
+            _init()
+            mesh = _submesh({"tp": 4})
+            fn = _shmapped(
+                jax.grad(functools.partial(_loss, tp_ax="tp"),
+                         argnums=(1, 3)),
+                mesh,
+                in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None),
+                          P()),
+                out_specs=(P(None, "tp"), P("tp", None)))
+            args = (jax.ShapeDtypeStruct((B // 2, L, E), jnp.float32),
+                    jax.ShapeDtypeStruct((E, F), jnp.float32),
+                    jax.ShapeDtypeStruct((F,), jnp.float32),
+                    jax.ShapeDtypeStruct((F, E), jnp.float32),
+                    jax.ShapeDtypeStruct((E,), jnp.float32))
+            return fn, args
+
+        def dp_ref():
+            _init()
+            mesh = _submesh({DATA_AXIS: 2})
+
+            def exchange(gwu, gwd):
+                n = lax.axis_size(DATA_AXIS)
+                return (lax.psum(gwu, DATA_AXIS) / n,
+                        lax.psum(gwd, DATA_AXIS) / n)
+
+            fn = _shmapped(exchange, mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P()))
+            args = (jax.ShapeDtypeStruct((E, F // 4), jnp.float32),
+                    jax.ShapeDtypeStruct((F // 4, E), jnp.float32))
+            return fn, args
+
+        return [
+            EquivalenceSpec(reference=tp_ref, axes=("tp",), name="tp"),
+            EquivalenceSpec(reference=dp_ref, axes=("dp",),
+                            axis_map={"dp": DATA_AXIS}, name="dp"),
+        ]
+
+    return build, shardings, logical_mesh, equivalence
+
+
+def _composed_dp_ulysses():
+    """dp=2 x sp=4: Ulysses all-to-all attention with the batch sharded
+    over dp AND the sequence over sp, plus the DP loss reduction."""
+    B, L, H, D = 4, 32, 4, 8  # global; local [B/2, L/4, H, D]
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        import horovod_tpu.parallel as par
+
+        _init()
+        lm = _logical_mesh("dp=2,sp=4")
+        dp_ax = lm.role_axis("data")
+        sp_ax = lm.role_axis("seq")
+
+        def step(q, k, v):
+            out = par.ulysses_attention(q, k, v, axis=sp_ax, causal=True)
+            # DP loss reduction: global mean over the data axis.
+            return lax.psum(out.sum(), dp_ax) / lax.axis_size(dp_ax)
+
+        fn = _shmapped(
+            step, lm.mesh,
+            in_specs=(lm.spec("batch", "seq"),) * 3,
+            out_specs=lm.spec())
+        x = jax.ShapeDtypeStruct((B, L, H, D), jnp.float32)
+        return fn, (x, x, x)
+
+    def shardings():
+        lm = _logical_mesh("dp=2,sp=4")
+        return ShardingSpec(mesh=lm, entries=(
+            ("q", ("batch", "seq"), lm.spec("batch", "seq")),
+            ("k", ("batch", "seq"), lm.spec("batch", "seq")),
+            ("v", ("batch", "seq"), lm.spec("batch", "seq")),
+            ("loss", (), lm.spec()),
+        ))
+
+    def logical_mesh():
+        return _logical_mesh("dp=2,sp=4")
+
+    def equivalence():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.parallel.logical import DATA_AXIS
+
+        def sp_ref():
+            import horovod_tpu.parallel as par
+
+            _init()
+            mesh = _submesh({"sp": 4})
+            fn = _shmapped(
+                lambda q, k, v: par.ulysses_attention(
+                    q, k, v, axis="sp", causal=True),
+                mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"))
+            x = jax.ShapeDtypeStruct((B // 2, L, H, D), jnp.float32)
+            return fn, (x, x, x)
+
+        def dp_ref():
+            _init()
+            mesh = _submesh({DATA_AXIS: 2})
+            fn = _shmapped(
+                lambda s: lax.psum(s, DATA_AXIS)
+                / lax.axis_size(DATA_AXIS),
+                mesh, in_specs=P(), out_specs=P())
+            return fn, (jax.ShapeDtypeStruct((), jnp.float32),)
+
+        return [
+            EquivalenceSpec(reference=sp_ref, axes=("sp",), name="sp"),
+            EquivalenceSpec(reference=dp_ref, axes=("dp",),
+                            axis_map={"dp": DATA_AXIS}, name="dp"),
+        ]
+
+    return build, shardings, logical_mesh, equivalence
+
+
+def _composed_tp_pp():
+    """tp=2 x pp=4: a GPipe pipeline whose every stage is a Megatron
+    MLP — TP collectives inside the scanned tick loop, the PP rotation
+    outside-conditional as always."""
+    STAGES, M, Bm, E, F = 4, 6, 2, 8, 16
+
+    def _stage(w, a, tp_ax):
+        import jax
+
+        import horovod_tpu.parallel as par
+
+        h = jax.nn.gelu(par.column_parallel(a, w["wu"], axis=tp_ax))
+        return par.row_parallel(h, w["wd"], axis=tp_ax)
+
+    def build():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        import horovod_tpu.parallel as par
+
+        _init()
+        lm = _logical_mesh("tp=2,pp=4")
+        tp_ax = lm.role_axis("tensor")
+        pp_ax = lm.role_axis("stage")
+
+        def step(ws, x):
+            return par.pipeline_apply(
+                functools.partial(_stage, tp_ax=tp_ax), ws, x,
+                axis=pp_ax)
+
+        fn = _shmapped(
+            step, lm.mesh,
+            in_specs=({"wu": lm.spec("stage", "embed", "mlp"),
+                       "wd": lm.spec("stage", "mlp", "embed")},
+                      lm.spec()),
+            out_specs=lm.spec())
+        args = ({"wu": jax.ShapeDtypeStruct((STAGES, E, F), jnp.float32),
+                 "wd": jax.ShapeDtypeStruct((STAGES, F, E),
+                                            jnp.float32)},
+                jax.ShapeDtypeStruct((M, Bm, E), jnp.float32))
+        return fn, args
+
+    def shardings():
+        lm = _logical_mesh("tp=2,pp=4")
+        return ShardingSpec(mesh=lm, entries=(
+            ("wu", ("stage", "embed", "mlp"),
+             lm.spec("stage", "embed", "mlp")),
+            ("wd", ("stage", "mlp", "embed"),
+             lm.spec("stage", "mlp", "embed")),
+            ("x", (), lm.spec()),
+        ))
+
+    def logical_mesh():
+        return _logical_mesh("tp=2,pp=4")
+
+    def equivalence():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        n_ticks = M + STAGES - 1
+
+        def pp_ref():
+            import horovod_tpu.parallel as par
+
+            _init()
+            mesh = _submesh({"pp": 4})
+            fn = _shmapped(
+                lambda ws, x: par.pipeline_apply(
+                    lambda w, a: jnp.tanh(a @ w), ws, x, axis="pp"),
+                mesh, in_specs=(P("pp"), P()), out_specs=P())
+            args = (jax.ShapeDtypeStruct((STAGES, E, E), jnp.float32),
+                    jax.ShapeDtypeStruct((M, Bm, E), jnp.float32))
+            return fn, args
+
+        def tp_ref():
+            _init()
+            mesh = _submesh({"tp": 2})
+
+            def loop(wu, wd, a):
+                body = functools.partial(_stage, tp_ax="tp")
+                return lax.fori_loop(
+                    0, n_ticks,
+                    lambda i, acc: body({"wu": wu, "wd": wd}, acc), a)
+
+            fn = _shmapped(
+                loop, mesh,
+                in_specs=(P(None, "tp"), P("tp", None), P()),
+                out_specs=P())
+            args = (jax.ShapeDtypeStruct((E, F), jnp.float32),
+                    jax.ShapeDtypeStruct((F, E), jnp.float32),
+                    jax.ShapeDtypeStruct((Bm, E), jnp.float32))
+            return fn, args
+
+        return [
+            EquivalenceSpec(reference=pp_ref, axes=("pp",), name="pp"),
+            EquivalenceSpec(reference=tp_ref, axes=("tp",), name="tp"),
+        ]
+
+    return build, shardings, logical_mesh, equivalence
+
+
 # -------------------------------------------------------------- elastic
 
 
@@ -705,6 +1039,18 @@ def _make_registry() -> List[Program]:
                 lambda: _build_parallel_moe()),
     ]
 
+    # LogicalMesh-composed stacks: the full HVV2xx pass (sharding
+    # reconciliation, axis vocabulary, per-module schedule
+    # equivalence) over the three canonical 2-axis compositions.
+    for pname, factory in (("composed.dp_tp", _composed_dp_tp),
+                           ("composed.dp_ulysses", _composed_dp_ulysses),
+                           ("composed.tp_pp", _composed_tp_pp)):
+        build, shardings, logical_mesh, equivalence = factory()
+        progs.append(Program(pname, "composed", build,
+                             shardings=shardings,
+                             logical_mesh=logical_mesh,
+                             equivalence=equivalence))
+
     # The elastic windowed loop + its donation invariant — at the
     # launch world size AND the post-resize (shrunken-world) batch
     # geometry, so the PR-5 snapshot-in-flight invariant is checked on
@@ -746,8 +1092,11 @@ REGISTRY: List[Program] = _make_registry()
 
 #: Programs cheap enough for the fast (tier-1) sweep pin: everything
 #: except the big-model gate lanes, whose tracing cost belongs to the
-#: full-suite / check.sh --verify gate.
-FAST_GROUPS = ("optimizer", "dp", "parallel", "elastic", "serve")
+#: full-suite / check.sh --verify gate. The composed stacks trace at
+#: toy shapes (plus their per-module reference traces), cheap enough
+#: for the fast lane.
+FAST_GROUPS = ("optimizer", "dp", "parallel", "composed", "elastic",
+               "serve")
 
 
 def programs(groups=None, names=None) -> List[Program]:

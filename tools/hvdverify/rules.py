@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence
 
+from horovod_tpu.parallel.logical import DATA_AXIS
 from tools.hvdverify.schedule import CollectiveOp, RawFinding
 
 RULES: Dict[str, str] = {
@@ -42,6 +43,15 @@ RULES: Dict[str, str] = {
               "(horovod_tpu.jax.fusion.plan_buckets; flat psum, "
               "scatter rs+ag, or the hierarchical rs->exchange->ag "
               "ladder incl. quantized DCN legs)",
+    "HVV201": "declared in/out/param partition specs do not reconcile "
+              "with the LogicalMesh axis-rules table — the sharding "
+              "analogue of HVV105's byte reconciliation",
+    "HVV202": "collective or with_sharding_constraint references a "
+              "physical mesh axis the bound LogicalMesh does not "
+              "define (vocabulary drift past the rules table)",
+    "HVV203": "composed-stack collective schedule is not op-identical "
+              "to the per-module reference trace (kind/axes/shape/"
+              "dtype/params, in issue order)",
 }
 
 
@@ -96,7 +106,7 @@ class ReconcileSpec:
     leaves: Sequence
     threshold: int
     axis_size: int
-    axis: str = "hvd"  # hvdlint: disable=HVD008 (LogicalMesh work list)
+    axis: str = DATA_AXIS
     hier_inner: int = 0
     dcn_dtype: Optional[str] = None
 
@@ -308,4 +318,175 @@ def check_reconciliation(program: str, schedule: Sequence[CollectiveOp],
             "exchange, a gather without its reduce-scatter, or a "
             "foreign collective on the gradient axis",
             op.path, op.source))
+    return findings
+
+
+# ------------------------------------------------------------------ HVV201
+
+
+@dataclasses.dataclass
+class ShardingSpec:
+    """What a program claims about its shardings, against the rules
+    table: ``mesh`` is the bound
+    :class:`~horovod_tpu.parallel.logical.LogicalMesh`; ``entries`` is
+    one ``(label, logical_dims, declared_spec)`` triple per sharded
+    argument/output/param group — ``logical_dims`` the logical axis
+    names per array dimension (``None`` = replicated dim) and
+    ``declared_spec`` the ``PartitionSpec`` the program actually passes
+    to ``in_specs``/``out_specs``/``with_sharding_constraint``. HVV201
+    resolves ``logical_dims`` through the table and compares."""
+
+    mesh: object
+    entries: Sequence
+
+
+def _norm_spec(spec) -> tuple:
+    """PartitionSpec -> trailing-None-stripped tuple (``P('dp')`` and
+    ``P('dp', None)`` shard identically)."""
+    t = tuple(spec) if spec is not None else ()
+    while t and t[-1] is None:
+        t = t[:-1]
+    return t
+
+
+def check_shardings(program: str, spec: ShardingSpec) -> List[Finding]:
+    """HVV201: every declared partition spec must equal what the
+    axis-rules table resolves for the claimed logical dims. A declared
+    spec spelling a different physical axis (or sharding a dim the
+    table replicates, or vice versa) is a finding — the program's
+    sharding drifted from the registry that is supposed to own it."""
+    findings: List[Finding] = []
+    for label, dims, declared in spec.entries:
+        try:
+            expected = spec.mesh.spec(*dims)
+        except Exception as e:
+            findings.append(Finding(
+                program, "HVV201",
+                f"sharding entry '{label}' claims logical dims "
+                f"{tuple(dims)!r} the rules table cannot resolve: {e}"))
+            continue
+        if _norm_spec(declared) != _norm_spec(expected):
+            findings.append(Finding(
+                program, "HVV201",
+                f"sharding entry '{label}': declared spec "
+                f"{tuple(declared)!r} but the axis-rules table resolves "
+                f"logical dims {tuple(dims)!r} to {tuple(expected)!r} "
+                f"on mesh '{spec.mesh.config}' — the program's sharding "
+                "drifted from the table (the sharding analogue of an "
+                "HVV105 byte mismatch)"))
+    return findings
+
+
+# ------------------------------------------------------------------ HVV202
+
+
+def check_axis_vocabulary(program: str, schedule: Sequence[CollectiveOp],
+                          constraint_refs: Sequence,
+                          logical_mesh) -> List[Finding]:
+    """HVV202: every mesh axis a collective runs over — and every axis a
+    ``with_sharding_constraint`` spells — must be defined by the bound
+    LogicalMesh. An undefined axis means the program smuggled a physical
+    spelling past the rules table (it may still trace if an enclosing
+    shard_map binds the axis, which is exactly why HVV102 cannot catch
+    this class)."""
+    defined = set(logical_mesh.axis_names)
+    findings: List[Finding] = []
+    for op in schedule:
+        for ax in op.axes:
+            if ax not in defined:
+                findings.append(Finding(
+                    program, "HVV202",
+                    f"collective {op.describe()} runs over mesh axis "
+                    f"'{ax}' which the bound LogicalMesh "
+                    f"('{logical_mesh.config}') does not define — the "
+                    "axis spelling bypassed the rules table",
+                    op.path, op.source))
+    for axes, path, source in constraint_refs:
+        for ax in axes:
+            if ax not in defined:
+                findings.append(Finding(
+                    program, "HVV202",
+                    f"with_sharding_constraint references mesh axis "
+                    f"'{ax}' which the bound LogicalMesh "
+                    f"('{logical_mesh.config}') does not define",
+                    path, source))
+    return findings
+
+
+# ------------------------------------------------------------------ HVV203
+
+
+@dataclasses.dataclass
+class EquivalenceSpec:
+    """One per-module reference a composed program must reproduce.
+
+    ``reference``: zero-arg callable returning ``(fn, args)`` — the
+    single-strategy program whose collective schedule is ground truth
+    (built at the composed program's LOCAL shapes, i.e. with the other
+    strategies' axes already divided out). ``axes``: the composed
+    program's physical axes this reference owns (its collectives are
+    filtered to ops touching them). ``axis_map``: composed -> reference
+    axis renames (e.g. ``{"dp": "hvd"}`` when the reference spells the
+    data axis the legacy way)."""
+
+    reference: object
+    axes: Sequence[str]
+    axis_map: Dict[str, str] = dataclasses.field(default_factory=dict)
+    name: str = "reference"
+
+
+def _op_key(op: CollectiveOp, rename: Dict[str, str]) -> tuple:
+    axes = tuple(rename.get(a, a) for a in op.axes)
+    return (op.kind, axes, tuple(op.shape), op.dtype, op.times, op.params)
+
+
+def check_equivalence(program: str, schedule: Sequence[CollectiveOp],
+                      specs: Sequence[EquivalenceSpec]) -> List[Finding]:
+    """HVV203: per reference, the composed program's collectives over
+    that reference's axes must be OP-IDENTICAL — same kinds, axes
+    (after renaming), shapes, dtypes, static multipliers and params, in
+    the same issue order — to the reference's own trace. Composition
+    through the rules table must not change what any single strategy
+    puts on the wire."""
+    import warnings
+
+    import jax
+
+    from tools.hvdverify.schedule import extract
+
+    findings: List[Finding] = []
+    for spec in specs:
+        fn, args = spec.reference()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            closed = jax.make_jaxpr(fn)(*args)
+        ref_schedule, _, _ = extract(closed)
+        owned = set(spec.axes)
+        mapped = {spec.axis_map.get(a, a) for a in spec.axes}
+        composed = [op for op in schedule if set(op.axes) & owned]
+        ref_ops = [op for op in ref_schedule if set(op.axes) & mapped]
+        got = [_op_key(op, spec.axis_map) for op in composed]
+        want = [_op_key(op, {}) for op in ref_ops]
+        if got == want:
+            continue
+        if len(got) != len(want):
+            findings.append(Finding(
+                program, "HVV203",
+                f"composed schedule has {len(got)} collective(s) over "
+                f"axes {sorted(owned)} but reference "
+                f"'{spec.name}' traces {len(want)} — composition "
+                "changed what the strategy puts on the wire"))
+            continue
+        for i, (g, w) in enumerate(zip(got, want)):
+            if g != w:
+                g_op = composed[i]
+                findings.append(Finding(
+                    program, "HVV203",
+                    f"composed schedule diverges from reference "
+                    f"'{spec.name}' at op #{i}: composed "
+                    f"{g_op.describe()} (key {g!r}) vs reference "
+                    f"{ref_ops[i].describe()} (key {w!r}) — the stack "
+                    "must be op-identical to the per-module trace",
+                    g_op.path, g_op.source))
+                break
     return findings

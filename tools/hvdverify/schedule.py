@@ -533,6 +533,41 @@ def extract(closed_jaxpr, *, bound_axes=frozenset()):
     return w.schedule, w.findings, w.donating_calls
 
 
+def sharding_constraint_refs(closed_jaxpr, *, _depth: int = 0
+                             ) -> List[Tuple[Tuple[str, ...], str, str]]:
+    """Every ``with_sharding_constraint`` in the (recursively opened)
+    jaxpr as ``(axis names referenced, path, source)`` tuples — the
+    HVV202 input: a constraint spelling a physical axis the bound
+    LogicalMesh does not define is exactly the vocabulary drift the
+    rules table exists to prevent. Axis names come from the constraint's
+    NamedSharding spec; non-named shardings (GSPMD opaque) contribute
+    nothing."""
+    if _depth > 32:
+        return []
+    out: List[Tuple[Tuple[str, ...], str, str]] = []
+    jaxpr = _open(closed_jaxpr)
+    for eqn in getattr(jaxpr, "eqns", ()):
+        if eqn.primitive.name == "sharding_constraint":
+            sharding = eqn.params.get("sharding")
+            spec = getattr(sharding, "spec", None)
+            if spec is not None:
+                axes: List[str] = []
+                for entry in spec:
+                    parts = (entry if isinstance(entry, (tuple, list))
+                             else (entry,))
+                    axes.extend(p for p in parts if isinstance(p, str))
+                if axes:
+                    out.append((tuple(axes), "sharding_constraint",
+                                _source_of(eqn)))
+            continue
+        for val in eqn.params.values():
+            for item in (val if isinstance(val, (tuple, list)) else [val]):
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    out.extend(sharding_constraint_refs(
+                        item, _depth=_depth + 1))
+    return out
+
+
 def summarize(schedule: Sequence[CollectiveOp]) -> Dict[str, Any]:
     """Static audit numbers for one program: collective count and bytes
     (payload x static multiplier; while-nested ops count once and are

@@ -74,6 +74,15 @@ def flash_grid_cell(rec):
     return cell
 
 
+def mesh_cell(rec):
+    """Compact render of the record's logical mesh config (bench.py
+    --mesh, canonicalized through horovod_tpu.parallel.logical), e.g.
+    "dp=8,tp=4" — the parallelism stack a lane ran under. Unconfigured
+    (and pre-registry) records render as em-dash."""
+    m = rec.get("mesh")
+    return m if m else "—"
+
+
 def overlap_cell(rec):
     """Compact render of the record's overlap/bucket stamps (bench.py
     --overlap; horovod_tpu/jax/fusion.py): "on(98b)" = overlap on over a
@@ -286,11 +295,11 @@ def main():
                     help="restrict to records stamped today (UTC)")
     args = ap.parse_args()
     ok, err = load(args.today)
-    print("| lane | value | unit | window | overlap | wire | collectives "
-          "| flash grid | snapshot | elastic | serve | fleet | prefix "
-          "| peak | probe TF | stamp (UTC) |")
+    print("| lane | value | unit | window | mesh | overlap | wire "
+          "| collectives | flash grid | snapshot | elastic | serve "
+          "| fleet | prefix | peak | probe TF | stamp (UTC) |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|")
+          "---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -300,6 +309,7 @@ def main():
         window = rec.get("window")
         print(f"| {lane} | {fmt(rec['value'])} | {rec.get('unit', '')} "
               f"| {window if window is not None else '—'} "
+              f"| {mesh_cell(rec)} "
               f"| {overlap_cell(rec)} "
               f"| {wire_cell(rec)} "
               f"| {collectives_cell(rec)} "

@@ -242,17 +242,19 @@ CHIP_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 def efficiency_table(fusion_threshold, overlap="auto",
                      dispatch_us=DEFAULT_DISPATCH_US, dcn_inner=0,
-                     dcn_wire="none", models=None):
+                     dcn_wire="none", models=None, chips=None):
     """Markdown rows: per model, predicted efficiency across the chip
-    ladder plus the bucket accounting that produced it."""
+    ladder (or the ``chips`` override, e.g. a mesh config's device
+    product) plus the bucket accounting that produced it."""
+    ladder = tuple(chips) if chips else CHIP_LADDER
     lines = ["| model | buckets | grad MB | step ms | "
-             + " | ".join(f"{c}c" for c in CHIP_LADDER) + " |",
-             "|---|---|---|---|" + "---|" * len(CHIP_LADDER)]
+             + " | ".join(f"{c}c" for c in ladder) + " |",
+             "|---|---|---|---|" + "---|" * len(ladder)]
     for name in models or list(MEASURED):
         stats = bucket_stats(name, fusion_threshold)
         _, summary = stats
         cells = []
-        for c in CHIP_LADDER:
+        for c in ladder:
             p = predict_efficiency(name, c, fusion_threshold,
                                    overlap=overlap, dispatch_us=dispatch_us,
                                    dcn_inner=dcn_inner, dcn_wire=dcn_wire,
@@ -280,13 +282,13 @@ def microbench_dispatch(iters=200):
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from horovod_tpu.parallel.logical import DATA_AXIS
     from horovod_tpu.parallel.spmd import _SHARD_MAP_CHECK_KW, _shard_map
     from horovod_tpu.utils.devsync import force_device_sync
 
-    # LogicalMesh work list: the microbench spells the DP axis.
-    mesh = Mesh(np.array(jax.devices()[:1]), ("hvd",))  # hvdlint: disable=HVD008
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
     f = jax.jit(_shard_map(
-        lambda x: lax.psum(x, "hvd"), mesh=mesh, in_specs=P(),  # hvdlint: disable=HVD008
+        lambda x: lax.psum(x, DATA_AXIS), mesh=mesh, in_specs=P(),
         out_specs=P(), **{_SHARD_MAP_CHECK_KW: False}))
     x = jnp.ones((1024,), jnp.float32)
     out = f(x)
@@ -329,7 +331,29 @@ def main():
     ap.add_argument("--models", default="",
                     help="comma list (default: all of "
                          f"{','.join(MEASURED)})")
+    ap.add_argument("--mesh", default=None,
+                    help="logical mesh config, e.g. 'dp=8,tp=4,sp=2' "
+                         "(horovod_tpu.parallel.logical vocabulary): "
+                         "restricts the table to that device product "
+                         "and stamps the canonical config in the "
+                         "header")
     args = ap.parse_args()
+
+    mesh_cfg, mesh_chips = None, None
+    if args.mesh:
+        from horovod_tpu.parallel.logical import (
+            format_mesh_config,
+            parse_mesh_config,
+        )
+
+        try:
+            axes = parse_mesh_config(args.mesh)
+        except Exception as e:
+            ap.error(f"--mesh: {e}")
+        mesh_cfg = format_mesh_config(axes)
+        mesh_chips = [1]
+        for size in axes.values():
+            mesh_chips[0] *= size
 
     dispatch_us = DEFAULT_DISPATCH_US
     if args.microbench:
@@ -347,12 +371,14 @@ def main():
           f"overlap={args.overlap}, dispatch {dispatch_us:.1f} us, "
           + (f"multi-slice DCN inner={args.dcn_inner}, "
              f"wire={args.dcn_compression}"
-             if args.dcn_inner else "all-ICI") + ")")
+             if args.dcn_inner else "all-ICI")
+          + (f", mesh={mesh_cfg}" if mesh_cfg else "") + ")")
     print()
     print(efficiency_table(args.fusion_threshold, overlap=args.overlap,
                            dispatch_us=dispatch_us,
                            dcn_inner=args.dcn_inner,
-                           dcn_wire=args.dcn_compression, models=models))
+                           dcn_wire=args.dcn_compression, models=models,
+                           chips=mesh_chips))
     return 0
 
 
