@@ -157,8 +157,23 @@ def _final_hidden(params, x):
     return _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
 
 
-def _logits(params, x):
-    return _final_hidden(params, x) @ params["head"]
+def _logits(params, x, tp=None, vocab_parallel: bool = False):
+    """Final LayerNorm + vocab projection -> full-vocab logits.
+
+    With ``vocab_parallel`` the head arrives column-sharded [E, V/tp]
+    (:func:`lm_param_specs` ``vocab_parallel=True``) and the full row
+    is assembled by ONE tiled all-gather
+    (:func:`~horovod_tpu.parallel.tp.vocab_parallel_logits`) — the
+    serving path's spelling; training-side fused losses consume the
+    shard directly and never materialize this tensor."""
+    h = _final_hidden(params, x)
+    if vocab_parallel:
+        if not tp:
+            raise ValueError("vocab_parallel logits need a tp axis")
+        from horovod_tpu.parallel.tp import vocab_parallel_logits
+
+        return vocab_parallel_logits(h, params["head"], axis=tp)
+    return h @ params["head"]
 
 
 def lm_apply(params: Dict, tokens, sp: Optional[str] = None,

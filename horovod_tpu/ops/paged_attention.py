@@ -219,7 +219,7 @@ def paged_attention_decode(q, k_pages, v_pages, tables, lengths,
 def paged_grid_info(lengths: Sequence[int], *, page_size: int,
                     pages_per_seq: int, num_heads: int, head_dim: int,
                     dtype_bytes: int = 4, num_layers: int = 1,
-                    tables=None):
+                    tables=None, tp: int = 1):
     """Static page/byte accounting for one decode step, without tracing.
 
     Mirrors exactly the index-map policy :func:`paged_attention_decode`
@@ -243,11 +243,21 @@ def paged_grid_info(lengths: Sequence[int], *, page_size: int,
       traffic-win headline;
     * ``pages_visited`` (only when ``tables`` is given) — the per-slot
       PHYSICAL page ids the kernel's index map streams; never contains
-      the null page 0 for a live slot.
+      the null page 0 for a live slot;
+    * ``tp`` / ``kv_bytes_per_chip`` / ``kv_bytes_gather_per_chip`` —
+      the tensor-parallel degree and each policy's PER-CHIP bytes
+      under it: heads shard exactly (``num_heads % tp == 0`` is
+      enforced), so per-chip traffic is byte-for-byte 1/tp of the
+      totals above — the honest form of the TP bandwidth claim
+      (``tp=1`` degenerates to the totals).
     """
     lens = [int(x) for x in lengths]
     if any(x < 0 for x in lens):
         raise ValueError(f"negative length in {lens}")
+    if tp < 1 or num_heads % tp != 0:
+        raise ValueError(
+            f"tp={tp} must be >= 1 and divide num_heads={num_heads} "
+            "(the head-sharded page arrays split exactly)")
     pages_live = [-(-x // page_size) for x in lens]
     if any(p > pages_per_seq for p in pages_live):
         raise ValueError(
@@ -266,6 +276,9 @@ def paged_grid_info(lengths: Sequence[int], *, page_size: int,
         "kv_bytes_gather": S * pages_per_seq * tile,
         "kv_fetch_frac": (round(sum(pages_live) / (S * pages_per_seq), 4)
                           if S else None),
+        "tp": tp,
+        "kv_bytes_per_chip": sum(pages_live) * tile // tp,
+        "kv_bytes_gather_per_chip": S * pages_per_seq * tile // tp,
     }
     if tables is not None:
         import numpy as np

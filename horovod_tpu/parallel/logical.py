@@ -204,6 +204,13 @@ class LogicalMesh:
                    else list(jax.devices()))
         sizes = self._resolve_wildcard(dict(axes), len(devices))
         want = math.prod(sizes.values())
+        if want > len(devices):
+            # Fail-fast with the real arithmetic — without this the
+            # overshoot surfaces as a cryptic make_mesh reshape error
+            # (or worse, at first compile inside a consumer's jit).
+            raise InvalidArgumentError(
+                f"mesh axes {format_mesh_config(sizes)} need {want} "
+                f"device(s) but only {len(devices)} are available")
         if want < len(devices):
             # Virtual sub-mesh (tests bind dp=2,tp=4 on however many
             # devices the host exposes): take a prefix, like the

@@ -140,6 +140,22 @@ def tp_mlp(x, w_up, b_up, w_down, b_down, axis: Optional[str] = None,
     return row_parallel(h, w_down, b_down, axis)
 
 
+def vocab_parallel_logits(x, head, axis: Optional[str] = None):
+    """Full-vocab logits from a column-sharded head: ``x @ W_local``
+    ([..., E] x [E, V/P]) then ONE tiled all-gather over the vocab
+    axis — exactly :func:`column_parallel` with ``gather_output``.
+
+    The inference-side conjugate of ops/xent.py's vocab-parallel loss
+    (which never materializes full logits): serving needs the whole
+    row because the SAMPLER (greedy argmax, top-k) runs host-side over
+    full-vocab f32. Each chip computes its vocab columns with the
+    bit-identical dot products of the dense ``x @ W`` — the gather
+    only concatenates slices in axis order — so greedy decode over a
+    sharded head stays token-exact vs the replicated reference
+    (tests/test_serve_engine.py pins it)."""
+    return column_parallel(x, head, axis=axis, gather_output=True)
+
+
 def shard_columns(w, axis_size: int, index: int):
     """Host-side helper: slice the column shard for mesh position
     ``index`` (used when materializing per-chip weights outside

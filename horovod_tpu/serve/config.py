@@ -115,6 +115,23 @@ class ServeConfig:
     decode kernel"). Greedy token streams are bit-identical either
     way; the prefill lane keeps the full gather in both modes.
 
+    ``mesh`` shards the engine's compiled step SPMD over a
+    :class:`~horovod_tpu.parallel.logical.LogicalMesh` built from the
+    PR-17 config string (e.g. ``"dp=1,tp=4"``): attention heads, MLP
+    features and the vocab projection shard Megatron-style over the
+    tensor axis, and the per-layer KV page arrays become
+    ``[num_pages, page_size, H/tp, D]`` per chip — per-chip KV and
+    weight bytes drop by 1/tp while page tables, the free-list
+    allocator and the prefix index stay replicated host-side
+    (docs/serving.md "TP-sharded decode"). Only the tensor role axis
+    may exceed size 1 (data parallelism belongs to the FLEET — one
+    engine is one logical replica); the string's syntax and axis
+    shape are validated HERE at construction, the model-dependent
+    divisibility (H/mlp/vocab % tp) and the device budget at ENGINE
+    construction — never at first compile. ``None`` (default) is the
+    unsharded single-chip engine, the exactness reference the tp path
+    is pinned bit-identical to.
+
     ``prefix_caching`` turns on the copy-on-write prefix cache
     (:mod:`horovod_tpu.serve.prefix`; docs/serving.md "Prefix
     caching"): admission maps a prompt's longest chain of
@@ -140,6 +157,9 @@ class ServeConfig:
     eos_token: Optional[int] = None
     max_queue: int = 0          # 0 = unbounded
     requeue_evicted: bool = True
+    #: LogicalMesh config string ("dp=1,tp=4") sharding the compiled
+    #: step; None = unsharded single-chip engine (the reference).
+    mesh: Optional[str] = None
     #: Default per-request deadline in seconds from arrival (None =
     #: no deadline; a per-request ``ttl=`` overrides). A request still
     #: unfinished past its deadline is finished with the ``timeout``
@@ -174,6 +194,52 @@ class ServeConfig:
             raise ValueError(
                 f"default_ttl must be > 0 seconds (or None), got "
                 f"{self.default_ttl}")
+        if self.mesh is not None:
+            self.mesh_axes()   # fail-fast: syntax + axis-shape errors
+
+    def mesh_axes(self) -> Optional[dict]:
+        """The parsed ``mesh`` axes (``None`` when unsharded),
+        validated for the serve shape: canonical PR-17 syntax, fully
+        specified sizes (no ``-1`` wildcard — the engine must know its
+        device budget before it touches one), and only the TENSOR role
+        axis above size 1. Raises
+        :class:`~horovod_tpu.common.exceptions.InvalidArgumentError`
+        at ServeConfig construction, never at first compile."""
+        if self.mesh is None:
+            return None
+        from horovod_tpu.common.exceptions import InvalidArgumentError
+        from horovod_tpu.parallel.logical import (
+            ROLE_AXES,
+            parse_mesh_config,
+        )
+
+        axes = parse_mesh_config(self.mesh)    # raises on bad syntax
+        tensor = ROLE_AXES["tensor"]
+        for name, size in axes.items():
+            if size == -1:
+                raise InvalidArgumentError(
+                    f"ServeConfig.mesh {self.mesh!r}: the serve mesh "
+                    f"must be fully specified — '-1' wildcards resolve "
+                    "against a device count the config does not know")
+            if name != tensor and size != 1:
+                raise InvalidArgumentError(
+                    f"ServeConfig.mesh {self.mesh!r}: axis {name!r} has "
+                    f"size {size}, but one engine shards over the "
+                    f"tensor axis ({tensor!r}) only — data parallelism "
+                    "is the FLEET's job (one engine per mesh is one "
+                    "logical replica)")
+        return axes
+
+    @property
+    def tp_degree(self) -> int:
+        """The tensor-parallel degree the ``mesh`` string names (1 when
+        unsharded)."""
+        axes = self.mesh_axes()
+        if not axes:
+            return 1
+        from horovod_tpu.parallel.logical import ROLE_AXES
+
+        return axes.get(ROLE_AXES["tensor"], 1)
 
     @property
     def in_flight_limit(self) -> int:

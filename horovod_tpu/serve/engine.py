@@ -41,6 +41,28 @@ donated: a live request's pages must stay readable under an in-flight
 step (tools/hvdverify registers ``serve.step`` with
 ``forbid_donation``, the HVV104 invariant class the elastic loop
 established).
+
+**TP-sharded decode** (``ServeConfig.mesh``, e.g. ``"dp=1,tp=4"``):
+the SAME step runs SPMD under ``shard_map`` over a bound
+:class:`~horovod_tpu.parallel.logical.LogicalMesh` — attention heads,
+MLP features and the vocab projection shard Megatron-style
+(:func:`models.parallel_lm.lm_param_specs` ``vocab_parallel=True``),
+the per-layer KV page arrays become ``[num_pages, page_size, H/tp,
+D]`` per chip, and full-vocab f32 logits are reassembled by one tiled
+all-gather (:func:`~horovod_tpu.parallel.tp.vocab_parallel_logits`)
+so the host-side sampler is byte-identical to the unsharded path.
+The design split: the DATA plane (K/V pages, weights) shards; the
+CONTROL plane (scheduler, page tables, free-list refcounts, the radix
+prefix index) stays host-side Python — one allocator makes every
+decision, so "replicated across chips" holds by construction. Both
+attention paths work sharded: the gather path gathers local-head
+pages, and the Pallas kernel runs per-shard with its grid's head
+dimension sized H/tp (the kernel is shape-polymorphic in H — no
+kernel change). Greedy tokens stay bit-identical to ``lm_decode`` AND
+to the tp=1 engine (tests/test_serve_engine.py; ``serve_bench
+--ab-tp`` gates it in CI): each chip's dot products are exactly the
+dense math's column slices, psums only add terms the dense contraction
+adds, and argmax sees the identical full-vocab row.
 """
 
 from __future__ import annotations
@@ -91,7 +113,8 @@ def _gather_cache_kv(pk, pv, table):
 
 
 def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
-               attention: str = "gather", tp=None):
+               attention: str = "gather", tp=None,
+               vocab_parallel: bool = False):
     """One continuous-batching step.
 
     ``dec``: ``tok``/``pos``/``active`` [S] + ``tables`` [S, pps];
@@ -108,6 +131,12 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
     pages through :func:`~horovod_tpu.ops.paged_attention.
     paged_attention_decode`. The prefill lane keeps the full gather in
     both modes (rectangular-causal over the whole cache).
+
+    ``tp`` (static) names the tensor axis when the step runs inside
+    ``shard_map`` over head-sharded params and pages; ``vocab_parallel``
+    additionally expects a column-sharded head [E, V/tp] and assembles
+    full-vocab logits with one tiled all-gather — the sampler upstream
+    never sees a shard.
     """
     import math
 
@@ -228,16 +257,55 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
 
         new_pages.append({"k": pk, "v": pv})
 
-    dec_logits = _logits(params, xd)[:, 0]              # [S, V]
+    dec_logits = _logits(params, xd, tp, vocab_parallel)[:, 0]  # [S, V]
     if pre is not None:
         last = jnp.clip(pre["length"] - 1, 0, C - 1)
         row = lax.dynamic_slice_in_dim(xp[0], last, 1, 0)   # [1, E]
-        pre_logits = _logits(params, row[None])[0, 0]       # [V]
+        pre_logits = _logits(params, row[None], tp,
+                             vocab_parallel)[0, 0]          # [V]
     return new_pages, dec_logits, pre_logits
 
 
 # --------------------------------------------------------------------------
 # The host-side engine.
+
+
+def resolve_tp_mesh(params: Dict, config: ServeConfig):
+    """Bind ``config.mesh`` to this host's devices; fail-fast on
+    everything the config string alone could not know. Returns
+    ``(logical_mesh, tp_axis, tp_degree)`` — ``(None, None, 1)`` when
+    the engine runs unsharded (``mesh=None`` or an all-ones mesh).
+
+    Raises :class:`~horovod_tpu.common.exceptions.InvalidArgumentError`
+    at ENGINE construction, never at first compile, when the mesh's
+    device product exceeds the available devices (LogicalMesh's own
+    check) or when heads / MLP features / vocab don't divide the tp
+    degree (the shard shapes would be ragged)."""
+    axes = config.mesh_axes()
+    if not axes:
+        return None, None, 1
+    import jax
+
+    from horovod_tpu.common.exceptions import InvalidArgumentError
+    from horovod_tpu.parallel.logical import LogicalMesh
+
+    lm = LogicalMesh.from_config(config.mesh, devices=jax.devices())
+    tp_axis = lm.role_axis("tensor")
+    tp = lm.axes.get(tp_axis, 1)
+    if tp == 1:
+        return None, None, 1
+    layer0 = params["layers"][0]
+    dims = (("num_heads", int(layer0["wqkv"].shape[2])),
+            ("mlp", int(layer0["wup"].shape[1])),
+            ("vocab", int(params["head"].shape[1])))
+    for what, n in dims:
+        if n % tp:
+            raise InvalidArgumentError(
+                f"ServeConfig.mesh {config.mesh!r}: {what}={n} is not "
+                f"divisible by tp={tp} — the head/feature/vocab shards "
+                "must split exactly (pad the model or pick a tp that "
+                "divides)")
+    return lm, tp_axis, tp
 
 
 class ServeEngine:
@@ -252,11 +320,37 @@ class ServeEngine:
 
     def __init__(self, params: Dict, config: ServeConfig, *,
                  chips: int = 1, clock=time.perf_counter):
-        self.params = params
         self.config = config
         self.chips = chips
         self.clock = clock
-        self.cache = PagedKVCache(params, config)
+        #: Bound LogicalMesh + tensor axis + degree (mesh=None -> tp=1).
+        #: Fail-fast happens HERE (device budget, divisibility), never
+        #: at first compile.
+        self.logical_mesh, self._tp_axis, self.tp = \
+            resolve_tp_mesh(params, config)
+        kv_sharding = None
+        self._param_specs = None
+        if self.tp > 1:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from horovod_tpu.models.parallel_lm import lm_param_specs
+
+            mesh = self.logical_mesh.mesh
+            # Megatron param placement + head-sharded pages: the DATA
+            # plane. Specs double as the shard_map in/out_specs below.
+            self._param_specs = lm_param_specs(
+                len(params["layers"]), self._tp_axis,
+                vocab_parallel=True)
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params, self._param_specs)
+            self._kv_spec = P(None, None, self._tp_axis, None)
+            kv_sharding = NamedSharding(mesh, self._kv_spec)
+        self.params = params
+        self.cache = PagedKVCache(params, config,
+                                  kv_sharding=kv_sharding)
         if config.prefix_caching:
             from horovod_tpu.serve.prefix import PrefixIndex
 
@@ -293,15 +387,45 @@ class ServeEngine:
         self._t_start = clock()
         step = functools.partial(serve_step,
                                  page_size=config.page_size,
-                                 attention=config.attention)
+                                 attention=config.attention,
+                                 tp=self._tp_axis,
+                                 vocab_parallel=self.tp > 1)
         import jax
 
         # Two fixed-shape variants, compiled once each; NO donation —
         # live requests hold pages under the step (hvdverify
-        # serve.step forbid_donation).
-        self._step_mixed = jax.jit(step)
-        self._step_decode = jax.jit(
-            lambda params, pages, dec: step(params, pages, dec, None))
+        # serve.step forbid_donation; the tp variants serve.step_tp
+        # keep the same invariant — shards of a live page must stay
+        # readable under the step on every chip).
+        if self.tp > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from horovod_tpu.parallel.spmd import (
+                _SHARD_MAP_CHECK_KW,
+                _shard_map,
+            )
+
+            mesh = self.logical_mesh.mesh
+            kv = self._kv_spec
+            # dec/pre arrive replicated (P() prefix over the host
+            # dicts), pages head-sharded in AND out, logits replicated
+            # full-vocab (the step's all-gather makes them so).
+            untyped = {_SHARD_MAP_CHECK_KW: False}
+            self._step_mixed = jax.jit(_shard_map(
+                lambda p, pages, dec, pre: step(p, pages, dec, pre),
+                mesh=mesh,
+                in_specs=(self._param_specs, kv, P(), P()),
+                out_specs=(kv, P(), P()), **untyped))
+            self._step_decode = jax.jit(_shard_map(
+                lambda p, pages, dec: step(p, pages, dec, None),
+                mesh=mesh,
+                in_specs=(self._param_specs, kv, P()),
+                out_specs=(kv, P(), P()), **untyped))
+        else:
+            self._step_mixed = jax.jit(step)
+            self._step_decode = jax.jit(
+                lambda params, pages, dec: step(params, pages, dec,
+                                                None))
 
     # ------------------------------------------------------ submission
 
@@ -640,6 +764,16 @@ class ServeEngine:
                 f"{tuple(new)} vs the engine's {tuple(old)} — a "
                 "geometry change needs a fresh engine, not a weight "
                 "swap")
+        if self.tp > 1:
+            # Same placement as construction: the compiled sharded
+            # step expects head/feature/vocab shards, not replicas.
+            import jax
+            from jax.sharding import NamedSharding
+
+            mesh = self.logical_mesh.mesh
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params, self._param_specs)
         self.params = params
         if self.prefix is not None:
             # K/V rows are a function of the weights: stale-version
@@ -723,7 +857,7 @@ class ServeEngine:
             pages_per_seq=c.pages_per_seq, num_heads=c.num_heads,
             head_dim=c.head_dim,
             dtype_bytes=np.dtype(c.dtype).itemsize,
-            num_layers=c.num_layers)
+            num_layers=c.num_layers, tp=self.tp)
 
     def attention_stats(self) -> Dict:
         """Decode-lane K/V traffic accounting over the run: what the
@@ -739,6 +873,13 @@ class ServeEngine:
         total_live = sum(i["pages_live_total"] for i in infos)
         total_paged = sum(i["kv_bytes"] for i in infos)
         total_gather = sum(i["kv_bytes_gather"] for i in infos)
+        # Per-chip bytes of THIS mode's policy (paged streams live
+        # pages, gather reconstructs the full table): heads shard
+        # exactly, so per-chip is 1/tp of the totals — the honest form
+        # of the TP bandwidth claim (`serve_bench --ab-tp` pins
+        # kv_bytes_per_chip <= unsharded/tp).
+        total_chip = (total_paged if self.config.attention == "paged"
+                      else total_gather) // self.tp
         return {
             "mode": self.config.attention,
             "decode_steps": n,
@@ -754,4 +895,7 @@ class ServeEngine:
                 total_gather // n if n else None,
             "kv_fetch_frac":
                 round(total_paged / total_gather, 4) if n else None,
+            "tp": self.tp,
+            "kv_bytes_per_chip":
+                round(total_chip / n, 1) if n else None,
         }

@@ -215,13 +215,24 @@ class PagedKVCache:
     position-table length must divide into whole pages — the engine's
     gathered per-request cache is then EXACTLY ``[Lmax, H, D]``, the
     decode lane's shape.
+
+    ``kv_sharding`` (a ``NamedSharding`` whose spec shards the HEAD
+    axis over the tensor axis) places the page arrays head-sharded —
+    each chip holds ``[num_pages, page_size, H/tp, D]``, the engine's
+    TP data plane. Everything HOST-side here (the allocator, refcounts,
+    page math) is the replicated control plane: allocation decisions
+    are identical on every chip by construction because there is
+    exactly one allocator making them.
     """
 
-    def __init__(self, params: Dict, config, *, abstract: bool = False):
+    def __init__(self, params: Dict, config, *, abstract: bool = False,
+                 kv_sharding=None):
         import jax
         import jax.numpy as jnp
 
         self.config = config
+        #: Device placement of the page arrays (None = single-chip).
+        self.kv_sharding = kv_sharding
         self.max_len = int(params["pos"].shape[0])
         if self.max_len % config.page_size:
             raise ValueError(
@@ -239,6 +250,9 @@ class PagedKVCache:
                  self.head_dim)
         if abstract:
             mk = lambda: jax.ShapeDtypeStruct(shape, self.dtype)  # noqa: E731
+        elif kv_sharding is not None:
+            mk = lambda: jax.device_put(jnp.zeros(shape, self.dtype),  # noqa: E731
+                                        kv_sharding)
         else:
             mk = lambda: jnp.zeros(shape, self.dtype)  # noqa: E731
         #: Per-layer ``{"k", "v"}`` page arrays — the engine's step
@@ -260,12 +274,23 @@ class PagedKVCache:
         copy + table swap is the WHOLE cost because the engine threads
         pages functionally and never donates — the original stays
         readable under any in-flight step. Raises :class:`OutOfPages`
-        (no state change) when no page is free."""
+        (no state change) when no page is free.
+
+        Under ``kv_sharding`` the scatter runs SPMD: the page row is
+        elementwise on the sharded head axis, so every chip copies its
+        own H/tp slice of the shared page — one coherent copy across
+        shards (the re-``device_put`` pins the invariant even if a
+        future jax changes scatter sharding propagation)."""
         (new,) = self.allocator.alloc(1)
         try:
             for layer in self.pages:
                 for kv in ("k", "v"):
-                    layer[kv] = layer[kv].at[new].set(layer[kv][page])
+                    upd = layer[kv].at[new].set(layer[kv][page])
+                    if self.kv_sharding is not None:
+                        import jax
+
+                        upd = jax.device_put(upd, self.kv_sharding)
+                    layer[kv] = upd
         except BaseException:
             self.allocator.free([new])
             raise

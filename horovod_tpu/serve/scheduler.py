@@ -1,8 +1,15 @@
 """Request lifecycle + the SLO-knobbed scheduler.
 
 The scheduler is pure host bookkeeping between compiled steps — it
-never touches device arrays. It owns three decisions per step, each
-behind one :class:`~horovod_tpu.serve.config.ServeConfig` knob:
+never touches device arrays. That host-side purity is also what makes
+the TP-sharded engine's control plane trivially REPLICATED: under
+``ServeConfig.mesh`` the step program runs SPMD with head-sharded
+pages, but admission, page tables, eviction picks and the prefix
+index still happen exactly once here, so every chip executes the step
+with identical tables by construction — no cross-chip agreement
+protocol exists because there is nothing to disagree about. It owns
+three decisions per step, each behind one
+:class:`~horovod_tpu.serve.config.ServeConfig` knob:
 
 * **queue order** (``policy``): ``fcfs`` arrival order, or ``sjf``
   shortest-prompt-first (minimizes mean TTFT under backlog at the cost

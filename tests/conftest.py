@@ -213,15 +213,33 @@ _SLOW_TESTS = {
     # pins the in-process launcher plumbing fast, and the elastic e2e
     # lanes drive launch_job with real collectives every run.
     "test_launcher.py::TestRunFn::test_collectives_through_launcher",
-    # 20s: the longest serve-engine exactness matrix entry; the other
+    # 14s: the longest serve-engine exactness matrix entry; the other
     # exactness classes (eviction-recompute, chunk-invariance, single
     # request, max_new=1) stay fast in both attention modes, and the
     # check.sh serve smoke re-pins greedy==lm_decode end-to-end.
-    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[gather]",
-    # Round-17 re-budget: the paged twin (21s) joins it on the same
-    # grounds — the other exactness classes keep both attention modes
-    # fast.
-    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[paged]",
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[gather-tp1]",
+    # Round-17 re-budget: the paged twin joins it on the same grounds
+    # — the other exactness classes keep both attention modes fast.
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[paged-tp1]",
+    # The tp=4 staggered twins (6s each: SPMD compile + 6 lm_decode
+    # refs) follow their tp1 parents to the slow lane; fast stand-ins
+    # for staggered-under-TP are the tp4 single-request/eviction/
+    # max_new exactness cells plus the check.sh TP smoke, which runs
+    # a multi-request tp=4-vs-tp=1 A/B end-to-end.
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[gather-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_staggered_joins_bit_identical[paged-tp4]",
+    # Chunk-invariance under tp=4: chunk=4 (the ragged non-divisor)
+    # stays fast in BOTH attention modes as the named stand-in; the
+    # 1/3/16 tp4 cells (~3s each, 6 tests) are slow-lane — chunking
+    # itself is pinned fast by the full tp1 chunk matrix, and the tp4
+    # concern (SPMD prefill rows == lm_prefill rows) is chunk-size-
+    # independent by construction.
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[1-gather-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[1-paged-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[3-gather-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[3-paged-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[16-gather-tp4]",
+    "test_serve_engine.py::TestGreedyExactness::test_chunked_prefill_is_chunk_invariant[16-paged-tp4]",
     # 35s + 38s whole-bench ab-prefix subprocess wrappers (each runs a
     # cold AND a warm serve/fleet bench): stand-ins are the fast
     # in-process prefix pins — test_serve_prefix.py TestEngineHits
@@ -230,6 +248,13 @@ _SLOW_TESTS = {
     # the single-engine --ab-prefix contract end-to-end.
     "test_serve_bench.py::TestServeBenchContract::test_ab_prefix_record_contract",
     "test_serve_bench.py::TestFleetBenchContract::test_fleet_ab_prefix_record_contract",
+    # ~20s whole-bench --ab-tp subprocess wrapper (tp=1 AND tp=4 SPMD
+    # compiles): stand-ins are the fast in-process tp4 exactness cells
+    # (test_serve_engine.py TestGreedyExactness mesh matrix +
+    # TestTPSharding per-chip pins) and the check.sh TP smoke, which
+    # runs the --ab-tp contract end-to-end; the cheap
+    # test_ab_tp_arg_validation stays fast.
+    "test_serve_bench.py::TestServeBenchContract::test_ab_tp_record_contract",
     # 13s np=2 torch multi-process ops: the torch TestMultiProcess
     # matrix goes fully slow-lane, matching the tf-binding precedent
     # (its whole TestMultiProcess class has been slow-marked for

@@ -128,8 +128,16 @@ def test_registry_shape():
         "elastic.windowed_loop", "elastic.windowed_loop_resized"}
     assert all(p.forbid_donation for p in elastic)
     serve = by_group["serve"]
-    assert {p.name for p in serve} == {"serve.step", "serve.step_paged"}
+    assert {p.name for p in serve} == {
+        "serve.step", "serve.step_paged",
+        "serve.step_tp", "serve.step_tp_paged"}
     assert all(p.forbid_donation for p in serve)
+    # The TP variants carry the full HVV2xx surface (sharding table +
+    # bound LogicalMesh), like the composed stacks.
+    tp_serve = [p for p in serve if "_tp" in p.name]
+    assert len(tp_serve) == 2
+    assert all(p.shardings is not None for p in tp_serve)
+    assert all(p.logical_mesh is not None for p in tp_serve)
     assert all(p.reconcile is not None for p in by_group["optimizer"])
     # The composed-stack lanes (logical-axis registry): each carries
     # the full HVV2xx surface — a sharding table, a bound LogicalMesh
@@ -301,6 +309,98 @@ def test_serve_step_paged_verifies_and_donating_variant_is_flagged(hvd):
                      args, name="serve-paged-donating",
                      forbid_donation=True, forbid_donation_why=_SERVE_WHY)
     assert "HVV104" in [f.rule for f in flagged.findings]
+
+
+@pytest.mark.parametrize("attention", ["gather", "paged"])
+def test_serve_step_tp_verifies_and_donating_variant_is_flagged(
+        hvd, attention):
+    """The TP-sharded step (this PR): the SPMD spelling verifies clean
+    under forbid_donation + the full HVV2xx surface, with a NON-empty
+    collective schedule (the TP all-reduces/all-gathers) — and the
+    donate-the-pages variant is still an HVV104 finding: donation of
+    any head-shard of a live page is the same bug, per chip."""
+    import functools
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tools.hvdverify.registry import (
+        _SERVE_WHY,
+        _build_serve_step_tp,
+        _logical_mesh,
+        _serve_tp_logical_mesh,
+        _serve_tp_shardings,
+        _shmapped,
+    )
+
+    fn, args = _build_serve_step_tp(attention=attention)
+    clean = verify(fn, args, name=f"serve.step_tp[{attention}]",
+                   forbid_donation=True, forbid_donation_why=_SERVE_WHY,
+                   shardings=_serve_tp_shardings(),
+                   logical_mesh=_serve_tp_logical_mesh())
+    assert not clean.findings
+    # Unlike the tp=1 step, the schedule is NOT empty: the TP
+    # reductions (attention output, MLP down-proj, vocab all-gather)
+    # are the whole point.
+    assert clean.summary["count"] > 0
+
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve.engine import serve_step
+
+    lm = _logical_mesh("dp=1,tp=4")
+    tp_ax = lm.role_axis("tensor")
+    kv = P(None, None, tp_ax, None)
+    specs = lm_param_specs(2, tp_ax, vocab_parallel=True)
+    step = functools.partial(serve_step, page_size=8,
+                             attention=attention, tp=tp_ax,
+                             vocab_parallel=True)
+    donating = jax.jit(
+        _shmapped(lambda p, pages, d, pr: step(p, pages, d, pr),
+                  lm.mesh, in_specs=(specs, kv, P(), P()),
+                  out_specs=(kv, P(), P())),
+        donate_argnums=(1,))    # donate the (sharded) pages
+    flagged = verify(lambda p, pages, d, pr: donating(p, pages, d, pr),
+                     args, name="serve-tp-donating",
+                     forbid_donation=True, forbid_donation_why=_SERVE_WHY)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+
+
+def test_serve_step_tp_rogue_axis_is_flagged(hvd):
+    """HVV202 pin for the serve TP lane: run the same step over a mesh
+    whose axis the bound LogicalMesh does NOT define ('rogue' instead
+    of 'tp') — every TP collective then spells an axis outside the
+    mesh vocabulary, and each is a finding. This is the smuggled-
+    physical-spelling class the rules table exists to prevent."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from tools.hvdverify.registry import (
+        _build_serve_step_tp,
+        _serve_tp_logical_mesh,
+        _shmapped,
+        _submesh,
+    )
+
+    _, args = _build_serve_step_tp()
+
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve.engine import serve_step
+
+    mesh = _submesh({"rogue": 4})
+    kv = P(None, None, "rogue", None)
+    specs = lm_param_specs(2, "rogue", vocab_parallel=True)
+    step = functools.partial(serve_step, page_size=8, tp="rogue",
+                             vocab_parallel=True)
+    rogue = _shmapped(lambda p, pages, d, pr: step(p, pages, d, pr),
+                      mesh, in_specs=(specs, kv, P(), P()),
+                      out_specs=(kv, P(), P()))
+    flagged = verify(lambda p, pages, d, pr: rogue(p, pages, d, pr),
+                     args, name="serve-tp-rogue-axis",
+                     logical_mesh=_serve_tp_logical_mesh())
+    rules = [f.rule for f in flagged.findings]
+    assert rules and set(rules) == {"HVV202"}
+    assert any("rogue" in f.message for f in flagged.findings)
 
 
 def test_while_condition_findings_are_merged(hvd):

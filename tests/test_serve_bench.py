@@ -142,6 +142,47 @@ class TestServeBenchContract:
                      check=False)
             assert p.returncode == 2, (extra, p.stderr[-300:])
 
+    def test_ab_tp_record_contract(self):
+        """--ab-tp (round-18 acceptance): the identical workload runs
+        unsharded then head-sharded over dp=1,tp=4; the bench aborts
+        unless every greedy stream is bit-identical and the sharded
+        side's per-chip KV bytes are at most 1/tp — so a passing run
+        IS the exactness+bandwidth evidence, and the record stamps
+        serve.tp{degree, kv_bytes_per_chip, tp_over_single}."""
+        p = _run("serve_bench.py", *TINY, "--heads", "4",
+                 "--mesh", "dp=1,tp=4", "--ab-tp",
+                 "--pin-exact", "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_ab_tp_tokens_per_sec_per_chip"
+        s = rec["serve"]
+        assert s["mode"] == "ab_tp"
+        assert s["by_state"] == {"finished": 6}
+        assert s["attention"]["tp"] == 4
+        tp = s["tp"]
+        assert tp["degree"] == 4 and tp["mesh"] == "dp=1,tp=4"
+        assert tp["exact_pin"]["identical"] is True
+        assert tp["exact_pin"]["compared"] == 6
+        assert tp["kv_bytes_per_chip"] == pytest.approx(
+            tp["kv_bytes_per_chip_single"] / 4, rel=1e-3)
+        assert tp["tp_over_single"] is not None
+        assert rec["config"]["mesh"] == "dp=1,tp=4"
+        # the perf_summary serve column renders the tp tag
+        from tools.perf_summary import serve_cell
+
+        cell = serve_cell(rec)
+        assert " tp4 kv 0.25x" in cell
+
+    def test_ab_tp_arg_validation(self):
+        # --ab-tp without a mesh, with another A/B, a mesh that
+        # resolves to tp=1, and mesh+fleet are all argparse errors
+        for argv in (["--ab-tp"],
+                     ["--mesh", "dp=1,tp=2", "--ab-tp", "--ab"],
+                     ["--mesh", "dp=1", "--ab-tp"],
+                     ["--mesh", "garbage", "--ab-tp"],
+                     ["--mesh", "dp=1,tp=2", "--fleet", "2"]):
+            p = _run("serve_bench.py", *TINY, *argv, check=False)
+            assert p.returncode == 2, (argv, p.stderr[-300:])
+
     def test_require_finished_fails_loudly(self):
         # capacity of ONE page (8 positions): several drawn requests
         # can never fit and hard-reject -> --require-finished exits 1

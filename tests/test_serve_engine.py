@@ -10,7 +10,14 @@ gather reference AND the fused paged-attention kernel
 (horovod_tpu/ops/paged_attention.py, interpret mode on CPU). The
 whole exactness matrix is attention-parametrized; the paged path
 additionally pins its static traffic accounting (pages streamed per
-step = ``ceil((t+1)/page_size)`` per slot)."""
+step = ``ceil((t+1)/page_size)`` per slot).
+
+The same matrix is additionally MESH-parametrized (``mesh=None`` vs
+the tp=4 virtual CPU mesh): under ``ServeConfig.mesh`` the step runs
+SPMD with head-sharded pages and a vocab-parallel head, and every
+greedy pin must hold bit-identically — the geometry here (H=4) divides
+tp=4 exactly for that reason. Heavy tp4 combinations are slow-marked
+in tests/conftest.py with the fast stand-ins named there."""
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +27,13 @@ import pytest
 from horovod_tpu.models import parallel_lm as plm
 from horovod_tpu.serve import ServeConfig, ServeEngine
 
-V, LMAX, LAYERS, H, DH, FFN = 64, 64, 2, 2, 8, 32
+V, LMAX, LAYERS, H, DH, FFN = 64, 64, 2, 4, 4, 32
+
+#: The mesh matrix: unsharded reference vs TP over the virtual CPU
+#: mesh (tests/conftest.py forces 8 host devices; tp=4 takes the
+#: prefix). One spelling, shared by every parametrized class.
+MESHES = [None, "dp=1,tp=4"]
+MESH_IDS = ["tp1", "tp4"]
 
 
 @pytest.fixture(scope="module")
@@ -40,13 +53,15 @@ def _ref(params, prompt, steps):
         plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
 
 
+@pytest.mark.parametrize("mesh", MESHES, ids=MESH_IDS)
 @pytest.mark.parametrize("attention", ["gather", "paged"])
 class TestGreedyExactness:
-    def test_single_request_matches_lm_decode(self, params, attention):
+    def test_single_request_matches_lm_decode(self, params, attention,
+                                              mesh):
         prompt = _prompt(0, 7)
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4,
-            attention=attention))
+            attention=attention, mesh=mesh))
         req = eng.submit(prompt, 9)
         eng.run()
         assert req.state == "finished"
@@ -54,19 +69,20 @@ class TestGreedyExactness:
 
     @pytest.mark.parametrize("chunk", [1, 3, 4, 16])
     def test_chunked_prefill_is_chunk_invariant(self, params, chunk,
-                                                attention):
+                                                attention, mesh):
         """Any prefill chunking (1-token, non-divisible, whole-prompt)
         yields the identical stream — the rectangular-causal chunk
         rows reproduce lm_prefill's rows exactly."""
         prompt = _prompt(1, 11)
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=32, decode_slots=1,
-            prefill_chunk=chunk, attention=attention))
+            prefill_chunk=chunk, attention=attention, mesh=mesh))
         req = eng.submit(prompt, 5)
         eng.run()
         assert req.output == _ref(params, prompt, 5)
 
-    def test_staggered_joins_bit_identical(self, params, attention):
+    def test_staggered_joins_bit_identical(self, params, attention,
+                                           mesh):
         """The acceptance pin: requests join the running batch at
         different steps; every greedy stream must equal its own
         independent lm_decode call."""
@@ -76,7 +92,7 @@ class TestGreedyExactness:
                 for p, (_, n) in zip(prompts, spec)]
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=40, decode_slots=2, prefill_chunk=4,
-            attention=attention))
+            attention=attention, mesh=mesh))
         reqs = [eng.submit(prompts[0], spec[0][1]),
                 eng.submit(prompts[1], spec[1][1])]
         for _ in range(3):
@@ -92,7 +108,8 @@ class TestGreedyExactness:
             assert req.state == "finished"
             assert req.output == ref
 
-    def test_eviction_recompute_stays_exact(self, params, attention):
+    def test_eviction_recompute_stays_exact(self, params, attention,
+                                            mesh):
         """Lazy admission under page pressure: requests get evicted,
         requeued with their generated prefix, re-prefilled — and the
         final streams are still bit-identical to lm_decode."""
@@ -101,7 +118,7 @@ class TestGreedyExactness:
         refs = [_ref(params, p, n) for p, (_, n) in zip(prompts, spec)]
         eng = ServeEngine(params, ServeConfig(
             page_size=4, num_pages=8, decode_slots=2, prefill_chunk=4,
-            admission="lazy", attention=attention))
+            admission="lazy", attention=attention, mesh=mesh))
         reqs = [eng.submit(p, n) for p, (_, n) in zip(prompts, spec)]
         eng.run(max_steps=500)
         assert sum(r.evictions for r in reqs) > 0, \
@@ -111,11 +128,11 @@ class TestGreedyExactness:
             assert req.output == ref
 
     def test_max_new_tokens_one_finishes_at_prefill(self, params,
-                                                    attention):
+                                                    attention, mesh):
         prompt = _prompt(2, 6)
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8,
-            attention=attention))
+            attention=attention, mesh=mesh))
         req = eng.submit(prompt, 1)
         eng.run()
         assert req.state == "finished"
@@ -246,17 +263,20 @@ class TestSampling:
         assert outs[0] == outs[1]
         assert all(0 <= t < V for t in outs[0])
 
+    @pytest.mark.parametrize("mesh", MESHES, ids=MESH_IDS)
     @pytest.mark.parametrize("attention", ["gather", "paged"])
     def test_greedy_rows_unaffected_by_sampling_neighbors(self, params,
-                                                          attention):
+                                                          attention,
+                                                          mesh):
         """A greedy request sharing steps with a temperature request
         stays bit-identical to lm_decode (per-slot sampling knobs) —
-        the mixed greedy+sampling cell of the attention matrix."""
+        the mixed greedy+sampling cell of the attention AND mesh
+        matrix (the sampler reads full-vocab logits either way)."""
         pg, ps = _prompt(7, 6), _prompt(8, 6)
         ref = _ref(params, pg, 6)
         eng = ServeEngine(params, ServeConfig(
             page_size=8, num_pages=32, decode_slots=2, prefill_chunk=4,
-            attention=attention))
+            attention=attention, mesh=mesh))
         rg = eng.submit(pg, 6)
         rs = eng.submit(ps, 6, temperature=1.2, top_k=4, seed=9)
         eng.run()
@@ -504,3 +524,143 @@ class TestUpdateParams:
                                    LAYERS, H, DH, FFN)
         with pytest.raises(ValueError, match="geometry"):
             eng.update_params(small)
+
+
+class TestMeshValidation:
+    """Satellite: the fail-fast truth table. Bad mesh strings die at
+    ``ServeConfig`` construction; geometry that parses but cannot be
+    satisfied (heads/mlp/vocab not divisible, device budget) dies at
+    ``ServeEngine`` construction — NEVER at first compile. Every raise
+    is :class:`InvalidArgumentError` (a ``ValueError``, so plain
+    callers stay portable)."""
+
+    @pytest.mark.parametrize("bad", [
+        "garbage",            # not k=v at all
+        "dp=2,tp=2",          # non-tensor axis > 1: the fleet's job
+        "dp=1,tp=-1",         # wildcards not allowed: fully specified
+        "tp=0",               # non-positive axis
+        "",                   # empty string is not "no mesh"
+    ])
+    def test_bad_mesh_string_raises_at_config(self, bad):
+        from horovod_tpu.common.exceptions import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError):
+            ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                        prefill_chunk=4, mesh=bad)
+
+    def test_heads_not_divisible_raises_at_engine(self, params):
+        from horovod_tpu.common.exceptions import InvalidArgumentError
+        cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                          prefill_chunk=4, mesh="dp=1,tp=3")
+        with pytest.raises(InvalidArgumentError, match="num_heads"):
+            ServeEngine(params, cfg)
+
+    def test_vocab_not_divisible_raises_at_engine(self):
+        from horovod_tpu.common.exceptions import InvalidArgumentError
+        odd = plm.init_lm_params(jax.random.PRNGKey(9), 66, 32, 1, 4,
+                                 4, 16)
+        cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                          prefill_chunk=4, mesh="dp=1,tp=4")
+        with pytest.raises(InvalidArgumentError, match="vocab"):
+            ServeEngine(odd, cfg)
+
+    def test_device_budget_raises_at_engine(self, params):
+        from horovod_tpu.common.exceptions import InvalidArgumentError
+        cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                          prefill_chunk=4, mesh="dp=1,tp=16")
+        with pytest.raises(InvalidArgumentError, match="device"):
+            ServeEngine(params, cfg)
+
+    def test_valid_mesh_constructs_without_compiling(self, params):
+        # Construction places params/pages but compiles nothing (jit
+        # is lazy) — so this is cheap AND proves validation happened
+        # already, not at first step.
+        cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                          prefill_chunk=4, mesh="dp=1,tp=2")
+        eng = ServeEngine(params, cfg)
+        assert eng.tp == 2 and eng.logical_mesh is not None
+
+    def test_tp_degree_property(self):
+        assert ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                           prefill_chunk=4,
+                           mesh="dp=1,tp=4").tp_degree == 4
+        assert ServeConfig(page_size=8, num_pages=16, decode_slots=1,
+                           prefill_chunk=4).tp_degree == 1
+
+
+class TestTPSharding:
+    """Pins on the sharded data plane itself: page placement, per-chip
+    byte accounting, COW coherence, and prefix hits under tp=4."""
+
+    def test_kv_pages_are_head_sharded(self, params):
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=4,
+            mesh="dp=1,tp=4"))
+        assert eng.cache.kv_sharding is not None
+        for layer in eng.cache.pages:
+            for kv in ("k", "v"):
+                arr = layer[kv]
+                assert arr.shape[2] == H
+                shard = arr.addressable_shards[0].data
+                assert shard.shape[2] == H // 4  # heads/tp per chip
+                # every other dim stays whole on each chip
+                assert (shard.shape[0], shard.shape[1], shard.shape[3]) \
+                    == (arr.shape[0], arr.shape[1], arr.shape[3])
+
+    def test_paged_grid_info_per_chip_accounting(self):
+        from horovod_tpu.ops.paged_attention import paged_grid_info
+        kw = dict(page_size=8, pages_per_seq=8, num_heads=4,
+                  head_dim=4, dtype_bytes=4, num_layers=2)
+        one = paged_grid_info([17, 3], tp=1, **kw)
+        four = paged_grid_info([17, 3], tp=4, **kw)
+        assert one["kv_bytes_per_chip"] == one["kv_bytes"]
+        assert four["kv_bytes_per_chip"] == one["kv_bytes"] // 4
+        assert (four["kv_bytes_gather_per_chip"]
+                == one["kv_bytes_gather"] // 4)
+        assert four["tp"] == 4
+        # same traffic model, only the per-chip slice changes
+        assert four["kv_bytes"] == one["kv_bytes"]
+        with pytest.raises(ValueError, match="divide"):
+            paged_grid_info([17], tp=3, **kw)
+
+    def test_attention_stats_carry_per_chip_bytes(self, params):
+        eng = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=8,
+            mesh="dp=1,tp=4"))
+        eng.submit(_prompt(60, 5), 4)
+        eng.run()
+        attn = eng.stats()["attention"]
+        assert attn["tp"] == 4
+        # gather mode reconstructs the full table; per-chip is 1/tp
+        assert attn["kv_bytes_per_chip"] == pytest.approx(
+            attn["kv_bytes_per_step_gather"] / 4, rel=1e-6)
+
+    def test_prefix_hits_and_cow_stay_sharded(self, params):
+        """Prefix-cache hits under tp=4 reuse head-sharded pages, the
+        streams stay bit-identical to the unsharded engine, and a
+        copy-on-write of a shared sharded page lands on every chip
+        (COW-under-sharding coherence pin)."""
+        sys_p = _prompt(61, 16)
+        mk = lambda mesh: ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=32, decode_slots=1, prefill_chunk=8,
+            prefix_caching=True, mesh=mesh))
+        outs = {}
+        for mesh in MESHES:
+            eng = mk(mesh)
+            reqs = [eng.submit(
+                np.concatenate([sys_p, _prompt(62 + i, 3)]), 4)
+                    for i in range(2)]
+            eng.run()
+            assert eng.prefix_stats()["hits"] >= 1
+            outs[mesh] = [r.output for r in reqs]
+            if mesh is not None:
+                spec = eng.cache.kv_sharding.spec
+                live = eng.cache.pages[0]["k"]
+                new = eng.cache.cow_page(1)
+                for layer in eng.cache.pages:
+                    for kv in ("k", "v"):
+                        assert layer[kv].sharding.spec == spec
+                # the copy really happened, on-device and sharded
+                got = np.asarray(eng.cache.pages[0]["k"][new])
+                np.testing.assert_array_equal(
+                    got, np.asarray(live[1]))
+        assert outs[None] == outs["dp=1,tp=4"]

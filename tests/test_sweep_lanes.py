@@ -81,6 +81,29 @@ def test_image_only_flags_not_on_lm_lanes(lanes, parser):
                 f"{lane}: --flash-full-grid without the flash path"
 
 
+def test_serve_tp_lane_geometry_divides(lanes):
+    """The serve_tp_ab lane must not fail-fast on the chip: the tp
+    degree it requests has to divide the default model geometry
+    (heads, mlp = 4*d_model, vocab — tools/lm_common.py defaults),
+    because ServeEngine raises InvalidArgumentError at construction
+    otherwise. A mis-paired lane edit dies here in milliseconds."""
+    entry = next(e for e in lanes if e[0] == "serve_tp_ab")
+    cmd = entry[1]
+    assert cmd[0] == "tools/serve_bench.py"
+    assert "--ab-tp" in cmd and "--mesh" in cmd
+    mesh = cmd[cmd.index("--mesh") + 1]
+    axes = dict(kv.split("=") for kv in mesh.split(","))
+    tp = int(axes["tp"])
+    assert tp > 1, "the A/B needs a sharded side"
+    heads, d_model, vocab = 12, 768, 32000  # lm_common defaults
+    assert heads % tp == 0
+    assert (4 * d_model) % tp == 0
+    assert vocab % tp == 0
+    # every non-tensor axis must be 1 (data parallelism is the
+    # fleet's job — ServeConfig rejects dp>1)
+    assert all(int(v) == 1 for k, v in axes.items() if k != "tp")
+
+
 def test_parser_builds_without_backend_init(parser):
     """build_parser must not initialize a backend (the sweep imports it
     on a box whose tunnel may be wedged): bench.py defers its jax import

@@ -101,6 +101,16 @@
 #                               greedy streams stay bit-identical to
 #                               the clean run, zero leftover workers)
 #   tools/check.sh --no-prefix  skip the prefix-caching smoke
+#   tools/check.sh --no-tp-serve  skip the TP-decode smoke (round-18
+#                               tentpole: the identical 8-request
+#                               workload unsharded then SPMD over a
+#                               dp=1,tp=4 virtual CPU mesh — KV pages
+#                               head-sharded, vocab-parallel logits —
+#                               in BOTH decode-attention modes; the
+#                               bench aborts unless every greedy
+#                               stream is bit-identical across tp=1
+#                               vs tp=4 and per-chip KV bytes are at
+#                               most 1/4 of the single-chip bytes)
 #   tools/check.sh --no-hier    skip the hierarchical smoke
 #   tools/check.sh --sanitize   additionally rebuild csrc/ under ASAN and
 #                               TSAN (HVD_SANITIZE=address|thread through
@@ -119,6 +129,7 @@ FLEET_PROC=1
 FLEET_TCP=1
 FLEET_UPDATE=1
 PREFIX=1
+TP_SERVE=1
 HIER=1
 VERIFY=0
 for arg in "$@"; do
@@ -131,9 +142,10 @@ for arg in "$@"; do
     --no-fleet-tcp) FLEET_TCP=0 ;;
     --no-fleet-update) FLEET_UPDATE=0 ;;
     --no-prefix) PREFIX=0 ;;
+    --no-tp-serve) TP_SERVE=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-tp-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -181,6 +193,36 @@ t = s["ttft_ms"]
 print("serve smoke [%s]: all 8 finished, TTFT p50/p99 = %s/%s ms, "
       "decode K/V frac %s" % (a["mode"], t["p50"], t["p99"],
                               a["kv_fetch_frac"]))
+'
+  done
+fi
+
+if [[ "$TP_SERVE" == "1" ]]; then
+  echo "== TP-decode smoke (dp=1,tp=4 virtual mesh: greedy streams bit-identical tp=1 vs tp=4, per-chip KV <= 1/4; gather + paged) =="
+  for ATTN in gather paged; do
+    TP_OUT=$(JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/serve_bench.py \
+      --layers 2 --d-model 64 --heads 4 --vocab 128 \
+      --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
+      --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+      --page-size 8 --attention "$ATTN" --mesh dp=1,tp=4 --ab-tp \
+      --pin-exact --require-finished)
+    echo "$TP_OUT" | ATTN="$ATTN" python -c '
+import json, os, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "ab_tp", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+assert s["attention"]["mode"] == os.environ["ATTN"], s["attention"]
+tp = s["tp"]
+assert tp["degree"] == 4, tp
+assert tp["exact_pin"]["identical"] and tp["exact_pin"]["compared"] == 8, tp
+assert tp["kv_bytes_per_chip"] <= tp["kv_bytes_per_chip_single"] / 4 * 1.001, tp
+print("tp smoke [%s]: 8 greedy streams bit-identical tp=1 vs tp=4, "
+      "kv/chip %s vs %s single" % (s["attention"]["mode"],
+                                   tp["kv_bytes_per_chip"],
+                                   tp["kv_bytes_per_chip_single"]))
 '
   done
 fi

@@ -981,6 +981,95 @@ def _build_serve_step(attention: str = "gather"):
         (params, cache.pages, dec, pre)
 
 
+_SERVE_TP_MESH = "dp=1,tp=4"
+#: TP-variant geometry: heads=4 so the head dim divides tp=4 (the
+#: engine fail-fasts otherwise); embed stays 16 (4 heads x head_dim 4),
+#: vocab 64 and mlp 32 both divide 4 for the vocab-/column-parallel
+#: shards.
+_SERVE_TP_GEOM = (64, 32, 2, 4, 4, 32)  # V, Lmax, layers, H, DH, FFN
+
+
+def _build_serve_step_tp(attention: str = "gather"):
+    """The TP-sharded serving step exactly as ServeEngine spells it
+    when ``ServeConfig.mesh`` binds a tensor axis (engine.py __init__):
+    ``serve_step`` under shard_map on the dp=1,tp=4 LogicalMesh —
+    Megatron params via ``lm_param_specs(vocab_parallel=True)``, KV
+    pages head-sharded ``P(None, None, tp, None)`` in AND out, host
+    control dicts replicated, logits replicated full-vocab (the
+    vocab-parallel head all-gathers, so the host sampler sees every
+    column). Same donation invariant as serve.step — a live page's
+    SHARDS must stay readable on every chip — plus the HVV2xx sweep:
+    the declared specs must match what the rules table resolves for
+    heads/mlp/vocab, and every collective must run over a mesh-defined
+    axis."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step
+
+    V, LMAX, LAYERS, H, DH, FFN = _SERVE_TP_GEOM
+    lm = _logical_mesh(_SERVE_TP_MESH)
+    tp_ax = lm.role_axis("tensor")
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4, attention=attention,
+                      mesh=_SERVE_TP_MESH)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX,
+                                   LAYERS, H, DH, FFN))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S, C = cfg.decode_slots, cfg.prefill_chunk
+    sds = jax.ShapeDtypeStruct
+    dec = {"tok": sds((S,), jnp.int32), "pos": sds((S,), jnp.int32),
+           "active": sds((S,), jnp.bool_),
+           "tables": sds((S, pps), jnp.int32)}
+    pre = {"tokens": sds((C,), jnp.int32), "start": sds((), jnp.int32),
+           "length": sds((), jnp.int32),
+           "table": sds((pps,), jnp.int32)}
+    param_specs = lm_param_specs(LAYERS, tp_ax, vocab_parallel=True)
+    kv = P(None, None, tp_ax, None)
+    step = functools.partial(serve_step, page_size=cfg.page_size,
+                             attention=cfg.attention, tp=tp_ax,
+                             vocab_parallel=True)
+    fn = jax.jit(_shmapped(
+        lambda p, pages, d, pr: step(p, pages, d, pr), lm.mesh,
+        in_specs=(param_specs, kv, P(), P()),
+        out_specs=(kv, P(), P())))
+    return (lambda p, pages, d, pr: fn(p, pages, d, pr)), \
+        (params, cache.pages, dec, pre)
+
+
+def _serve_tp_shardings():
+    """HVV201 claims for the TP step: the Megatron param placement +
+    the head-sharded page pool, all resolved through the rules table
+    (heads/mlp/vocab -> the tensor axis on this mesh)."""
+    from jax.sharding import PartitionSpec as P
+
+    lm = _logical_mesh(_SERVE_TP_MESH)
+    tp_ax = lm.role_axis("tensor")
+    return ShardingSpec(mesh=lm, entries=(
+        ("kv_pages", (None, None, "heads", None),
+         P(None, None, tp_ax, None)),
+        ("wqkv", (None, None, "heads", None),
+         P(None, None, tp_ax, None)),
+        ("wo", ("heads", None, None), P(tp_ax, None, None)),
+        ("w_up", (None, "mlp"), lm.spec(None, "mlp")),
+        ("b_up", ("mlp",), lm.spec("mlp")),
+        ("w_down", ("mlp", None), lm.spec("mlp", None)),
+        ("head", (None, "vocab"), lm.spec(None, "vocab")),
+    ))
+
+
+def _serve_tp_logical_mesh():
+    return _logical_mesh(_SERVE_TP_MESH)
+
+
 # -------------------------------------------------------------- registry
 
 
@@ -1084,6 +1173,31 @@ def _make_registry() -> List[Program]:
         lambda: _build_serve_step(attention="paged"),
         forbid_donation=True,
         forbid_donation_why=_SERVE_WHY))
+
+    # The TP-sharded step (ServeConfig.mesh="dp=1,tp=4"): the same
+    # page-donation invariant — shards of a live page on every chip —
+    # PLUS the full HVV2xx sharding sweep (declared specs vs the rules
+    # table, axis vocabulary, bound LogicalMesh), in both
+    # decode-attention modes.
+    progs.append(Program(
+        "serve.step_tp", "serve",
+        lambda: _build_serve_step_tp(),
+        forbid_donation=True,
+        forbid_donation_why=_SERVE_WHY + (
+            " — TP edition: every chip holds a head-shard of each "
+            "live page, and donation on ANY shard corrupts the "
+            "replicated page table's view"),
+        shardings=_serve_tp_shardings,
+        logical_mesh=_serve_tp_logical_mesh))
+    progs.append(Program(
+        "serve.step_tp_paged", "serve",
+        lambda: _build_serve_step_tp(attention="paged"),
+        forbid_donation=True,
+        forbid_donation_why=_SERVE_WHY + (
+            " — TP edition, paged kernel per-shard under shard_map "
+            "(grid head dim = H/tp)"),
+        shardings=_serve_tp_shardings,
+        logical_mesh=_serve_tp_logical_mesh))
 
     return progs
 

@@ -199,6 +199,21 @@ LANES = [
                          "--new-max", "256", "--fleet", "2",
                          "--system-prompt-len", "256", "--ab-prefix",
                          "--require-finished"]),
+    # TP-sharded decode A/B (round-18 tentpole, ServeConfig.mesh +
+    # the SPMD step): the IDENTICAL workload through one engine twice
+    # — unsharded, then head-sharded over dp=1,tp=4 (KV pages
+    # [pages, page_size, H/tp, D] per chip, Megatron params,
+    # vocab-parallel logits all-gathered so the host sampler sees the
+    # full row). The bench ABORTS unless every greedy stream is
+    # bit-identical across the sides and the sharded side's
+    # kv_bytes_per_chip is at most 1/tp of the single-chip bytes;
+    # serve.tp stamps degree/per-chip-bytes/wall-clock ratio. Default
+    # geometry (12 heads, 32000 vocab, 4x mlp) divides tp=4 exactly —
+    # the engine fail-fasts otherwise.
+    ("serve_tp_ab", ["tools/serve_bench.py", "--requests", "64",
+                     "--rate", "8", "--new-min", "16",
+                     "--new-max", "256", "--mesh", "dp=1,tp=4",
+                     "--ab-tp", "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

@@ -172,7 +172,10 @@ def serve_cell(rec):
     paged-attention records append "kv 0.13x" (live-pages/gather
     decode K/V byte fraction — ops/paged_attention.paged_grid_info)
     and attention-A/B records "p/g 1.15" (paged-over-gather
-    throughput). Non-serving records render as em-dash."""
+    throughput). TP-A/B records (--ab-tp) append "tp4 kv 0.25x" —
+    the degree plus the sharded side's per-chip K/V bytes as a
+    fraction of the single-chip bytes (heads shard exactly, so 1/tp
+    when the pin held). Non-serving records render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -191,6 +194,13 @@ def serve_cell(rec):
     abat = s.get("ab_attention") or {}
     if abat.get("paged_over_gather") is not None:
         cell += f" p/g {abat['paged_over_gather']:g}"
+    tp = s.get("tp") or {}
+    if tp.get("degree"):
+        cell += f" tp{tp['degree']}"
+        chip, single = (tp.get("kv_bytes_per_chip"),
+                        tp.get("kv_bytes_per_chip_single"))
+        if chip and single:
+            cell += f" kv {round(chip / single, 4):g}x"
     return cell
 
 

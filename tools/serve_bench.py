@@ -33,6 +33,19 @@ Modes:
               ``paged_over_gather`` throughput ratio (the
               gather-vs-paged A/B as one record; exclusive with
               --ab/--static)
+  --mesh      bind a LogicalMesh to the engine (``ServeConfig.mesh``,
+              e.g. ``dp=1,tp=4``): the compiled step runs SPMD with
+              KV pages head-sharded across the tensor axis, Megatron
+              param placement and vocab-parallel logits; per-chip
+              metrics divide by the tp degree
+  --ab-tp     run the IDENTICAL workload unsharded then TP-sharded
+              over ``--mesh`` and stamp both + ``serve.tp`` (degree,
+              per-chip KV bytes, wall-clock ratio). Two aborts ride
+              the lane: every greedy stream bit-identical across the
+              sides (head sharding is a layout change, not a numerics
+              change) and the sharded side's ``kv_bytes_per_chip`` at
+              most 1/tp of the single-chip bytes. Exclusive with the
+              other A/Bs and --fleet
   --prefix    enable copy-on-write prefix caching
               (``ServeConfig.prefix_caching`` — the radix index in
               horovod_tpu/serve/prefix.py) for whatever mode runs;
@@ -158,10 +171,12 @@ def _warm(eng, workload):
 
 def run_continuous(params, cfg, workload, warm=True):
     """Continuous batching under the open-loop clock; returns the
-    engine (drained)."""
+    engine (drained). A TP mesh on the config makes per-chip metrics
+    honest: the engine spans ``tp_degree`` chips, so tokens/s/chip
+    divides by it."""
     from horovod_tpu.serve import ServeEngine
 
-    eng = ServeEngine(params, cfg)
+    eng = ServeEngine(params, cfg, chips=cfg.tp_degree)
     if warm:
         _warm(eng, workload)
     pending = sorted(workload, key=lambda w: w[0])
@@ -182,7 +197,7 @@ def run_static(params, cfg, workload, warm=True):
     batch drains fully before the next is admitted."""
     from horovod_tpu.serve import ServeEngine
 
-    eng = ServeEngine(params, cfg)
+    eng = ServeEngine(params, cfg, chips=cfg.tp_degree)
     if warm:
         _warm(eng, workload)
     pending = sorted(workload, key=lambda w: w[0])
@@ -390,6 +405,21 @@ def main() -> int:
                     help="continuous engine with BOTH attention paths "
                          "on the same workload; stamp both + the "
                          "paged_over_gather ratio")
+    ap.add_argument("--mesh", default="",
+                    help="ServeConfig.mesh: run the engine step SPMD "
+                         "over a bound LogicalMesh, e.g. 'dp=1,tp=4' "
+                         "(KV pages head-sharded, Megatron params, "
+                         "vocab-parallel logits); per-chip metrics "
+                         "divide by the tp degree. Empty = unsharded")
+    ap.add_argument("--ab-tp", action="store_true",
+                    help="run the IDENTICAL workload unsharded (tp=1) "
+                         "then TP-sharded over --mesh; ABORT unless "
+                         "every greedy stream is bit-identical across "
+                         "the sides AND the sharded side's "
+                         "kv_bytes_per_chip <= 1/tp of the single-chip "
+                         "bytes; stamp serve.tp{degree, "
+                         "kv_bytes_per_chip, tp_over_single} "
+                         "(exclusive with the other A/Bs and --fleet)")
     ap.add_argument("--prefix", action="store_true",
                     help="enable copy-on-write prefix caching "
                          "(ServeConfig.prefix_caching) for whatever "
@@ -502,6 +532,19 @@ def main() -> int:
                  "--rolling-update-at (one A/B per record; the "
                  "redispatch-meets-prefix lane lives in the test "
                  "matrix)")
+    if args.ab_tp:
+        if args.ab or args.static or args.ab_attention or \
+                args.ab_prefix:
+            ap.error("--ab-tp is exclusive with --ab/--static/"
+                     "--ab-attention/--ab-prefix (one A/B per record)")
+        if not args.mesh:
+            ap.error("--ab-tp compares tp=1 against a sharded mesh — "
+                     "it requires --mesh (e.g. --mesh dp=1,tp=4)")
+    if args.mesh and args.fleet:
+        ap.error("--mesh shards ONE engine across chips; the fleet "
+                 "router sees each mesh as a single logical replica "
+                 "and composing the two is not wired into the bench — "
+                 "drop one")
     if args.system_prompt_len < -1:
         ap.error("--system-prompt-len must be >= 0 (-1 = auto)")
     if args.fleet < 0:
@@ -577,13 +620,20 @@ def main() -> int:
     num_pages = args.num_pages
     if num_pages <= 0:
         num_pages = (args.decode_slots + 1) * pages_per_seq + 1
-    cfg = ServeConfig(
-        page_size=ps, num_pages=num_pages,
-        decode_slots=args.decode_slots,
-        prefill_chunk=args.prefill_chunk, policy=args.policy,
-        slo=args.slo, admission=args.admission,
-        attention=args.attention,
-        prefix_caching=args.prefix)
+    try:
+        cfg = ServeConfig(
+            page_size=ps, num_pages=num_pages,
+            decode_slots=args.decode_slots,
+            prefill_chunk=args.prefill_chunk, policy=args.policy,
+            slo=args.slo, admission=args.admission,
+            attention=args.attention,
+            prefix_caching=args.prefix,
+            mesh=args.mesh or None)
+    except ValueError as e:          # bad --mesh string: fail at argparse
+        ap.error(str(e))
+    if args.ab_tp and cfg.tp_degree < 2:
+        ap.error(f"--ab-tp needs a sharded side: --mesh {args.mesh!r} "
+                 f"resolves to tp={cfg.tp_degree}")
 
     params = build_params(args, lmax)
     workload = make_workload(args, system_prompt_len=spl)
@@ -812,6 +862,92 @@ def main() -> int:
             "exact_pin": {"compared": compared, "identical": True},
             "cached_over_cold": ratio,
         })
+    elif args.ab_tp:
+        import dataclasses
+
+        def tp_lane(tag, lane_cfg):
+            eng = run_continuous(params, lane_cfg, workload)
+            stats = eng.stats()
+            attn = stats["attention"]
+            print(f"[serve_bench] {tag}: "
+                  f"{stats['tokens_per_sec_per_chip']} tok/s/chip "
+                  f"x{eng.chips} chip(s), "
+                  f"ttft p50/p99 {stats['ttft_ms']['p50']}/"
+                  f"{stats['ttft_ms']['p99']} ms, "
+                  f"kv_bytes_per_chip {attn['kv_bytes_per_chip']}, "
+                  f"{stats['by_state']}", file=sys.stderr, flush=True)
+            if args.pin_exact:
+                pin_exact(params, eng)
+            if args.require_finished and \
+                    stats["by_state"].get("finished") != args.requests:
+                raise SystemExit(
+                    f"not all requests finished: {stats['by_state']}")
+            reqs = sorted(eng.finished + eng.evicted + eng.timed_out
+                          + eng.scheduler.rejected,
+                          key=lambda r: r.rid)
+            return stats, reqs
+
+        tpd = cfg.tp_degree
+        single, single_reqs = tp_lane(
+            "tp=1", dataclasses.replace(cfg, mesh=None))
+        shard, shard_reqs = tp_lane(f"tp={tpd} [{args.mesh}]", cfg)
+        # The exactness abort: every greedy stream must be
+        # bit-identical across the sides — sharding heads is a layout
+        # change, not a numerics change.
+        if len(single_reqs) != len(shard_reqs):
+            raise SystemExit(
+                f"TP AB PIN FAILED: {len(single_reqs)} requests on the "
+                f"tp=1 side vs {len(shard_reqs)} on tp={tpd}")
+        compared = 0
+        for i, (rs, rt) in enumerate(zip(single_reqs, shard_reqs)):
+            if rs.temperature > 0 or rs.state != "finished" \
+                    or rt.state != "finished":
+                continue
+            if rs.output != rt.output:
+                raise SystemExit(
+                    f"TP AB PIN FAILED: request #{i} tp1={rs.output} "
+                    f"tp{tpd}={rt.output}")
+            compared += 1
+        if not compared:
+            raise SystemExit("TP AB PIN FAILED: no greedy pairs "
+                             "finished on both sides — nothing compared")
+        # The bandwidth pin: the sharded side holds 1/tp of the decode
+        # K/V traffic per chip. The denominator is the SAME run's
+        # full-model per-step bytes (what one chip would hold for the
+        # identical execution) — NOT the tp=1 lane's stamp: arrivals
+        # are wall-clock, so the two lanes batch differently and their
+        # per-step means diverge legitimately. Heads shard exactly;
+        # tolerance covers the stamp's rounding only.
+        attnN = shard["attention"]
+        kv_full = attnN["kv_bytes_per_step_paged"] \
+            if attnN["mode"] == "paged" \
+            else attnN["kv_bytes_per_step_gather"]
+        kvN = attnN["kv_bytes_per_chip"]
+        if kv_full and kvN and kvN > kv_full / tpd * 1.001:
+            raise SystemExit(
+                f"TP AB BYTES PIN FAILED: kv_bytes_per_chip {kvN} on "
+                f"tp={tpd} exceeds 1/{tpd} of the run's single-chip "
+                f"bytes {kv_full}")
+        print(f"[serve_bench] tp pins: {compared} greedy streams "
+              f"bit-identical tp=1 vs tp={tpd}; kv_bytes_per_chip "
+              f"{kvN} <= {kv_full}/{tpd}", file=sys.stderr, flush=True)
+        ratio = None
+        if single["tokens_per_sec_per_chip"] and \
+                shard["tokens_per_sec_per_chip"]:
+            # WALL-CLOCK throughput ratio (chips cancel back out): on
+            # the virtual CPU mesh this is < 1 — honest; the win TP
+            # buys is per-chip KV residency, not CPU-emulated speed.
+            ratio = round(shard["tokens_per_sec_per_chip"] * tpd
+                          / single["tokens_per_sec_per_chip"], 3)
+        mode, headline = "ab_tp", shard
+        serve = dict(shard, mode="ab_tp", tp={
+            "degree": tpd,
+            "mesh": args.mesh,
+            "kv_bytes_per_chip": kvN,
+            "kv_bytes_per_chip_single": kv_full,
+            "exact_pin": {"compared": compared, "identical": True},
+            "tp_over_single": ratio,
+        })
     elif args.ab_attention:
         import dataclasses
 
@@ -864,6 +1000,7 @@ def main() -> int:
                           else args.attention),
             "prefix_caching": ("ab" if args.ab_prefix
                                else args.prefix),
+            "mesh": args.mesh or None,
             "system_prompt_len": spl,
             "rate": args.rate,
             "requests": args.requests,
