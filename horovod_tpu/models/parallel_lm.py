@@ -312,6 +312,129 @@ def lm_decode(params: Dict, prompt, steps: int, temperature: float = 0.0,
     return toks.T  # [B, steps]
 
 
+def draft_params(params: Dict, layers: int) -> Dict:
+    """Layer-skip self-draft: the target's FIRST ``layers`` transformer
+    layers sharing the target's embed/pos/ln_f/head — the speculative-
+    decoding draft model as a zero-copy VIEW of the target pytree
+    (list slice of the layer dicts; no array is copied).
+
+    Why a view instead of a second trained artifact: the draft's K/V
+    for layer ``l < layers`` are computed by exactly the target's first
+    ``l+1`` layers, so the draft shares the target's KV cache rows, the
+    target's tp sharding (head/feature divisibility holds by
+    construction), and the target's params-distribution path — the
+    serving fleet's wire transports and ``update_params`` need no
+    second weight artifact. The result plugs straight into
+    :func:`lm_decode_step` / :func:`lm_prefill`."""
+    n = len(params["layers"])
+    if not 1 <= layers <= n:
+        raise ValueError(
+            f"draft_params: layers={layers} outside 1..{n} (the target "
+            "has that many transformer layers)")
+    return {"embed": params["embed"], "pos": params["pos"],
+            "layers": params["layers"][:layers],
+            "ln_f": params["ln_f"], "head": params["head"]}
+
+
+def lm_verify_window(params: Dict, caches, toks, t,
+                     tp: Optional[str] = None):
+    """Speculative-decoding verify pass: ONE rectangular-causal step
+    over a ``w``-token window — write the window's K/V rows at
+    positions ``t..t+w-1`` and return the logits at ALL ``w``
+    positions, so a draft's ``w-1`` proposals are verified by a single
+    target dispatch instead of ``w`` sequential decode steps.
+
+    ``toks`` is [B, w] int32 (row 0 = the last emitted token, rows
+    1..w-1 = the draft's proposals), ``t`` the window's first absolute
+    position; caches are :func:`lm_prefill`'s fixed-shape pytree.
+    Returns ``(new_caches, logits [B, w, vocab])``.
+
+    The attention is exactly the chunked-prefill shape — queries at
+    global positions ``t..t+w-1`` over the full masked cache with
+    ``q_offset=t, k_offset=0`` — so greedy argmaxes match ``w``
+    sequential :func:`lm_decode_step` calls (masked softmax terms are
+    exactly zero), and ``w=1`` IS :func:`lm_decode_step` shape-for-
+    shape. Rows past an accepted prefix need no erasure: the next
+    window overwrites positions it reaches and the causal mask hides
+    positions beyond its own last query."""
+    w = toks.shape[1]
+    x = params["embed"][toks] + \
+        lax.dynamic_slice_in_dim(params["pos"], t, w, 0)[None]
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        q, k, v = _project_qkv(layer, x, tp)              # [B, w, H, D]
+        ck = lax.dynamic_update_slice_in_dim(cache["k"], k, t, 1)
+        cv = lax.dynamic_update_slice_in_dim(cache["v"], v, t, 1)
+        new_caches.append({"k": ck, "v": cv})
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        attn = dot_product_attention(q, ck, cv, causal=True,
+                                     scale=scale, q_offset=t)
+        x = _attn_out_residual(layer, attn, x, tp)
+        x = _ffn_residual(layer, x, tp)
+    return new_caches, _logits(params, x)                 # [B, w, V]
+
+
+def lm_decode_spec(params: Dict, prompt, steps: int, *, k: int,
+                   draft_layers: int, tp: Optional[str] = None):
+    """Greedy speculative decoding, the model-level reference the
+    serving engine's spec path is pinned against: the layer-skip draft
+    (:func:`draft_params`) proposes up to ``k`` tokens per tick, the
+    target verifies all proposals plus one bonus position in a single
+    :func:`lm_verify_window` pass, and the longest prefix where draft
+    and target argmaxes agree is kept (plus the target's token at the
+    first mismatch — the correction — or one bonus token when every
+    proposal matched).
+
+    Provably bit-identical to greedy :func:`lm_decode`: every emitted
+    token is ``argmax(float32 target logits | emitted prefix)``
+    regardless of WHAT the draft proposed or where tick boundaries
+    fall — proposals only decide how many target argmaxes one dispatch
+    yields. Returns the generated ids [1, steps] (single-row: the
+    accept rule makes rows diverge in length)."""
+    B, Lp = prompt.shape
+    if B != 1:
+        raise ValueError(
+            f"lm_decode_spec is single-row (got B={B}): acceptance "
+            "lengths diverge per row")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    Lmax = params["pos"].shape[0]
+    if Lp + steps > Lmax:
+        raise ValueError(
+            f"prompt ({Lp}) + steps ({steps}) exceeds the position table "
+            f"({Lmax})")
+    dparams = draft_params(params, draft_layers)
+
+    caches, logits_last = lm_prefill(params, prompt, tp)
+    out = [int(jnp.argmax(logits_last.astype(jnp.float32), axis=-1)[0])]
+    while len(out) < steps:
+        t = Lp + len(out) - 1
+        # Budget clamp: never verify past the generation budget (the
+        # serving engine's page-grant bound is the same arithmetic).
+        k_eff = min(k, steps - len(out) - 1)
+        w = k_eff + 1
+        # Draft proposals: k_eff sequential single-token steps over the
+        # TARGET's first draft_layers caches (layer-skip shares rows);
+        # the draft's writes land on a discarded branch of the pytree —
+        # the verify pass below writes the rows that persist.
+        dcaches = caches[:draft_layers]
+        tok, d = out[-1], []
+        for i in range(k_eff):
+            dcaches, dlg = lm_decode_step(
+                dparams, dcaches, jnp.full((1,), tok, jnp.int32),
+                t + i, tp)
+            tok = int(jnp.argmax(dlg.astype(jnp.float32), axis=-1)[0])
+            d.append(tok)
+        window = jnp.asarray([[out[-1]] + d], jnp.int32)      # [1, w]
+        caches, vlg = lm_verify_window(params, caches, window, t, tp)
+        tgt = jnp.argmax(vlg.astype(jnp.float32), axis=-1)[0]  # [w]
+        for i in range(w):
+            out.append(int(tgt[i]))
+            if i < w - 1 and d[i] != int(tgt[i]):
+                break   # correction emitted; rest of the window stale
+    return jnp.asarray([out], jnp.int32)                  # [1, steps]
+
+
 def stack_layers(params: Dict):
     """Split the param pytree for pipeline parallelism: the per-layer
     dicts stack into leading-axis arrays (shard with ``P(pp)`` so each

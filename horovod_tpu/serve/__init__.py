@@ -29,7 +29,18 @@ steps without ever recompiling.
   hashes the normalized prefix so prefix-mates land on the replica
   already holding the pages — one cold prefill per unique prefix per
   replica, hit streams bit-identical to the cold path;
-* :mod:`~horovod_tpu.serve.sampling` — vectorized per-slot sampling;
+* :mod:`~horovod_tpu.serve.sampling` — vectorized per-slot sampling,
+  plus the speculative-decoding surfaces
+  (``ServeConfig(speculate_k=K)``): the in-step draft proposal draw
+  and the host-side acceptance rule
+  (:func:`~horovod_tpu.serve.sampling.speculative_accept` — longest
+  agreeing prefix under greedy, provably bit-identical to
+  ``lm_decode``; Leviathan rejection sampling under position-folded
+  domain-separated keys otherwise). The draft is the target's first
+  ``draft_layers`` layers sharing embed/head AND the target's own KV
+  pages (``models.parallel_lm.draft_params``) — no second cache, no
+  extra wire traffic; the target verifies all K+1 positions in one
+  rectangular-causal pass (``engine.serve_step_spec``);
 * :mod:`~horovod_tpu.serve.metrics` — TTFT / per-token latency /
   page-occupancy accounting for the bench lane
   (`tools/serve_bench.py`);

@@ -132,6 +132,15 @@ class ServeConfig:
     unsharded single-chip engine, the exactness reference the tp path
     is pinned bit-identical to.
 
+    ``speculate_k`` > 0 turns on speculative decoding: each engine tick
+    the layer-skip draft (the target's first ``draft_layers`` layers
+    sharing embed/head) proposes up to ``k`` tokens per slot and the
+    target verifies all ``k+1`` positions in one rectangular-causal
+    pass — up to ``k+1`` tokens emitted per slot per tick, greedy
+    streams pinned bit-identical to the non-speculative engine (the
+    acceptance rule keeps only target argmaxes). See docs/serving.md
+    "Speculative decoding".
+
     ``prefix_caching`` turns on the copy-on-write prefix cache
     (:mod:`horovod_tpu.serve.prefix`; docs/serving.md "Prefix
     caching"): admission maps a prompt's longest chain of
@@ -154,6 +163,21 @@ class ServeConfig:
     #: Copy-on-write prefix caching (serve/prefix.py). Off = seed
     #: behavior: every request pays a full cold prefill.
     prefix_caching: bool = False
+    #: Speculative decoding (docs/serving.md "Speculative decoding"):
+    #: the layer-skip draft proposes up to ``speculate_k`` tokens per
+    #: slot per tick and the target verifies all ``k+1`` positions in
+    #: ONE rectangular-causal pass. 0 (default) = off — the
+    #: single-token decode lane, the exactness reference the spec path
+    #: is pinned bit-identical to under greedy acceptance.
+    speculate_k: int = 0
+    #: Draft depth for speculation: the draft model is the target's
+    #: FIRST ``draft_layers`` transformer layers sharing embed/head
+    #: (:func:`models.parallel_lm.draft_params` — self-speculative, no
+    #: second weight artifact to distribute). 0 = auto: half the
+    #: target's depth, at least 1. Model-dependent validation (1 <=
+    #: draft_layers <= num_layers) happens at ENGINE construction,
+    #: like the tp divisibility checks.
+    draft_layers: int = 0
     eos_token: Optional[int] = None
     max_queue: int = 0          # 0 = unbounded
     requeue_evicted: bool = True
@@ -190,6 +214,19 @@ class ServeConfig:
         if self.attention not in ATTENTIONS:
             raise ValueError(
                 f"attention {self.attention!r} not in {ATTENTIONS}")
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0 (0 = speculation off), got "
+                f"{self.speculate_k}")
+        if self.draft_layers < 0:
+            raise ValueError(
+                f"draft_layers must be >= 0 (0 = auto: half the "
+                f"target's depth), got {self.draft_layers}")
+        if self.draft_layers > 0 and self.speculate_k == 0:
+            raise ValueError(
+                f"draft_layers={self.draft_layers} without "
+                "speculate_k — the draft only exists to propose "
+                "speculative tokens (set speculate_k >= 1)")
         if self.default_ttl is not None and self.default_ttl <= 0:
             raise ValueError(
                 f"default_ttl must be > 0 seconds (or None), got "

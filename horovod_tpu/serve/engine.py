@@ -63,6 +63,26 @@ to the tp=1 engine (tests/test_serve_engine.py; ``serve_bench
 --ab-tp`` gates it in CI): each chip's dot products are exactly the
 dense math's column slices, psums only add terms the dense contraction
 adds, and argmax sees the identical full-vocab row.
+
+**Speculative decoding** (``ServeConfig.speculate_k``): the compiled
+step becomes :func:`serve_step_spec` — the layer-skip draft (the
+target's first ``draft_layers`` layers sharing embed/head AND the
+target's own KV pages, :func:`models.parallel_lm.draft_params`)
+proposes up to ``k`` tokens per slot in one ``lax.scan``, and the
+target verifies all ``k+1`` positions in ONE rectangular-causal pass
+(``q_offset=t, k_offset=0`` — the prefill lane's exact contract). The
+host keeps the longest draft/target-agreeing prefix per slot
+(:func:`~horovod_tpu.serve.sampling.speculative_accept`) and emits
+1..k+1 tokens per tick; rejected rows roll back by page-table
+arithmetic (stale rows are overwritten by the next window or causally
+masked — no erasure pass), with ``Request.spec_window`` clamping the
+window inside the page grant and ``_cow_guard`` widened over the full
+write range. Greedy streams stay bit-identical to ``lm_decode`` and
+to the non-speculative engine — every emitted token is a target
+argmax of its true prefix — across both attention modes and under TP
+(tests/test_serve_engine.py; ``serve_bench --ab-spec`` gates it in
+CI; hvdverify ``serve.step_spec{,_paged,_tp}`` pin the no-donation
+rollback substrate).
 """
 
 from __future__ import annotations
@@ -112,6 +132,73 @@ def _gather_cache_kv(pk, pv, table):
             pv.reshape(P * ps, pv.shape[2], pv.shape[3])[rows])
 
 
+def _prefill_lane(params: Dict, pages, pre, *, page_size: int, tp=None,
+                  vocab_parallel: bool = False):
+    """The chunked-prefill pass of one step — shared verbatim by
+    :func:`serve_step` and :func:`serve_step_spec`: one rectangular-
+    causal chunk (queries at ``start..start+C-1`` over the full
+    gathered cache, ``q_offset=start, k_offset=0``) whose K/V rows
+    write through the page table via :func:`~horovod_tpu.serve.
+    kvcache.append_rows` (padded rows hit the OOB sentinel and drop).
+    Returns ``(new_pages, pre_logits [V])``."""
+    import math
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from horovod_tpu.models.parallel_lm import (
+        _attn_out_residual,
+        _ffn_residual,
+        _logits,
+        _project_qkv,
+    )
+    from horovod_tpu.ops.attention import dot_product_attention
+    from horovod_tpu.serve.kvcache import append_rows
+
+    ps = page_size
+    num_pages = pages[0]["k"].shape[0]
+    lmax = pre["table"].shape[0] * ps
+    C = pre["tokens"].shape[0]
+    start = pre["start"]
+    rows = jnp.arange(C)
+    row_valid = rows < pre["length"]
+    # OOB sentinel drops padded/inactive rows at every scatter.
+    write_page, write_off, safe_pos = append_rows(
+        pre["table"], start, C, page_size=ps, num_pages=num_pages,
+        valid=row_valid)
+    xp = params["embed"][pre["tokens"]][None] + \
+        params["pos"][safe_pos][None]                  # [1, C, E]
+    new_pages = []
+    for layer, page in zip(params["layers"], pages):
+        pk, pv = page["k"], page["v"]
+        qp, kp, vp = _project_qkv(layer, xp, tp)       # [1, C, H, D]
+        # math.sqrt, exactly parallel_lm's spelling — the scale
+        # must be the bit-identical float for the exactness pin.
+        scale = 1.0 / math.sqrt(qp.shape[-1])
+        gk, gv = _gather_cache_kv(pk, pv, pre["table"])
+        # The chunk's own rows enter the gathered view (scatter —
+        # row-distinct indices, padded rows dropped), then the
+        # rectangular-causal attention: queries at start+i over
+        # keys 0..start+i.
+        ck = gk.at[jnp.where(row_valid, safe_pos, lmax)].set(
+            kp[0], mode="drop")
+        cv = gv.at[jnp.where(row_valid, safe_pos, lmax)].set(
+            vp[0], mode="drop")
+        attn = dot_product_attention(qp, ck[None], cv[None],
+                                     causal=True, scale=scale,
+                                     q_offset=start, k_offset=0)
+        xp = _attn_out_residual(layer, attn, xp, tp)
+        xp = _ffn_residual(layer, xp, tp)
+        pk = pk.at[write_page, write_off].set(kp[0], mode="drop")
+        pv = pv.at[write_page, write_off].set(vp[0], mode="drop")
+        new_pages.append({"k": pk, "v": pv})
+    last = jnp.clip(pre["length"] - 1, 0, C - 1)
+    row = lax.dynamic_slice_in_dim(xp[0], last, 1, 0)   # [1, E]
+    pre_logits = _logits(params, row[None], tp,
+                         vocab_parallel)[0, 0]          # [V]
+    return new_pages, pre_logits
+
+
 def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
                attention: str = "gather", tp=None,
                vocab_parallel: bool = False):
@@ -158,26 +245,15 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
             f"attention must be 'gather' or 'paged', got {attention!r}")
     ps = page_size
     num_pages = pages[0]["k"].shape[0]
-    pps = dec["tables"].shape[1]
-    lmax = pps * ps
     S = dec["tok"].shape[0]
     new_pages = []
 
     # ---------------------------------------------------- prefill lane
     pre_logits = None
     if pre is not None:
-        C = pre["tokens"].shape[0]
-        start = pre["start"]
-        rows = jnp.arange(C)
-        positions = start + rows                       # [C] global pos
-        row_valid = rows < pre["length"]
-        # OOB sentinel drops padded/inactive rows at every scatter.
-        safe_pos = jnp.clip(positions, 0, lmax - 1)
-        write_page = jnp.where(row_valid, pre["table"][safe_pos // ps],
-                               num_pages)              # OOB when invalid
-        write_off = safe_pos % ps
-        xp = params["embed"][pre["tokens"]][None] + \
-            params["pos"][safe_pos][None]              # [1, C, E]
+        pages, pre_logits = _prefill_lane(params, pages, pre,
+                                          page_size=ps, tp=tp,
+                                          vocab_parallel=vocab_parallel)
 
     # ----------------------------------------------------- decode lane
     t = dec["pos"]                                      # [S]
@@ -195,33 +271,8 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
 
     for layer, page in zip(params["layers"], pages):
         pk, pv = page["k"], page["v"]
-        scale = None
-
-        if pre is not None:
-            qp, kp, vp = _project_qkv(layer, xp, tp)    # [1, C, H, D]
-            # math.sqrt, exactly parallel_lm's spelling — the scale
-            # must be the bit-identical float for the exactness pin.
-            scale = 1.0 / math.sqrt(qp.shape[-1])
-            gk, gv = _gather_cache_kv(pk, pv, pre["table"])
-            # The chunk's own rows enter the gathered view (scatter —
-            # row-distinct indices, padded rows dropped), then the
-            # rectangular-causal attention: queries at start+i over
-            # keys 0..start+i.
-            ck = gk.at[jnp.where(row_valid, safe_pos, lmax)].set(
-                kp[0], mode="drop")
-            cv = gv.at[jnp.where(row_valid, safe_pos, lmax)].set(
-                vp[0], mode="drop")
-            attn = dot_product_attention(qp, ck[None], cv[None],
-                                         causal=True, scale=scale,
-                                         q_offset=start, k_offset=0)
-            xp = _attn_out_residual(layer, attn, xp, tp)
-            xp = _ffn_residual(layer, xp, tp)
-            pk = pk.at[write_page, write_off].set(kp[0], mode="drop")
-            pv = pv.at[write_page, write_off].set(vp[0], mode="drop")
-
         qd, kd, vd = _project_qkv(layer, xd, tp)        # [S, 1, H, D]
-        if scale is None:
-            scale = 1.0 / math.sqrt(qd.shape[-1])
+        scale = 1.0 / math.sqrt(qd.shape[-1])
         if attention == "paged":
             # Scatter the new row FIRST (the gather path's identical
             # scatter, just hoisted above the attention), then stream
@@ -258,12 +309,230 @@ def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
         new_pages.append({"k": pk, "v": pv})
 
     dec_logits = _logits(params, xd, tp, vocab_parallel)[:, 0]  # [S, V]
-    if pre is not None:
-        last = jnp.clip(pre["length"] - 1, 0, C - 1)
-        row = lax.dynamic_slice_in_dim(xp[0], last, 1, 0)   # [1, E]
-        pre_logits = _logits(params, row[None], tp,
-                             vocab_parallel)[0, 0]          # [V]
     return new_pages, dec_logits, pre_logits
+
+
+def serve_step_spec(params: Dict, pages, dec, pre, *, k: int,
+                    draft_layers: int, page_size: int,
+                    attention: str = "gather", tp=None,
+                    vocab_parallel: bool = False):
+    """One continuous-batching step with SPECULATIVE decoding: the
+    layer-skip draft (the target's first ``draft_layers`` layers
+    sharing embed/head) proposes up to ``k`` tokens per slot, and the
+    target verifies all ``k+1`` positions in ONE rectangular-causal
+    pass — the exact chunked-prefill shape per slot: queries at
+    ``t..t+k`` over the full gathered cache, ``q_offset=t,
+    k_offset=0``.
+
+    ``dec`` extends :func:`serve_step`'s batch with the speculation
+    plane: ``width`` [S] (``k_eff+1`` rows this slot verifies this
+    tick — the host's budget clamp; 0 = idle lane) plus the draft's
+    sampling knobs ``temp``/``topk``/``seed``/``sidx`` [S] — proposals
+    are drawn IN-step, because the propose loop must feed each
+    proposal to the next draft step. That loop is ONE ``lax.scan``
+    (PR-1's windowing trick), so per-tick dispatch cost stays flat in
+    ``k``.
+
+    Returns ``(new_pages, ver_logits [S, k+1, V], draft_toks [S, k],
+    draft_logits [S, k, V], pre_logits)``; the host applies
+    :func:`~horovod_tpu.serve.sampling.speculative_accept` per slot.
+
+    The verify window's K/V rows scatter through
+    :func:`~horovod_tpu.serve.kvcache.append_rows` under the width
+    mask — rows past a slot's clamp (and idle lanes) hit the OOB
+    sentinel and never touch a real page — and REJECTED rows need no
+    rollback pass: a stale position is either overwritten by a later
+    window or causally masked (no query ever admits a key past its own
+    position), and the host's ``_cow_guard`` copied any shared page
+    across the whole write range BEFORE the step, so a rejected row
+    can never have landed on another request's page. Pages thread
+    functionally, never donated (hvdverify ``serve.step_spec``).
+
+    ``attention`` shapes the DRAFT propose scan: ``gather`` runs the
+    ``k`` single-token draft steps over per-slot gathered dense
+    caches; ``paged`` scatters each draft row and streams only live
+    pages through the fused kernel per step. The verify pass gathers
+    in both modes (rectangular-causal over the whole cache — exactly
+    the prefill lane's policy). Greedy streams are bit-identical
+    either way, and to :func:`serve_step`'s.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from horovod_tpu.models.parallel_lm import (
+        _attn_out_residual,
+        _ffn_residual,
+        _logits,
+        _project_qkv,
+    )
+    from horovod_tpu.ops.attention import dot_product_attention
+    from horovod_tpu.ops.paged_attention import paged_attention_decode
+    from horovod_tpu.serve.kvcache import append_rows
+    from horovod_tpu.serve.sampling import draft_sample_tokens
+
+    if attention not in ("gather", "paged"):
+        raise ValueError(
+            f"attention must be 'gather' or 'paged', got {attention!r}")
+    if k < 1:
+        raise ValueError(f"speculate_k must be >= 1 in-step, got {k}")
+    if not 1 <= draft_layers <= len(params["layers"]):
+        raise ValueError(
+            f"draft_layers={draft_layers} outside 1.."
+            f"{len(params['layers'])}")
+    ps = page_size
+    num_pages = pages[0]["k"].shape[0]
+    pps = dec["tables"].shape[1]
+    lmax = pps * ps
+    S = dec["tok"].shape[0]
+    w = k + 1
+
+    # ---------------------------------------------------- prefill lane
+    pre_logits = None
+    if pre is not None:
+        pages, pre_logits = _prefill_lane(params, pages, pre,
+                                          page_size=ps, tp=tp,
+                                          vocab_parallel=vocab_parallel)
+
+    t = dec["pos"]                                      # [S]
+    width = dec["width"]                                # [S]; 0 = idle
+    rows = jnp.arange(w)
+    insert = jax.vmap(
+        lambda c, u, tt: lax.dynamic_update_slice_in_dim(c, u, tt, 0))
+    dlayers = params["layers"][:draft_layers]
+
+    # ----------------------------------------------- draft propose scan
+    # k single-token draft steps, one lax.scan; step i feeds token c_i
+    # (c_0 = the last emitted token, c_i = proposal i) at position t+i
+    # and proposes c_{i+1}. Rows the budget clamp masked off propose
+    # garbage the host never reads.
+    if attention == "paged":
+        # Each draft step scatters its row (width-masked — a masked
+        # row must never touch a real page) and streams only the live
+        # pages through the fused kernel; the pages thread through the
+        # scan carry so the verify pass below overwrites every row the
+        # draft wrote (same tokens, all layers).
+        def draft_step(carry, i):
+            tok, dpages = carry
+            pos = t + i                                 # [S]
+            safe = jnp.clip(pos, 0, lmax - 1)
+            x = params["embed"][tok][:, None] + \
+                params["pos"][safe][:, None]            # [S, 1, E]
+            # A draft row is needed only while a LATER proposal still
+            # attends it: the last proposal row is width-2.
+            ok = (i + 1) < width
+            wp = jnp.where(ok,
+                           dec["tables"][jnp.arange(S), safe // ps],
+                           num_pages)
+            wo = safe % ps
+            lens = jnp.where(ok, pos + 1, 0).astype(jnp.int32)
+            new_dpages = []
+            for layer, (pk, pv) in zip(dlayers, dpages):
+                q, kk, vv = _project_qkv(layer, x, tp)  # [S, 1, H, D]
+                scale = 1.0 / math.sqrt(q.shape[-1])
+                pk = pk.at[wp, wo].set(kk[:, 0], mode="drop")
+                pv = pv.at[wp, wo].set(vv[:, 0], mode="drop")
+                attn = paged_attention_decode(
+                    q[:, 0], pk, pv, dec["tables"], lens,
+                    scale=scale)[:, None]               # [S, 1, H, D]
+                x = _attn_out_residual(layer, attn, x, tp)
+                x = _ffn_residual(layer, x, tp)
+                new_dpages.append((pk, pv))
+            lg = _logits(params, x, tp, vocab_parallel)[:, 0]
+            nxt = draft_sample_tokens(lg, dec["temp"], dec["topk"],
+                                      dec["seed"], dec["sidx"] + i)
+            return (nxt, tuple(new_dpages)), (nxt, lg)
+
+        carry0 = (dec["tok"],
+                  tuple((p["k"], p["v"]) for p in pages[:draft_layers]))
+        (_, dpages), (draft_toks, draft_logits) = lax.scan(
+            draft_step, carry0, jnp.arange(k))
+        pages = [{"k": pk, "v": pv} for pk, pv in dpages] + \
+            list(pages[draft_layers:])
+    else:
+        # Gather each draft layer's dense per-slot caches ONCE; the
+        # scan inserts each step's row into the gathered copies (the
+        # decode lane's exact idiom) and the copies are DISCARDED
+        # after — the verify pass owns every row that persists.
+        gks, gvs = [], []
+        for page in pages[:draft_layers]:
+            a, b = jax.vmap(_gather_cache_kv, in_axes=(None, None, 0))(
+                page["k"], page["v"], dec["tables"])
+            gks.append(a)
+            gvs.append(b)
+
+        def draft_step(carry, i):
+            tok, dck, dcv = carry
+            pos = t + i                                 # [S]
+            safe = jnp.clip(pos, 0, lmax - 1)
+            x = params["embed"][tok][:, None] + \
+                params["pos"][safe][:, None]            # [S, 1, E]
+            new_ck, new_cv = [], []
+            for layer, ck0, cv0 in zip(dlayers, dck, dcv):
+                q, kk, vv = _project_qkv(layer, x, tp)  # [S, 1, H, D]
+                scale = 1.0 / math.sqrt(q.shape[-1])
+                ck = insert(ck0, kk, safe)
+                cv = insert(cv0, vv, safe)
+                new_ck.append(ck)
+                new_cv.append(cv)
+                attn = jax.vmap(
+                    lambda q1, k1, v1, tt: dot_product_attention(
+                        q1, k1, v1, causal=True, scale=scale,
+                        q_offset=tt)
+                )(q, ck, cv, safe)                      # [S, 1, H, D]
+                x = _attn_out_residual(layer, attn, x, tp)
+                x = _ffn_residual(layer, x, tp)
+            lg = _logits(params, x, tp, vocab_parallel)[:, 0]
+            nxt = draft_sample_tokens(lg, dec["temp"], dec["topk"],
+                                      dec["seed"], dec["sidx"] + i)
+            return (nxt, tuple(new_ck), tuple(new_cv)), (nxt, lg)
+
+        (_, _, _), (draft_toks, draft_logits) = lax.scan(
+            draft_step, (dec["tok"], tuple(gks), tuple(gvs)),
+            jnp.arange(k))
+
+    draft_toks = jnp.swapaxes(draft_toks, 0, 1)         # [S, k]
+    draft_logits = jnp.swapaxes(draft_logits, 0, 1)     # [S, k, V]
+
+    # ------------------------------------------------------ verify pass
+    # Window = [last emitted token, proposals] at positions t..t+k per
+    # slot; ONE rectangular-causal target pass over the gathered cache
+    # yields logits at every position. Width-masked rows gather-insert
+    # to the Lmax drop index and page-scatter to the OOB sentinel.
+    toks_w = jnp.concatenate([dec["tok"][:, None], draft_toks], 1)
+    wp, wo, safe_w = jax.vmap(
+        lambda tab, tt, wd: append_rows(
+            tab, tt, w, page_size=ps, num_pages=num_pages,
+            valid=jnp.arange(w) < wd))(dec["tables"], t, width)
+    xw = params["embed"][toks_w] + params["pos"][safe_w]  # [S, w, E]
+    gather_idx = jnp.where(rows[None, :] < width[:, None],
+                           safe_w, lmax)                 # [S, w]
+    scatter_g = jax.vmap(
+        lambda g, ii, u: g.at[ii].set(u, mode="drop"))
+    new_pages = []
+    for layer, page in zip(params["layers"], pages):
+        pk, pv = page["k"], page["v"]
+        qw, kw, vw = _project_qkv(layer, xw, tp)         # [S, w, H, D]
+        scale = 1.0 / math.sqrt(qw.shape[-1])
+        gk, gv = jax.vmap(_gather_cache_kv, in_axes=(None, None, 0))(
+            pk, pv, dec["tables"])                       # [S, Lmax, H, D]
+        ck = scatter_g(gk, gather_idx, kw)
+        cv = scatter_g(gv, gather_idx, vw)
+        attn = jax.vmap(
+            lambda q1, k1, v1, tt: dot_product_attention(
+                q1, k1, v1, causal=True, scale=scale,
+                q_offset=tt, k_offset=0)
+        )(qw, ck, cv, t)                                 # [S, w, H, D]
+        xw = _attn_out_residual(layer, attn, xw, tp)
+        xw = _ffn_residual(layer, xw, tp)
+        pk = pk.at[wp, wo].set(kw, mode="drop")
+        pv = pv.at[wp, wo].set(vw, mode="drop")
+        new_pages.append({"k": pk, "v": pv})
+
+    ver_logits = _logits(params, xw, tp, vocab_parallel)  # [S, w, V]
+    return new_pages, ver_logits, draft_toks, draft_logits, pre_logits
 
 
 # --------------------------------------------------------------------------
@@ -349,6 +618,26 @@ class ServeEngine:
             self._kv_spec = P(None, None, self._tp_axis, None)
             kv_sharding = NamedSharding(mesh, self._kv_spec)
         self.params = params
+        #: Speculative decoding plane (``config.speculate_k`` > 0):
+        #: static k compiled into the step, layer-skip draft depth
+        #: resolved against THIS model (0 = auto: half the depth, at
+        #: least 1) — fail-fast at construction, never at first
+        #: compile, like the tp divisibility checks above.
+        self.spec_k = int(config.speculate_k)
+        self.draft_layers = 0
+        if self.spec_k:
+            from horovod_tpu.common.exceptions import (
+                InvalidArgumentError,
+            )
+
+            n_layers = len(params["layers"])
+            dl = config.draft_layers or max(1, n_layers // 2)
+            if not 1 <= dl <= n_layers:
+                raise InvalidArgumentError(
+                    f"ServeConfig.draft_layers={config.draft_layers}: "
+                    f"the layer-skip draft is a prefix of the target's "
+                    f"{n_layers} layers — need 1..{n_layers}")
+            self.draft_layers = dl
         self.cache = PagedKVCache(params, config,
                                   kv_sharding=kv_sharding)
         if config.prefix_caching:
@@ -384,12 +673,28 @@ class ServeEngine:
         #: reset_metrics() bounds a long-lived engine.
         self.attn_len_samples: List[List[int]] = []
         self.steps = 0
+        #: Speculation accounting (speculate_k > 0): per decode TICK,
+        #: proposals made/accepted and tokens emitted — the inputs to
+        #: stats()["spec"] (accept_rate, tokens_per_step).
+        self.spec_ticks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         self._t_start = clock()
-        step = functools.partial(serve_step,
-                                 page_size=config.page_size,
-                                 attention=config.attention,
-                                 tp=self._tp_axis,
-                                 vocab_parallel=self.tp > 1)
+        if self.spec_k:
+            step = functools.partial(serve_step_spec,
+                                     k=self.spec_k,
+                                     draft_layers=self.draft_layers,
+                                     page_size=config.page_size,
+                                     attention=config.attention,
+                                     tp=self._tp_axis,
+                                     vocab_parallel=self.tp > 1)
+        else:
+            step = functools.partial(serve_step,
+                                     page_size=config.page_size,
+                                     attention=config.attention,
+                                     tp=self._tp_axis,
+                                     vocab_parallel=self.tp > 1)
         import jax
 
         # Two fixed-shape variants, compiled once each; NO donation —
@@ -411,16 +716,20 @@ class ServeEngine:
             # dicts), pages head-sharded in AND out, logits replicated
             # full-vocab (the step's all-gather makes them so).
             untyped = {_SHARD_MAP_CHECK_KW: False}
+            # The spec step returns (pages, ver_logits, draft_toks,
+            # draft_logits, pre_logits) — two extra replicated outputs
+            # over the base step's (pages, dec_logits, pre_logits).
+            n_rep = 4 if self.spec_k else 2
             self._step_mixed = jax.jit(_shard_map(
                 lambda p, pages, dec, pre: step(p, pages, dec, pre),
                 mesh=mesh,
                 in_specs=(self._param_specs, kv, P(), P()),
-                out_specs=(kv, P(), P()), **untyped))
+                out_specs=(kv,) + (P(),) * n_rep, **untyped))
             self._step_decode = jax.jit(_shard_map(
                 lambda p, pages, dec: step(p, pages, dec, None),
                 mesh=mesh,
                 in_specs=(self._param_specs, kv, P()),
-                out_specs=(kv, P(), P()), **untyped))
+                out_specs=(kv,) + (P(),) * n_rep, **untyped))
         else:
             self._step_mixed = jax.jit(step)
             self._step_decode = jax.jit(
@@ -553,7 +862,12 @@ class ServeEngine:
         for req in list(self.slots):
             if req is None or req not in self.slots:
                 continue
-            if not self.scheduler.ensure_pages(req, req.next_pos,
+            # Speculation widens the write range: the verify window
+            # lands rows t..t+k_eff, so every page under the WHOLE
+            # window must be mapped before the step.
+            last = req.next_pos + (req.spec_window(self.spec_k)
+                                   if self.spec_k else 0)
+            if not self.scheduler.ensure_pages(req, last,
                                                self._evict_for):
                 self._do_evict(req)
         if self.prefilling is not None:
@@ -579,7 +893,13 @@ class ServeEngine:
             return
         for req in self.slots:
             if req is not None and req.generated:
-                self._cow_range(req, req.next_pos, req.next_pos)
+                # Speculative ticks write the whole verify window
+                # t..t+k_eff — a rejected row rolled back by page
+                # arithmetic must STILL never have landed on a shared
+                # page, so the guard covers the full range.
+                last = req.next_pos + (req.spec_window(self.spec_k)
+                                       if self.spec_k else 0)
+                self._cow_range(req, req.next_pos, last)
         if self.prefilling is not None:
             req = self.prefilling
             chunk = min(self.config.prefill_chunk,
@@ -612,8 +932,28 @@ class ServeEngine:
             pos[i] = req.next_pos
             active[i] = True
             tables[i] = req.page_table
-        return {"tok": tok, "pos": pos, "active": active,
-                "tables": tables}
+        dec = {"tok": tok, "pos": pos, "active": active,
+               "tables": tables}
+        if self.spec_k:
+            # The speculation plane: width = k_eff+1 verify rows per
+            # slot (0 = idle lane — it subsumes `active` in the spec
+            # step) plus the draft's in-step sampling knobs.
+            width = np.zeros((S,), np.int32)
+            temp = np.zeros((S,), np.float32)
+            topk = np.zeros((S,), np.int32)
+            seed = np.zeros((S,), np.int32)
+            sidx = np.zeros((S,), np.int32)
+            for i, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                width[i] = req.spec_window(self.spec_k) + 1
+                temp[i] = req.temperature
+                topk[i] = req.top_k
+                seed[i] = req.seed
+                sidx[i] = req.sample_index
+            dec.update(width=width, temp=temp, topk=topk, seed=seed,
+                       sidx=sidx)
+        return dec
 
     def _build_pre(self):
         if self.prefilling is None:
@@ -666,54 +1006,121 @@ class ServeEngine:
         dec = self._build_dec()
         pre, chunk = self._build_pre()
         # Static traffic accounting for this step's decode lane (live
-        # keys per slot = t+1) — pure host data, no device sync.
+        # keys per slot = t+1; under speculation the verify window
+        # extends the read range to t+k_eff, so live keys =
+        # next_pos + spec_window + 1) — pure host data, no device sync.
         self.attn_len_samples.append(
-            [0 if r is None else r.next_pos + 1 for r in self.slots])
-        if pre is None:
-            pages, dec_logits, _ = self._step_decode(
-                self.params, self.cache.pages, dec)
-            pre_logits = None
-        else:
-            pages, dec_logits, pre_logits = self._step_mixed(
-                self.params, self.cache.pages, dec, pre)
-        self.cache.pages = pages
+            [0 if r is None else
+             r.next_pos + (r.spec_window(self.spec_k)
+                           if self.spec_k else 0) + 1
+             for r in self.slots])
 
-        # One sampler call covers the decode slots + the prefill lane.
         import jax.numpy as jnp
 
         S = self.config.decode_slots
-        rows = list(self.slots)
-        logits = dec_logits
         pre_done = (self.prefilling is not None and
                     self.prefilling.prefill_pos + chunk
                     >= self.prefilling.prompt_len)
-        if pre_logits is not None:
-            rows = rows + [self.prefilling if pre_done else None]
-            logits = jnp.concatenate([dec_logits, pre_logits[None]], 0)
-        n = len(rows)
-        temp = np.zeros((n,), np.float32)
-        topk = np.zeros((n,), np.int32)
-        seeds = np.zeros((n,), np.int32)
-        positions = np.zeros((n,), np.int32)
-        for i, req in enumerate(rows):
-            if req is None:
-                continue
-            temp[i] = req.temperature
-            topk[i] = req.top_k
-            seeds[i] = req.seed
-            positions[i] = req.sample_index
-        tokens = np.asarray(sample_tokens(logits, temp, topk, seeds,
-                                          positions))
-        now = self.clock()          # after the d2h pull: a real sync
 
-        # Decode slots: one new token each.
-        for i in range(S):
-            req = self.slots[i]
-            if req is None:
-                continue
-            self._accept_token(req, int(tokens[i]), now)
-            if req.state == RequestState.FINISHED:
-                self.slots[i] = None
+        if self.spec_k:
+            from horovod_tpu.serve.sampling import speculative_accept
+
+            if pre is None:
+                pages, ver_logits, draft_toks, draft_logits, _ = \
+                    self._step_decode(self.params, self.cache.pages,
+                                      dec)
+                pre_logits = None
+            else:
+                (pages, ver_logits, draft_toks, draft_logits,
+                 pre_logits) = self._step_mixed(
+                    self.params, self.cache.pages, dec, pre)
+            self.cache.pages = pages
+
+            ver = np.asarray(ver_logits)        # [S, k+1, V]
+            dts = np.asarray(draft_toks)        # [S, k]
+            dls = np.asarray(draft_logits)      # [S, k, V]
+            pre_token = None
+            if pre_logits is not None and pre_done:
+                # The prefill lane's FIRST token is a plain 1-row
+                # non-speculative draw — same sampler, same key.
+                preq = self.prefilling
+                pre_token = int(np.asarray(sample_tokens(
+                    jnp.asarray(pre_logits)[None],
+                    np.asarray([preq.temperature], np.float32),
+                    np.asarray([preq.top_k], np.int32),
+                    np.asarray([preq.seed], np.int32),
+                    np.asarray([preq.sample_index], np.int32)))[0])
+            now = self.clock()      # after the d2h pull: a real sync
+
+            for i in range(S):
+                req = self.slots[i]
+                if req is None:
+                    continue
+                wd = int(dec["width"][i])
+                emitted = speculative_accept(
+                    ver[i, :wd], dts[i, :wd - 1], dls[i, :wd - 1],
+                    temperature=float(req.temperature),
+                    top_k=int(req.top_k), seed=int(req.seed),
+                    position0=int(req.sample_index))
+                self.spec_ticks += 1
+                self.spec_proposed += wd - 1
+                self.spec_accepted += len(emitted) - 1
+                for tok in emitted:
+                    self.spec_emitted += 1
+                    self._accept_token(req, int(tok), now)
+                    if req.state == RequestState.FINISHED:
+                        # EOS (or the budget) mid-window: later
+                        # emitted tokens are dropped; the stale KV
+                        # rows past the cut go with the request's
+                        # pages.
+                        break
+                if req.state == RequestState.FINISHED:
+                    self.slots[i] = None
+        else:
+            if pre is None:
+                pages, dec_logits, _ = self._step_decode(
+                    self.params, self.cache.pages, dec)
+                pre_logits = None
+            else:
+                pages, dec_logits, pre_logits = self._step_mixed(
+                    self.params, self.cache.pages, dec, pre)
+            self.cache.pages = pages
+
+            # One sampler call covers the decode slots + the prefill
+            # lane.
+            rows = list(self.slots)
+            logits = dec_logits
+            if pre_logits is not None:
+                rows = rows + [self.prefilling if pre_done else None]
+                logits = jnp.concatenate(
+                    [dec_logits, pre_logits[None]], 0)
+            n = len(rows)
+            temp = np.zeros((n,), np.float32)
+            topk = np.zeros((n,), np.int32)
+            seeds = np.zeros((n,), np.int32)
+            positions = np.zeros((n,), np.int32)
+            for i, req in enumerate(rows):
+                if req is None:
+                    continue
+                temp[i] = req.temperature
+                topk[i] = req.top_k
+                seeds[i] = req.seed
+                positions[i] = req.sample_index
+            tokens = np.asarray(sample_tokens(logits, temp, topk,
+                                              seeds, positions))
+            now = self.clock()      # after the d2h pull: a real sync
+            pre_token = (int(tokens[S])
+                         if pre_logits is not None and pre_done
+                         else None)
+
+            # Decode slots: one new token each.
+            for i in range(S):
+                req = self.slots[i]
+                if req is None:
+                    continue
+                self._accept_token(req, int(tokens[i]), now)
+                if req.state == RequestState.FINISHED:
+                    self.slots[i] = None
 
         # Prefill lane: advance; on completion emit the FIRST token.
         if self.prefilling is not None and pre is not None:
@@ -726,7 +1133,7 @@ class ServeEngine:
                     # _finish releases its pages; the insert's retain
                     # must land while the request still holds them).
                     self.prefix.insert(req.prompt, req.page_table)
-                self._accept_token(req, int(tokens[S]), now)
+                self._accept_token(req, pre_token, now)
                 self.prefilling = None
                 if req.state != RequestState.FINISHED:
                     req.state = RequestState.DECODE
@@ -806,6 +1213,10 @@ class ServeEngine:
         self.attn_len_samples = []
         self.steps = 0
         self.cow_copies = 0
+        self.spec_ticks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         if self.prefix is not None:
             self.prefix.reset_metrics()
         self._t_start = self.clock()
@@ -825,7 +1236,34 @@ class ServeEngine:
         ps = self.prefix_stats()
         if ps is not None:
             out["prefix"] = ps
+        sp = self.spec_stats()
+        if sp is not None:
+            out["spec"] = sp
         return out
+
+    def spec_stats(self) -> Optional[Dict]:
+        """Speculation accounting over the run (None when speculation
+        is off — consumers must tolerate the key's absence, exactly
+        the ``prefix`` discipline). ``accept_rate`` = accepted
+        proposals over draft proposals; ``tokens_per_step`` = tokens
+        emitted per per-slot speculative tick — > 1 is the whole point
+        (k+1 at a perfect draft, 1 at a useless one: never slower in
+        tokens, only in wasted verify FLOPs)."""
+        if not self.spec_k:
+            return None
+        return {
+            "k": self.spec_k,
+            "draft_layers": self.draft_layers,
+            "ticks": self.spec_ticks,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "accept_rate":
+                (round(self.spec_accepted / self.spec_proposed, 4)
+                 if self.spec_proposed else None),
+            "tokens_per_step":
+                (round(self.spec_emitted / self.spec_ticks, 4)
+                 if self.spec_ticks else None),
+        }
 
     def prefix_stats(self) -> Optional[Dict]:
         """Prefix-cache accounting over the run (None when the cache

@@ -70,6 +70,52 @@ def pages_needed(prompt_len: int, max_new_tokens: int,
     return max(1, math.ceil(positions / page_size))
 
 
+def append_rows(table, start, n: int, *, page_size: int, num_pages: int,
+                valid=None):
+    """The multi-row page-write math every multi-token lane shares —
+    the chunked-prefill lane (``n = prefill_chunk`` rows at
+    ``start..start+n-1``) and the speculative-decode verify window
+    (``n = k+1`` rows at ``t..t+k``). Factored here so the engine's two
+    lanes and the tests agree on ONE spelling of the boundary cases
+    (rows crossing a page edge, rows past the table's last slot, rows
+    masked off per-slot).
+
+    ``table`` is one request's page-table index vector [pps]; ``start``
+    the first absolute cache position (scalar, traced or static);
+    ``valid`` an optional [n] bool mask (``None`` = all rows valid).
+    Returns ``(write_page [n], write_off [n], safe_pos [n])``:
+
+    * ``write_page`` — the physical page per row, or the OOB sentinel
+      ``num_pages`` for invalid rows, so every scatter through it uses
+      ``mode="drop"`` and an invalid row never touches a real page
+      (page 0, the null sink, included);
+    * ``write_off`` — the in-page offset per row;
+    * ``safe_pos`` — the row's absolute position clipped into
+      ``0..Lmax-1`` (what gathered-view scatters index with; invalid
+      rows must be redirected to the ``Lmax`` drop index by the
+      caller, exactly the prefill lane's spelling).
+
+    Rollback of rejected speculative rows is pure page-table
+    arithmetic on top of this: stale rows sit at positions the next
+    window either overwrites (same ``write_page/write_off`` math) or
+    masks (causal attention never admits a key past its own query), so
+    no erasure pass exists — and a shared/COW page is copied BEFORE
+    the window writes (the engine's ``_cow_guard`` covers the whole
+    ``start..start+n-1`` range), so a rejected row can never have
+    touched another holder's page."""
+    import jax.numpy as jnp
+
+    rows = jnp.arange(n)
+    positions = start + rows
+    lmax = table.shape[0] * page_size
+    safe_pos = jnp.clip(positions, 0, lmax - 1)
+    ok = positions < lmax
+    if valid is not None:
+        ok = jnp.logical_and(valid, ok)
+    write_page = jnp.where(ok, table[safe_pos // page_size], num_pages)
+    return write_page, safe_pos % page_size, safe_pos
+
+
 def fits_geometry(prompt_len: int, max_new_tokens: int, *, max_len: int,
                   page_size: int, capacity: int) -> bool:
     """Whether a request can EVER run on this cache geometry: position

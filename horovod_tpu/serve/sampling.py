@@ -21,25 +21,61 @@ tokens — sampling is a pure function of (seed, position, logits).
 This intentionally differs from ``lm_decode``'s single split-chain key
 (which is batch-coupled: one key drives all B rows); only the greedy
 path is pinned token-exact against the decode lane.
+
+**Speculative decoding** adds two surfaces, both keyed by the same
+(seed, absolute output position) scheme with DOMAIN-SEPARATED folds so
+the draft's randomness never collides with the target's:
+
+* :func:`draft_sample_tokens` — the in-step draft proposal (greedy
+  when ``temperature == 0``; otherwise a draw from the DRAFT's own
+  top-k/temperature distribution, the ``q`` the rejection test needs
+  proposals to actually follow);
+* :func:`speculative_accept` — the host-side acceptance rule for one
+  slot. Greedy: keep the longest prefix where draft and target
+  argmaxes agree, then the target's token at the first mismatch (the
+  correction) or one bonus token — every emitted token is a target
+  argmax of its true prefix, which is the bit-exactness proof.
+  ``temperature > 0``: standard rejection sampling (accept ``d_i``
+  iff ``u_i * q_i(d_i) <= p_i(d_i)``; on reject, resample from the
+  normalized residual ``max(p - q, 0)``), every draw position-folded,
+  so eviction-recompute and fleet redispatch re-draw identically under
+  the SAME window alignment (greedy is alignment-independent; sampled
+  streams are same-seed deterministic — docs/serving.md spells out
+  the clean-vs-faulted caveat).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+#: Domain separators folded into the position key so the draft's
+#: proposal draw, the acceptance uniform and the residual resample are
+#: three independent streams per (seed, position).
+DRAFT_FOLD = 0x5D_01
+ACCEPT_FOLD = 0x5D_02
+RESIDUAL_FOLD = 0x5D_03
 
 
-def _sample_one(logits, temperature, top_k, seed, position):
-    """One slot: logits [V] f32 -> token (int32 scalar)."""
+def _masked_logits(logits, temperature, top_k):
+    """Top-k + temperature masking shared by every sampling surface:
+    logits [V] f32 -> masked logits [V] (kept entries divided by the
+    temperature, the rest -inf; ties at the k-th logit all kept)."""
     v = logits.shape[0]
-    greedy = jnp.argmax(logits, axis=-1)
-
-    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
     k = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
     # Descending sort once; the k-th value is the keep threshold.
     thresh = jnp.sort(logits)[::-1][k - 1]
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    masked = jnp.where(logits >= thresh, logits / safe_t, -jnp.inf)
+    return jnp.where(logits >= thresh, logits / safe_t, -jnp.inf)
+
+
+def _sample_one(logits, temperature, top_k, seed, position):
+    """One slot: logits [V] f32 -> token (int32 scalar)."""
+    greedy = jnp.argmax(logits, axis=-1)
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    masked = _masked_logits(logits, temperature, top_k)
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled,
                      greedy).astype(jnp.int32)
@@ -59,3 +95,105 @@ def sample_tokens(logits, temperature, top_k, seeds, positions):
                                  top_k.astype(jnp.int32),
                                  seeds.astype(jnp.uint32),
                                  positions.astype(jnp.uint32))
+
+
+def _draft_one(logits, temperature, top_k, seed, position):
+    """One slot's draft proposal: logits [V] f32 -> token. Greedy at
+    ``temperature == 0`` (the bit-exact lane — proposal quality only
+    moves the accept rate, never a token); otherwise a draw from the
+    draft's OWN masked distribution under the ``DRAFT_FOLD``-separated
+    position key, so the rejection test upstream sees proposals that
+    genuinely follow ``q``."""
+    greedy = jnp.argmax(logits, axis=-1)
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), position),
+        DRAFT_FOLD)
+    masked = _masked_logits(logits, temperature, top_k)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled,
+                     greedy).astype(jnp.int32)
+
+
+def draft_sample_tokens(logits, temperature, top_k, seeds, positions):
+    """Vectorized draft proposals, traced INSIDE the compiled serve
+    step (not jitted here — the propose scan feeds each proposal to
+    the next draft step): logits [N, V] -> tokens [N] i32."""
+    logits = logits.astype(jnp.float32)
+    return jax.vmap(_draft_one)(logits,
+                                temperature.astype(jnp.float32),
+                                top_k.astype(jnp.int32),
+                                seeds.astype(jnp.uint32),
+                                positions.astype(jnp.uint32))
+
+
+def speculative_accept(target_logits, draft_toks, draft_logits, *,
+                       temperature: float, top_k: int, seed: int,
+                       position0: int):
+    """The acceptance rule for ONE slot's speculative tick.
+
+    ``target_logits`` [w, V] are the verify pass's logits (row i draws
+    the token at output position ``position0 + i``); ``draft_toks``
+    [w-1] and ``draft_logits`` [w-1, V] are the draft's proposals for
+    rows 1..w-1's PREDECESSOR positions (proposal i competes for
+    output position ``position0 + i``). Returns the emitted tokens —
+    between 1 (immediate mismatch/reject: the correction alone) and
+    ``w`` (every proposal accepted + the bonus).
+
+    Greedy (``temperature <= 0``): emit ``argmax(float32 row)`` — the
+    exact :func:`sample_tokens` greedy spelling — walking rows while
+    the draft's proposal matches. Bit-identical to the non-speculative
+    engine by construction: the emitted token at any position is the
+    target's argmax given exactly the previously emitted prefix, no
+    matter what the draft proposed or where tick boundaries fell.
+
+    ``temperature > 0``: Leviathan-style rejection sampling. Proposal
+    ``d_i ~ q_i`` is accepted iff ``u_i * q_i(d_i) <= p_i(d_i)`` with
+    ``u_i`` drawn under the ``ACCEPT_FOLD`` position key; on rejection
+    the correction comes from the normalized residual ``max(p_i - q_i,
+    0)`` under the ``RESIDUAL_FOLD`` key, preserving the target
+    distribution exactly. The bonus token (all proposals accepted) and
+    the ``w == 1`` degenerate tick use :func:`_sample_one` verbatim —
+    the NON-speculative draw at that position, same key and all."""
+    tl = jnp.asarray(target_logits).astype(jnp.float32)
+    w = tl.shape[0]
+    if temperature <= 0:
+        tgt = np.asarray(jnp.argmax(tl, axis=-1))
+        out = []
+        for i in range(w):
+            out.append(int(tgt[i]))
+            if i == w - 1 or int(draft_toks[i]) != int(tgt[i]):
+                break
+        return out
+
+    dl = jnp.asarray(draft_logits).astype(jnp.float32)
+    out = []
+    for i in range(w - 1):
+        d = int(draft_toks[i])
+        p = np.asarray(jax.nn.softmax(
+            _masked_logits(tl[i], temperature, top_k)))
+        q = np.asarray(jax.nn.softmax(
+            _masked_logits(dl[i], temperature, top_k)))
+        pos_key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                     np.uint32(position0 + i))
+        u = float(jax.random.uniform(
+            jax.random.fold_in(pos_key, ACCEPT_FOLD)))
+        if u * float(q[d]) <= float(p[d]):
+            out.append(d)
+            continue
+        residual = np.maximum(p - q, 0.0)
+        total = float(residual.sum())
+        # total == 0 means p <= q everywhere, i.e. p == q (both sum to
+        # 1) — the accept test above then always fires (u*q <= p), so
+        # this branch is unreachable with total == 0; guard anyway.
+        dist = residual / total if total > 0 else p
+        tok = int(jax.random.categorical(
+            jax.random.fold_in(pos_key, RESIDUAL_FOLD),
+            jnp.log(jnp.asarray(dist))))
+        out.append(tok)
+        return out
+    # Every proposal accepted: the bonus draw IS the non-speculative
+    # sampler at its position (same key, same spelling).
+    out.append(int(_sample_one(tl[w - 1], jnp.float32(temperature),
+                               jnp.int32(top_k), jnp.uint32(seed),
+                               jnp.uint32(position0 + w - 1))))
+    return out

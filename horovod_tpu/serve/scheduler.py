@@ -202,6 +202,18 @@ class Request:                     # tracked by `is` in slot lists
     def done_generating(self) -> bool:
         return len(self.generated) >= self.max_new_tokens
 
+    def spec_window(self, k: int) -> int:
+        """Budget clamp for a speculative tick: how many draft
+        proposals this request can still USE. A tick emits between 1
+        and proposals+1 tokens, so proposals beyond
+        ``max_new_tokens - len(generated) - 1`` could only produce
+        tokens past the budget (the host would drop them) while
+        writing KV rows past the request's reserve-mode page grant —
+        clamp instead. 0 = degenerate tick (verify-only, exactly one
+        token, the plain decode step in a width-1 window)."""
+        return max(0, min(k, self.max_new_tokens
+                          - len(self.generated) - 1))
+
     def hit_eos(self, default_eos: Optional[int]) -> bool:
         eos = self.eos_token if self.eos_token is not None else default_eos
         return bool(self.generated) and eos is not None \

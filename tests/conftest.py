@@ -312,6 +312,54 @@ _SLOW_TESTS = {
     # the protocol stub) + TestVersionedRollingUpdate (inproc version
     # pinning vs lm_decode) + the check.sh rolling-update smoke.
     "test_serve_worker.py::TestRealWorkerE2E::test_tcp_rolling_update_torn_push_bit_exact_vs_lm_decode",
+    # Round-19 speculative decoding (each spec cell pays the draft-
+    # scan + verify-window compile, ~6-7s): the k=2 cells of the
+    # exactness matrix stay fast in ALL FOUR attention×mesh
+    # combinations as the named stand-ins — window math is
+    # k-independent (the k=7 > steps clamp is pinned fast at the
+    # model level by test_parallel_lm spec tests and at the engine
+    # level by test_budget_clamp_never_overshoots).
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[1-gather-tp1]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[1-paged-tp1]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[1-gather-tp4]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[1-paged-tp4]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[4-gather-tp1]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[4-paged-tp1]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[4-gather-tp4]",
+    "test_serve_engine.py::TestSpeculativeExactness::test_spec_stream_bit_identical[4-paged-tp4]",
+    # 10s + 8s spec-composition depth: eviction-recompute and prefix/
+    # COW under speculation re-run machinery whose non-spec twins
+    # (TestGreedyExactness eviction matrix, TestTPSharding prefix/COW)
+    # and spec twins (the k=2 matrix above, which exercises the SAME
+    # widened page-grant/_cow_guard arithmetic every tick) stay fast;
+    # the check.sh spec smoke runs the full contract end-to-end.
+    "test_serve_engine.py::TestSpeculativeLifecycle::test_eviction_recompute_stays_exact_under_spec",
+    "test_serve_engine.py::TestSpeculativeLifecycle::test_prefix_cow_stays_exact_under_spec",
+    # 9s + 5s: two more spec engine compiles; fast stand-ins are the
+    # host-side TestSpeculativeAcceptUnit rejection-sampling pins
+    # (same speculative_accept code path, no compile) and the
+    # non-spec TestSampling determinism/neighbor tests.
+    "test_serve_engine.py::TestSpeculativeLifecycle::test_temperature_same_seed_deterministic",
+    "test_serve_engine.py::TestSpeculativeLifecycle::test_greedy_neighbor_unaffected_by_sampling_slot",
+    # ~3s each model-level spec windows at larger k: the [1-1]/[2-1]
+    # cells stay fast and pin the same lm_decode_spec == lm_decode
+    # equality; k=4/k=7 add only window width (and the k > steps
+    # clamp, re-pinned fast by the engine budget-clamp test).
+    "test_parallel_lm.py::test_spec_decode_matches_lm_decode[4-2]",
+    "test_parallel_lm.py::test_spec_decode_matches_lm_decode[7-2]",
+    # ~30s whole-bench --ab-spec subprocess wrapper (an OFF and an ON
+    # serve lane + the bit-identity pin): stand-ins are the fast
+    # test_ab_spec_arg_validation + the in-process spec exactness
+    # matrix, and the check.sh spec smoke runs this exact command
+    # (incl. the accept_rate==1.0 / tokens_per_step>1 record pins)
+    # end-to-end.
+    "test_serve_bench.py::TestServeBenchContract::test_ab_spec_record_contract",
+    # ~26s clean+faulted fleet pair under speculation: the fast
+    # TestKillRedispatch greedy pin covers drain/redispatch and the
+    # spec matrix covers speculative exactness; this composition test
+    # (redispatch resumes MID-STREAM under speculative windows) runs
+    # in the CI gate.
+    "test_serve_fleet.py::TestSpeculativeFleet::test_kill_redispatch_bit_exact_under_spec",
 }
 
 

@@ -130,12 +130,21 @@ def test_registry_shape():
     serve = by_group["serve"]
     assert {p.name for p in serve} == {
         "serve.step", "serve.step_paged",
-        "serve.step_tp", "serve.step_tp_paged"}
+        "serve.step_tp", "serve.step_tp_paged",
+        "serve.step_spec", "serve.step_spec_paged",
+        "serve.step_spec_tp"}
     assert all(p.forbid_donation for p in serve)
+    # The speculative programs carry the sharpened donation rationale:
+    # the pre-step pages are the rejected window's rollback substrate.
+    spec = [p for p in serve if "spec" in p.name]
+    assert len(spec) == 3
+    assert all("rejected window" in p.forbid_donation_why or
+               "rejection falls back" in p.forbid_donation_why
+               for p in spec)
     # The TP variants carry the full HVV2xx surface (sharding table +
     # bound LogicalMesh), like the composed stacks.
     tp_serve = [p for p in serve if "_tp" in p.name]
-    assert len(tp_serve) == 2
+    assert len(tp_serve) == 3
     assert all(p.shardings is not None for p in tp_serve)
     assert all(p.logical_mesh is not None for p in tp_serve)
     assert all(p.reconcile is not None for p in by_group["optimizer"])
@@ -629,3 +638,39 @@ def test_cli_clean_program_exits_zero():
     assert rec["program"] == "optimizer.fused"
     assert rec["collectives"]["count"] >= 2
     assert rec["findings"] == []
+
+
+def test_serve_step_spec_verifies_and_donating_variant_is_flagged(hvd):
+    """Round-19 speculative serving invariant: the speculative step
+    (layer-skip draft scan + rectangular verify pass, traced exactly
+    as ServeEngine jits it when speculate_k > 0) verifies clean under
+    forbid_donation — and the donate-the-pages variant is an HVV104
+    finding. Sharpened rationale: a rejected window rolls back by page
+    arithmetic over the PRE-step pages, so donating them destroys the
+    very state a rejection falls back to."""
+    import functools
+
+    import jax
+
+    from tools.hvdverify.registry import _build_serve_step_spec
+    from tools.hvdverify.registry import REGISTRY as _REG
+
+    why = next(p for p in _REG
+               if p.name == "serve.step_spec").forbid_donation_why
+    fn, args = _build_serve_step_spec()
+    clean = verify(fn, args, name="serve.step_spec",
+                   forbid_donation=True, forbid_donation_why=why)
+    assert not clean.findings
+    assert clean.summary["count"] == 0     # tp=1: no collectives
+
+    from horovod_tpu.serve.engine import serve_step_spec
+
+    donating = jax.jit(
+        functools.partial(serve_step_spec, k=2, draft_layers=1,
+                          page_size=8),
+        donate_argnums=(1,))               # donate the pages
+    flagged = verify(lambda p, pages, d, pr: donating(p, pages, d, pr),
+                     args, name="serve-spec-donating",
+                     forbid_donation=True, forbid_donation_why=why)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+    assert "pages" in flagged.findings[0].message

@@ -499,3 +499,66 @@ def test_fused_loss_train_step_matches_dense(hvd, setup):
     for d, s in zip(flat_d, flat_s):
         np.testing.assert_allclose(np.asarray(s), np.asarray(d),
                                    rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.parametrize("k,dl", [(1, 1), (2, 1), (4, 2), (7, 2)])
+def test_spec_decode_matches_lm_decode(setup, k, dl):
+    """The model-level speculative reference (lm_decode_spec: layer-skip
+    draft + ONE rectangular verify window per tick) is bit-identical to
+    greedy lm_decode for every window size and draft depth — proposals
+    only decide how many target argmaxes one dispatch yields, never
+    what they are. k=7 exercises the budget clamp (k > steps)."""
+    params, tokens = setup
+    prompt = tokens[:1, :6]
+    want = np.asarray(plm.lm_decode(params, prompt, 8))
+    got = np.asarray(plm.lm_decode_spec(params, prompt, 8, k=k,
+                                        draft_layers=dl))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_verify_window_w1_is_decode_step(setup):
+    """w=1 verify window IS lm_decode_step shape-for-shape: identical
+    logits and identical cache rows — the rectangular pass degrades to
+    the sequential step exactly."""
+    params, tokens = setup
+    prompt = tokens[:2, :5]
+    caches, logits = plm.lm_prefill(params, prompt)
+    tok = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+        jnp.int32)
+    c_seq, lg_seq = plm.lm_decode_step(params, caches, tok, 5)
+    c_win, lg_win = plm.lm_verify_window(params, caches, tok[:, None], 5)
+    np.testing.assert_array_equal(np.asarray(lg_win[:, 0]),
+                                  np.asarray(lg_seq))
+    for a, b in zip(c_seq, c_win):
+        np.testing.assert_array_equal(np.asarray(a["k"]),
+                                      np.asarray(b["k"]))
+        np.testing.assert_array_equal(np.asarray(a["v"]),
+                                      np.asarray(b["v"]))
+
+
+def test_draft_params_is_a_zero_copy_view(setup):
+    """The layer-skip draft shares the target's arrays (no copy): same
+    embed/head objects, layer list a prefix slice — and out-of-range
+    depths die loudly."""
+    params, _ = setup
+    d = plm.draft_params(params, 1)
+    assert d["embed"] is params["embed"]
+    assert d["head"] is params["head"]
+    assert d["layers"] == params["layers"][:1]
+    assert len(plm.draft_params(params, LAYERS)["layers"]) == LAYERS
+    for bad in (0, -1, LAYERS + 1):
+        with pytest.raises(ValueError, match="draft_params"):
+            plm.draft_params(params, bad)
+
+
+def test_spec_decode_validation(setup):
+    params, tokens = setup
+    with pytest.raises(ValueError, match="single-row"):
+        plm.lm_decode_spec(params, tokens[:2, :4], 4, k=2,
+                           draft_layers=1)
+    with pytest.raises(ValueError, match="k must be"):
+        plm.lm_decode_spec(params, tokens[:1, :4], 4, k=0,
+                           draft_layers=1)
+    with pytest.raises(ValueError, match="position table"):
+        plm.lm_decode_spec(params, tokens[:1, :4], LMAX, k=2,
+                           draft_layers=1)

@@ -183,6 +183,51 @@ class TestServeBenchContract:
             p = _run("serve_bench.py", *TINY, *argv, check=False)
             assert p.returncode == 2, (argv, p.stderr[-300:])
 
+
+    def test_ab_spec_record_contract(self):
+        """--ab-spec (round 19): one record, speculative side as the
+        headline, the non-spec side under serve.ab_spec.base, the
+        greedy streams of BOTH sides pinned bit-identical
+        (exact_pin.identical), and the full-depth draft's
+        deterministic accounting: accept_rate exactly 1.0,
+        tokens_per_step > 1."""
+        p = _run("serve_bench.py", *TINY, "--speculate", "4",
+                 "--draft-layers", "2", "--ab-spec", "--pin-exact",
+                 "--require-finished")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "serve_ab_spec_tokens_per_sec_per_chip"
+        assert rec["config"]["speculate_k"] == "ab"
+        s = rec["serve"]
+        assert s["mode"] == "ab_spec"
+        assert s["spec"]["k"] == 4 and s["spec"]["draft_layers"] == 2
+        ab = s["ab_spec"]
+        assert ab["k"] == 4 and ab["draft_layers"] == 2
+        assert ab["base"]["spec"] is None
+        assert ab["base"]["tokens_per_sec_per_chip"] > 0
+        assert ab["exact_pin"]["identical"] is True
+        assert ab["exact_pin"]["compared"] == 6
+        # draft depth == target depth (TINY has 2 layers): the draft
+        # IS the target, so acceptance is total by construction
+        assert ab["accept_rate"] == 1.0
+        assert ab["tokens_per_step"] > 1.0
+        assert ab["spec_over_base"] is not None
+
+    def test_ab_spec_arg_validation(self):
+        # --ab-spec without --speculate, with every other A/B mode,
+        # with a fleet, plus the bare spec-knob misuses are all
+        # argparse errors
+        for argv in (["--ab-spec"],
+                     ["--speculate", "2", "--ab-spec", "--ab"],
+                     ["--speculate", "2", "--ab-spec", "--static"],
+                     ["--speculate", "2", "--ab-spec",
+                      "--ab-attention"],
+                     ["--speculate", "2", "--ab-spec", "--ab-prefix"],
+                     ["--speculate", "2", "--ab-spec", "--fleet", "2"],
+                     ["--speculate", "-1"],
+                     ["--draft-layers", "1"]):
+            p = _run("serve_bench.py", *TINY, *argv, check=False)
+            assert p.returncode == 2, (argv, p.stderr[-300:])
+
     def test_require_finished_fails_loudly(self):
         # capacity of ONE page (8 positions): several drawn requests
         # can never fit and hard-reject -> --require-finished exits 1

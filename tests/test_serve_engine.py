@@ -664,3 +664,281 @@ class TestTPSharding:
                 np.testing.assert_array_equal(
                     got, np.asarray(live[1]))
         assert outs[None] == outs["dp=1,tp=4"]
+
+
+def _spec_cfg(k, attention="gather", mesh=None, **kw):
+    base = dict(page_size=8, num_pages=40, decode_slots=2,
+                prefill_chunk=4, speculate_k=k, draft_layers=1,
+                attention=attention, mesh=mesh)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.mark.parametrize("mesh", MESHES, ids=MESH_IDS)
+@pytest.mark.parametrize("attention", ["gather", "paged"])
+class TestSpeculativeExactness:
+    """The round-19 acceptance pin: with ``speculate_k > 0`` every
+    greedy stream is BIT-IDENTICAL to ``lm_decode`` AND (therefore) to
+    the non-speculative engine — across the same attention AND mesh
+    matrix as TestGreedyExactness, for every window size ``k``. The
+    draft is the layer-skip view (target's first layer here), so a
+    wrong draft can only cost speedup, never tokens. The k=1/k=4
+    cells are slow-marked in tests/conftest.py; the k=2 cells stay
+    fast in all four attention×mesh combinations as the named
+    stand-ins."""
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_spec_stream_bit_identical(self, params, attention, mesh,
+                                       k):
+        spec = [(5, 9), (9, 6), (3, 11)]
+        prompts = [_prompt(70 + i, lp) for i, (lp, _) in enumerate(spec)]
+        refs = [_ref(params, p, n) for p, (_, n) in zip(prompts, spec)]
+        eng = ServeEngine(params, _spec_cfg(k, attention, mesh))
+        reqs = [eng.submit(prompts[0], spec[0][1]),
+                eng.submit(prompts[1], spec[1][1])]
+        for _ in range(2):
+            eng.step()               # third request joins mid-flight
+        reqs.append(eng.submit(prompts[2], spec[2][1]))
+        eng.run()
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+        sp = eng.stats()["spec"]
+        assert sp["k"] == k and sp["ticks"] > 0
+        assert sp["tokens_per_step"] is not None
+
+
+class TestSpeculativeLifecycle:
+    """Spec-path composition pins that don't need the full matrix:
+    budget clamping, EOS mid-window, eviction-recompute and prefix COW
+    under speculation, the acceptance accounting, and config
+    validation. One attention mode each — the matrix above already
+    pins both modes' token streams."""
+
+    def test_budget_clamp_never_overshoots(self, params):
+        """k=4 against max_new_tokens in {1, 2, 3}: the per-slot
+        window clamp (Request.spec_window) must stop the stream at
+        EXACTLY the budget — a speculative window may never emit past
+        max_new_tokens. n=1 finishes at prefill (spec_window 0), n=3
+        clamps mid-stream."""
+        for n in (1, 3):
+            prompt = _prompt(80, 7)
+            eng = ServeEngine(params, _spec_cfg(4))
+            req = eng.submit(prompt, n)
+            eng.run()
+            assert req.state == "finished"
+            assert req.output == _ref(params, prompt, n)
+
+    def test_eos_mid_window_truncates(self, params):
+        """An EOS accepted in the middle of a window stops the stream
+        AT the EOS — later accepted rows of the same window must be
+        discarded, exactly like the sequential engine."""
+        prompt = _prompt(3, 6)
+        full = _ref(params, prompt, 8)
+        eos = full[2]
+        stop = full.index(eos) + 1
+        eng = ServeEngine(params, _spec_cfg(4))
+        req = eng.submit(prompt, 8, eos_token=eos)
+        eng.run()
+        assert req.state == "finished"
+        assert req.output == full[:stop]
+
+    def test_eviction_recompute_stays_exact_under_spec(self, params):
+        """Lazy admission under page pressure WITH speculation: the
+        widened page grant (next_pos + spec_window) makes eviction
+        pressure harsher, and a re-prefilled request must still
+        produce the lm_decode stream."""
+        spec = [(9, 10), (11, 8), (10, 9)]
+        prompts = [_prompt(30 + i, lp) for i, (lp, _) in enumerate(spec)]
+        refs = [_ref(params, p, n) for p, (_, n) in zip(prompts, spec)]
+        eng = ServeEngine(params, _spec_cfg(
+            2, attention="paged", page_size=4, num_pages=8,
+            admission="lazy"))
+        reqs = [eng.submit(p, n) for p, (_, n) in zip(prompts, spec)]
+        eng.run(max_steps=500)
+        assert sum(r.evictions for r in reqs) > 0, \
+            "test must exercise the eviction path"
+        for req, ref in zip(reqs, refs):
+            assert req.state == "finished"
+            assert req.output == ref
+
+    def test_prefix_cow_stays_exact_under_spec(self, params):
+        """Prefix-cache hits + COW under speculation: the widened
+        _cow_guard must copy a shared page BEFORE the verify window
+        writes into it, so prefix-mates stay bit-identical to the cold
+        lm_decode stream."""
+        sys_p = _prompt(61, 16)
+        eng = ServeEngine(params, _spec_cfg(2, prefix_caching=True,
+                                            decode_slots=1))
+        prompts = [np.concatenate([sys_p, _prompt(62 + i, 3)])
+                   for i in range(2)]
+        reqs = [eng.submit(p, 4) for p in prompts]
+        eng.run()
+        assert eng.prefix_stats()["hits"] >= 1
+        for req, p in zip(reqs, prompts):
+            assert req.state == "finished"
+            assert req.output == _ref(params, p, 4)
+
+    def test_full_depth_draft_accepts_everything(self, params):
+        """draft_layers == n_layers makes the draft ≡ the target, so
+        every proposal is accepted by construction: accept_rate is
+        EXACTLY 1.0 and tokens_per_step > 1 — the deterministic CI pin
+        that the multi-token fast path actually engages."""
+        prompt = _prompt(81, 6)
+        eng = ServeEngine(params, _spec_cfg(4, draft_layers=LAYERS,
+                                            decode_slots=1))
+        req = eng.submit(prompt, 9)
+        eng.run()
+        assert req.output == _ref(params, prompt, 9)
+        sp = eng.stats()["spec"]
+        assert sp["accept_rate"] == 1.0
+        assert sp["proposed"] == sp["accepted"] > 0
+        assert sp["tokens_per_step"] > 1.0
+
+    def test_draft_layers_auto_default(self, params):
+        """draft_layers=0 = auto (half the stack). Construction-only:
+        the engine resolves the depth before anything compiles, and
+        the exactness matrix above already runs explicit depths."""
+        eng = ServeEngine(params, _spec_cfg(2, draft_layers=0))
+        assert eng.draft_layers == max(1, LAYERS // 2)
+        assert eng.spec_stats()["draft_layers"] == LAYERS // 2
+
+    def test_temperature_same_seed_deterministic(self, params):
+        """temp>0 under speculation: the position-folded rejection
+        sampling is deterministic per seed (two identical runs agree),
+        and every token is in-vocab. NOT pinned vs the non-spec
+        engine — window alignment legitimately changes which folded
+        key draws each position's uniform."""
+        prompt = _prompt(83, 5)
+        outs = []
+        for _ in range(2):
+            eng = ServeEngine(params, _spec_cfg(2, decode_slots=1))
+            req = eng.submit(prompt, 6, temperature=0.8, top_k=8,
+                             seed=42)
+            eng.run()
+            assert req.state == "finished"
+            outs.append(req.output)
+        assert outs[0] == outs[1]
+        assert all(0 <= t < V for t in outs[0])
+
+    def test_greedy_neighbor_unaffected_by_sampling_slot(self, params):
+        """A greedy stream sharing speculative steps with a
+        temperature stream stays bit-identical to lm_decode."""
+        pg, ps = _prompt(7, 6), _prompt(8, 6)
+        ref = _ref(params, pg, 6)
+        eng = ServeEngine(params, _spec_cfg(2))
+        rg = eng.submit(pg, 6)
+        rs = eng.submit(ps, 6, temperature=1.2, top_k=4, seed=9)
+        eng.run()
+        assert rg.output == ref
+        assert all(0 <= t < V for t in rs.output)
+
+    def test_spec_stats_block_shape_and_reset(self, params):
+        eng = ServeEngine(params, _spec_cfg(2))
+        req = eng.submit(_prompt(84, 5), 5)
+        eng.run()
+        sp = eng.stats()["spec"]
+        assert set(sp) == {"k", "draft_layers", "ticks", "proposed",
+                           "accepted", "accept_rate", "tokens_per_step"}
+        assert sp["ticks"] > 0 and sp["proposed"] >= sp["accepted"] >= 0
+        # emitted tokens per tick can never exceed the window
+        assert 1.0 <= sp["tokens_per_step"] <= sp["k"] + 1
+        assert req.output == _ref(params, req.prompt, 5)
+        eng.reset_metrics()
+        sp = eng.spec_stats()
+        assert sp["ticks"] == sp["proposed"] == sp["accepted"] == 0
+        assert sp["accept_rate"] is None
+        # the non-speculative engine has NO spec block at all
+        base = ServeEngine(params, ServeConfig(
+            page_size=8, num_pages=16, decode_slots=1, prefill_chunk=4))
+        assert "spec" not in base.stats()
+        assert base.spec_stats() is None
+
+    def test_spec_engine_compiles_once(self, params):
+        """Join/leave across speculative steps never recompiles: the
+        widened step programs are shape-stable (width rides data, not
+        shape)."""
+        eng = ServeEngine(params, _spec_cfg(2))
+        for i in range(4):
+            eng.submit(_prompt(85 + i, 3 + i), 3 + i)
+        eng.run()
+        if not hasattr(eng._step_mixed, "_cache_size"):
+            pytest.skip("no jit cache introspection on this jax")
+        mixed = eng._step_mixed._cache_size()
+        decode = eng._step_decode._cache_size()
+        assert mixed <= 1 and decode <= 1 and mixed + decode >= 1
+
+    def test_config_validation(self, params):
+        with pytest.raises(ValueError, match="speculate_k"):
+            ServeConfig(speculate_k=-1)
+        with pytest.raises(ValueError, match="draft_layers"):
+            ServeConfig(draft_layers=1)       # without speculate_k
+        with pytest.raises(ValueError, match="draft_layers"):
+            ServeConfig(speculate_k=2, draft_layers=-1)
+        # draft deeper than the target dies at engine construction
+        with pytest.raises(ValueError, match="draft"):
+            ServeEngine(params, _spec_cfg(2, draft_layers=LAYERS + 1))
+
+
+class TestSpeculativeAcceptUnit:
+    """Host-side pins on the acceptance rule itself
+    (serve.sampling.speculative_accept) — no engine, no compile: the
+    fast stand-ins for the slow-marked temperature e2e."""
+
+    def _rows(self, w, vocab=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal((w, vocab)).astype(np.float32)
+
+    def test_greedy_longest_agreeing_prefix(self):
+        from horovod_tpu.serve.sampling import speculative_accept
+
+        tl = self._rows(4)
+        tgt = [int(np.argmax(r)) for r in tl]
+        # drafts agree at rows 0,1 then diverge at row 2: emit the two
+        # agreed tokens plus the row-2 correction, nothing after.
+        draft = np.asarray([tgt[0], tgt[1], (tgt[2] + 1) % 16],
+                           np.int32)
+        out = speculative_accept(tl, draft, self._rows(3, seed=1),
+                                 temperature=0.0, top_k=0, seed=0,
+                                 position0=5)
+        assert out == tgt[:3]
+
+    def test_greedy_all_accepted_emits_bonus(self):
+        from horovod_tpu.serve.sampling import speculative_accept
+
+        tl = self._rows(4, seed=2)
+        tgt = [int(np.argmax(r)) for r in tl]
+        out = speculative_accept(tl, np.asarray(tgt[:3], np.int32),
+                                 self._rows(3, seed=3),
+                                 temperature=0.0, top_k=0, seed=0,
+                                 position0=0)
+        assert out == tgt          # k accepted + the bonus row
+
+    def test_greedy_first_mismatch_emits_one(self):
+        from horovod_tpu.serve.sampling import speculative_accept
+
+        tl = self._rows(3, seed=4)
+        tgt = [int(np.argmax(r)) for r in tl]
+        draft = np.asarray([(tgt[0] + 1) % 16, tgt[1]], np.int32)
+        out = speculative_accept(tl, draft, self._rows(2, seed=5),
+                                 temperature=0.0, top_k=0, seed=0,
+                                 position0=0)
+        assert out == tgt[:1]      # the correction alone
+
+    def test_stochastic_deterministic_and_window_bounded(self):
+        from horovod_tpu.serve.sampling import speculative_accept
+
+        tl = self._rows(5, seed=6)
+        draft = np.asarray([3, 7, 1, 9], np.int32)
+        dl = self._rows(4, seed=7)
+        kw = dict(temperature=0.8, top_k=8, seed=42, position0=11)
+        a = speculative_accept(tl, draft, dl, **kw)
+        b = speculative_accept(tl, draft, dl, **kw)
+        assert a == b              # same folded keys, same stream
+        assert 1 <= len(a) <= 5    # never empty, never past the window
+        assert all(0 <= t < 16 for t in a)
+        # a different seed may disagree, a different position0 must
+        # still emit a valid stream (position-folded keys)
+        c = speculative_accept(tl, draft, dl, temperature=0.8, top_k=8,
+                               seed=42, position0=12)
+        assert 1 <= len(c) <= 5

@@ -899,3 +899,43 @@ class TestVersionedRollingUpdate:
             assert r.output == _ref(params, _prompt(27, 6), 4)
         finally:
             fl.close()
+
+
+class TestSpeculativeFleet:
+    """Speculation rides ServeConfig, so every fleet replica builds a
+    speculative engine with NO fleet-layer changes — re-prove the kill/
+    redispatch exactness pin with speculate_k on: a redispatched
+    request re-prefills on the survivor, resumes mid-stream under
+    speculative windows, and still emits the lm_decode stream."""
+
+    def test_kill_redispatch_bit_exact_under_spec(self, params):
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7)]
+        cfg = _cfg(speculate_k=2, draft_layers=1)
+        outs = []
+        for faulted in (False, True):
+            clk = FakeClock()
+            fl = _fleet(params, clk, cfg=cfg, max_restarts=2)
+            reqs = [fl.submit(_prompt(10 + i, lp), n)
+                    for i, (lp, n) in enumerate(spec)]
+            if faulted:
+                for _ in range(4):
+                    fl.step()
+                    clk.t += 0.001
+                victims = list(fl.replicas[1].assigned)
+                assert victims, "kill must catch in-flight work"
+                fl.arm_fault_plan("kill:replica=1,at=0s")
+            while not fl.idle:
+                fl.step()
+                clk.t += 0.001
+            outs.append((reqs, fl))
+        (clean_reqs, _), (faulted_reqs, fl) = outs
+        assert fl.stats()["fleet"]["redispatched"] >= 1
+        for i, (rc, rf) in enumerate(zip(clean_reqs, faulted_reqs)):
+            assert rf.state == "finished", (i, rf.state)
+            assert rf.output == rc.output, i
+            assert rc.output == _ref(params, _prompt(10 + i, spec[i][0]),
+                                     spec[i][1])
+        # both fleets actually speculated (every replica stamps spec)
+        assert any(rep.engine.spec_stats() is not None
+                   and rep.engine.spec_stats()["ticks"] > 0
+                   for rep in fl.replicas if rep.engine is not None)

@@ -311,3 +311,73 @@ def test_request_validation():
         Request(prompt=np.zeros((0,), np.int32), max_new_tokens=1)
     with pytest.raises(ValueError):
         Request(prompt=np.zeros((3,), np.int32), max_new_tokens=0)
+
+
+class TestAppendRows:
+    """The multi-row page-write math (kvcache.append_rows) shared by
+    the chunked-prefill lane and the speculative verify window: page-
+    edge crossings, the OOB/invalid sentinel (every scatter through it
+    uses mode="drop"), and scatter conservation — an invalid row never
+    touches a real page, the null page included."""
+
+    def _table(self, *pages):
+        import jax.numpy as jnp
+        return jnp.asarray(pages, jnp.int32)
+
+    def test_rows_cross_a_page_edge(self):
+        from horovod_tpu.serve.kvcache import append_rows
+
+        table = self._table(3, 5, 7, 2)
+        wp, wo, sp = append_rows(table, 6, 4, page_size=8, num_pages=16)
+        assert list(np.asarray(wp)) == [3, 3, 5, 5]
+        assert list(np.asarray(wo)) == [6, 7, 0, 1]
+        assert list(np.asarray(sp)) == [6, 7, 8, 9]
+
+    def test_valid_mask_redirects_to_sentinel(self):
+        import jax.numpy as jnp
+
+        from horovod_tpu.serve.kvcache import append_rows
+
+        table = self._table(3, 5, 7, 2)
+        valid = jnp.asarray([True, True, False, False])
+        wp, wo, _ = append_rows(table, 6, 4, page_size=8, num_pages=16,
+                                valid=valid)
+        # masked rows write the OOB sentinel page (num_pages), never a
+        # real page and never the null page 0
+        assert list(np.asarray(wp)) == [3, 3, 16, 16]
+        assert list(np.asarray(wo)) == [6, 7, 0, 1]
+
+    def test_rows_past_lmax_are_dropped(self):
+        from horovod_tpu.serve.kvcache import append_rows
+
+        table = self._table(3, 5)        # Lmax = 16
+        wp, wo, sp = append_rows(table, 14, 4, page_size=8,
+                                 num_pages=16)
+        assert list(np.asarray(wp)) == [5, 5, 16, 16]
+        assert list(np.asarray(wo)) == [6, 7, 7, 7]
+        # safe_pos clips into 0..Lmax-1 for the gathered-view spelling
+        assert list(np.asarray(sp)) == [14, 15, 15, 15]
+
+    def test_scatter_conservation_through_drop_mode(self):
+        """Write a k+1 window through append_rows with a partial valid
+        mask into a real page pool: valid rows land at exactly their
+        page/offset, every other cell — other pages, the null page,
+        the masked rows' would-be cells — is untouched."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.serve.kvcache import append_rows
+
+        num_pages, ps = 6, 4
+        pool = jnp.zeros((num_pages, ps), jnp.float32)
+        table = self._table(2, 4)
+        valid = jnp.asarray([True, True, False])
+        wp, wo, _ = append_rows(table, 3, 3, page_size=ps,
+                                num_pages=num_pages, valid=valid)
+        new = pool.at[wp, wo].set(1.0, mode="drop")
+        got = np.asarray(new)
+        want = np.zeros((num_pages, ps), np.float32)
+        want[2, 3] = 1.0                 # position 3: page 2, offset 3
+        want[4, 0] = 1.0                 # position 4: page 4, offset 0
+        np.testing.assert_array_equal(got, want)
+        assert got[0].sum() == 0         # null page untouched
+        assert got.sum() == 2.0          # nothing else written

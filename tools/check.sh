@@ -75,6 +75,14 @@
 #                               processes asserted after exit)
 #   tools/check.sh --no-elastic skip the elastic smoke (lint-only gate)
 #   tools/check.sh --no-serve   skip the serving smoke
+#   tools/check.sh --no-spec    skip the speculative-decoding smoke
+#                               (round-19 tentpole: the identical
+#                               8-request workload with speculation off
+#                               then on at k=4 with a FULL-DEPTH draft
+#                               — greedy streams bit-identical across
+#                               the sides, accept_rate exactly 1.0 and
+#                               tokens_per_step > 1 asserted from the
+#                               record)
 #   tools/check.sh --no-fleet   skip the fleet smoke
 #   tools/check.sh --no-fleet-proc  skip the process-fleet smoke
 #   tools/check.sh --no-fleet-tcp   skip the loopback-TCP fleet smoke
@@ -124,6 +132,7 @@ cd "$(dirname "$0")/.."
 SANITIZE=0
 ELASTIC=1
 SERVE=1
+SPEC=1
 FLEET=1
 FLEET_PROC=1
 FLEET_TCP=1
@@ -137,6 +146,7 @@ for arg in "$@"; do
     --sanitize) SANITIZE=1 ;;
     --no-elastic) ELASTIC=0 ;;
     --no-serve) SERVE=0 ;;
+    --no-spec) SPEC=0 ;;
     --no-fleet) FLEET=0 ;;
     --no-fleet-proc) FLEET_PROC=0 ;;
     --no-fleet-tcp) FLEET_TCP=0 ;;
@@ -145,7 +155,7 @@ for arg in "$@"; do
     --no-tp-serve) TP_SERVE=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-tp-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-spec] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-tp-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -195,6 +205,39 @@ print("serve smoke [%s]: all 8 finished, TTFT p50/p99 = %s/%s ms, "
                               a["kv_fetch_frac"]))
 '
   done
+fi
+
+if [[ "$SPEC" == "1" ]]; then
+  echo "== speculative-decoding smoke (k=4, full-depth draft: greedy streams bit-identical spec off vs on, accept_rate 1.0, tokens_per_step > 1) =="
+  # --draft-layers 2 == the full 2-layer stack: the draft IS the
+  # target, so every proposal matches its verify row and the
+  # accept-rate / tokens-per-tick asserts are DETERMINISTIC (a
+  # half-depth draft's accept rate depends on the random toy weights).
+  SPEC_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 50 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --speculate 4 --draft-layers 2 --ab-spec \
+    --pin-exact --require-finished)
+  echo "$SPEC_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "ab_spec", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+ab = s["ab_spec"]
+assert ab["k"] == 4, ab
+assert ab["exact_pin"]["identical"] and ab["exact_pin"]["compared"] == 8, ab
+assert ab["accept_rate"] == 1.0, ab
+assert ab["tokens_per_step"] is not None and ab["tokens_per_step"] > 1, ab
+assert ab["base"]["spec"] is None, ab["base"]
+sp = s["spec"]
+assert sp["ticks"] > 0 and sp["proposed"] == sp["accepted"], sp
+print("spec smoke: 8 greedy streams bit-identical off vs on, "
+      "accept_rate %s, tokens_per_step %s (k=%s, %s draft layer(s))"
+      % (ab["accept_rate"], ab["tokens_per_step"], ab["k"],
+         ab["draft_layers"]))
+'
 fi
 
 if [[ "$TP_SERVE" == "1" ]]; then

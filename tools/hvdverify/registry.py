@@ -1045,6 +1045,113 @@ def _build_serve_step_tp(attention: str = "gather"):
         (params, cache.pages, dec, pre)
 
 
+def _spec_dec(sds, jnp, S, pps):
+    """The speculative step's decode batch: serve_step's plus the
+    speculation plane (width + the draft's in-step sampling knobs) —
+    ServeEngine._build_dec's exact spec-mode shape."""
+    return {"tok": sds((S,), jnp.int32), "pos": sds((S,), jnp.int32),
+            "active": sds((S,), jnp.bool_),
+            "tables": sds((S, pps), jnp.int32),
+            "width": sds((S,), jnp.int32),
+            "temp": sds((S,), jnp.float32),
+            "topk": sds((S,), jnp.int32),
+            "seed": sds((S,), jnp.int32),
+            "sidx": sds((S,), jnp.int32)}
+
+
+def _build_serve_step_spec(attention: str = "gather"):
+    """The SPECULATIVE serving step exactly as ServeEngine jits it
+    when ``speculate_k > 0`` (engine.py::serve_step_spec): the
+    layer-skip draft's k-step propose scan + the rectangular-causal
+    verify pass writing up to k+1 KV rows per slot. Same donation
+    invariant as serve.step, sharpened: a speculative tick REJECTS
+    rows by page arithmetic (stale rows are overwritten or causally
+    masked, never erased), so the pre-step pages are the rollback
+    substrate itself — donating them would destroy the very state a
+    rejected window falls back to."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step_spec
+
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4, attention=attention,
+                      speculate_k=2, draft_layers=1)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), 64, 32, 2, 2,
+                                   8, 32))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S, C = cfg.decode_slots, cfg.prefill_chunk
+    sds = jax.ShapeDtypeStruct
+    dec = _spec_dec(sds, jnp, S, pps)
+    pre = {"tokens": sds((C,), jnp.int32), "start": sds((), jnp.int32),
+           "length": sds((), jnp.int32),
+           "table": sds((pps,), jnp.int32)}
+    fn = jax.jit(functools.partial(serve_step_spec,
+                                   k=cfg.speculate_k,
+                                   draft_layers=cfg.draft_layers,
+                                   page_size=cfg.page_size,
+                                   attention=cfg.attention))
+    return (lambda p, pages, d, pr: fn(p, pages, d, pr)), \
+        (params, cache.pages, dec, pre)
+
+
+def _build_serve_step_spec_tp():
+    """The TP-sharded speculative step (ServeConfig.mesh="dp=1,tp=4",
+    ``speculate_k > 0``): serve_step_spec under shard_map — the
+    layer-skip draft needs NO extra sharding story (its layers ARE the
+    target's first layers, so the Megatron specs and the head-sharded
+    page pool cover it by construction), and the verify logits / draft
+    proposals / draft logits come back replicated full-vocab like the
+    base step's. Donation + the full HVV2xx sharding sweep."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step_spec
+
+    V, LMAX, LAYERS, H, DH, FFN = _SERVE_TP_GEOM
+    lm = _logical_mesh(_SERVE_TP_MESH)
+    tp_ax = lm.role_axis("tensor")
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4, mesh=_SERVE_TP_MESH,
+                      speculate_k=2, draft_layers=1)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX,
+                                   LAYERS, H, DH, FFN))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S, C = cfg.decode_slots, cfg.prefill_chunk
+    sds = jax.ShapeDtypeStruct
+    dec = _spec_dec(sds, jnp, S, pps)
+    pre = {"tokens": sds((C,), jnp.int32), "start": sds((), jnp.int32),
+           "length": sds((), jnp.int32),
+           "table": sds((pps,), jnp.int32)}
+    param_specs = lm_param_specs(LAYERS, tp_ax, vocab_parallel=True)
+    kv = P(None, None, tp_ax, None)
+    step = functools.partial(serve_step_spec, k=cfg.speculate_k,
+                             draft_layers=cfg.draft_layers,
+                             page_size=cfg.page_size,
+                             attention=cfg.attention, tp=tp_ax,
+                             vocab_parallel=True)
+    fn = jax.jit(_shmapped(
+        lambda p, pages, d, pr: step(p, pages, d, pr), lm.mesh,
+        in_specs=(param_specs, kv, P(), P()),
+        out_specs=(kv, P(), P(), P(), P())))
+    return (lambda p, pages, d, pr: fn(p, pages, d, pr)), \
+        (params, cache.pages, dec, pre)
+
+
 def _serve_tp_shardings():
     """HVV201 claims for the TP step: the Megatron param placement +
     the head-sharded page pool, all resolved through the rules table
@@ -1196,6 +1303,38 @@ def _make_registry() -> List[Program]:
         forbid_donation_why=_SERVE_WHY + (
             " — TP edition, paged kernel per-shard under shard_map "
             "(grid head dim = H/tp)"),
+        shardings=_serve_tp_shardings,
+        logical_mesh=_serve_tp_logical_mesh))
+
+    # The speculative step (ServeConfig.speculate_k > 0): the draft
+    # propose scan + rectangular-causal verify pass, in both
+    # decode-attention modes plus the TP-sharded composition. The
+    # donation invariant is sharpened here — rejected rows roll back by
+    # PAGE ARITHMETIC over the pre-step arrays, so those arrays are the
+    # rollback substrate itself.
+    _SPEC_WHY = _SERVE_WHY + (
+        " — speculative edition: a rejected window's rows roll back "
+        "by page arithmetic over the PRE-step pages; donating them "
+        "destroys the state a rejection falls back to")
+    progs.append(Program(
+        "serve.step_spec", "serve",
+        lambda: _build_serve_step_spec(),
+        forbid_donation=True,
+        forbid_donation_why=_SPEC_WHY))
+    progs.append(Program(
+        "serve.step_spec_paged", "serve",
+        lambda: _build_serve_step_spec(attention="paged"),
+        forbid_donation=True,
+        forbid_donation_why=_SPEC_WHY + (
+            " — the draft scan threads pages through its carry, so a "
+            "donated pool would alias every scan step's write")))
+    progs.append(Program(
+        "serve.step_spec_tp", "serve",
+        lambda: _build_serve_step_spec_tp(),
+        forbid_donation=True,
+        forbid_donation_why=_SPEC_WHY + (
+            " — TP edition: head-shards of the window's rows live on "
+            "every chip"),
         shardings=_serve_tp_shardings,
         logical_mesh=_serve_tp_logical_mesh))
 

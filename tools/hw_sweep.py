@@ -214,6 +214,21 @@ LANES = [
                      "--rate", "8", "--new-min", "16",
                      "--new-max", "256", "--mesh", "dp=1,tp=4",
                      "--ab-tp", "--require-finished"]),
+    # Speculative-decoding A/B (round-19 tentpole, serve_step_spec +
+    # serve/sampling.py): the IDENTICAL workload through one engine
+    # twice — plain decode, then with the layer-skip draft (half the
+    # stack, sharing embed/head and the target's own KV pages)
+    # proposing 4 tokens per slot per tick, verified in ONE
+    # rectangular-causal pass (q_offset=t, k_offset=0 — the chunked-
+    # prefill shape). The bench ABORTS unless every greedy stream is
+    # bit-identical across the sides; serve.ab_spec stamps k /
+    # accept_rate / tokens_per_step / spec_over_base. On real
+    # accelerators tokens_per_step > 1 converts directly to decode
+    # throughput; the CPU ratio is honest, not flattering.
+    ("serve_spec_ab", ["tools/serve_bench.py", "--requests", "64",
+                       "--rate", "8", "--new-min", "16",
+                       "--new-max", "256", "--speculate", "4",
+                       "--ab-spec", "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

@@ -175,7 +175,11 @@ def serve_cell(rec):
     throughput). TP-A/B records (--ab-tp) append "tp4 kv 0.25x" —
     the degree plus the sharded side's per-chip K/V bytes as a
     fraction of the single-chip bytes (heads shard exactly, so 1/tp
-    when the pin held). Non-serving records render as em-dash."""
+    when the pin held). Speculative records (--speculate/--ab-spec)
+    append "spec k4 acc .72 t/s 2.6x" — the window, accept rate and
+    tokens-per-tick (A/B records use the ab_spec stamp, plain
+    speculative runs the serve.spec block). Non-serving records
+    render as em-dash."""
     s = rec.get("serve")
     if not isinstance(s, dict):
         return "—"
@@ -201,6 +205,13 @@ def serve_cell(rec):
                         tp.get("kv_bytes_per_chip_single"))
         if chip and single:
             cell += f" kv {round(chip / single, 4):g}x"
+    sp = s.get("ab_spec") or s.get("spec") or {}
+    if sp.get("k"):
+        cell += f" spec k{sp['k']}"
+        if sp.get("accept_rate") is not None:
+            cell += f" acc {sp['accept_rate']:g}"
+        if sp.get("tokens_per_step") is not None:
+            cell += f" t/s {sp['tokens_per_step']:g}x"
     return cell
 
 

@@ -46,6 +46,26 @@ Modes:
               change) and the sharded side's ``kv_bytes_per_chip`` at
               most 1/tp of the single-chip bytes. Exclusive with the
               other A/Bs and --fleet
+  --speculate K
+              speculative decoding (``ServeConfig.speculate_k``): the
+              layer-skip draft (the target's first ``--draft-layers``
+              layers, 0 = auto = half) proposes up to K tokens per
+              slot per tick and the target verifies all K+1 positions
+              in one rectangular-causal pass; the record stamps
+              ``serve.spec{k, draft_layers, accept_rate,
+              tokens_per_step}``. Greedy streams stay bit-identical to
+              the non-speculative engine by construction. Composes
+              with --mesh / --prefix / --attention / the batching
+              modes
+  --ab-spec   run the IDENTICAL workload with speculation OFF then ON
+              (``--speculate K`` sets the on-side window); ABORT
+              unless every greedy stream is bit-identical across the
+              sides; stamp both + ``serve.ab_spec{k, accept_rate,
+              tokens_per_step, spec_over_base}``. Exclusive with the
+              other A/Bs and --fleet (one A/B per record). The
+              wall-clock ratio is honest, not flattering, on CPU: the
+              draft scan is emulated serially, so the win the record
+              proves is tokens_per_step > 1, not CPU seconds
   --prefix    enable copy-on-write prefix caching
               (``ServeConfig.prefix_caching`` — the radix index in
               horovod_tpu/serve/prefix.py) for whatever mode runs;
@@ -420,6 +440,23 @@ def main() -> int:
                          "bytes; stamp serve.tp{degree, "
                          "kv_bytes_per_chip, tp_over_single} "
                          "(exclusive with the other A/Bs and --fleet)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="speculative-decoding window "
+                         "(ServeConfig.speculate_k): the layer-skip "
+                         "draft proposes up to K tokens per slot per "
+                         "tick, verified in one rectangular-causal "
+                         "pass (0 = off)")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="layers in the layer-skip draft (requires "
+                         "--speculate; 0 = auto: half the stack)")
+    ap.add_argument("--ab-spec", action="store_true",
+                    help="run the IDENTICAL workload with speculation "
+                         "OFF then ON (--speculate K sets the window); "
+                         "ABORT unless every greedy stream is "
+                         "bit-identical across the sides; stamp both "
+                         "sides + serve.ab_spec{k, accept_rate, "
+                         "tokens_per_step, spec_over_base} (exclusive "
+                         "with the other A/Bs and --fleet)")
     ap.add_argument("--prefix", action="store_true",
                     help="enable copy-on-write prefix caching "
                          "(ServeConfig.prefix_caching) for whatever "
@@ -540,6 +577,24 @@ def main() -> int:
         if not args.mesh:
             ap.error("--ab-tp compares tp=1 against a sharded mesh — "
                      "it requires --mesh (e.g. --mesh dp=1,tp=4)")
+    if args.speculate < 0:
+        ap.error("--speculate must be >= 0 (0 = off)")
+    if args.draft_layers and not args.speculate:
+        ap.error("--draft-layers sizes the speculation draft — it "
+                 "requires --speculate K")
+    if args.ab_spec:
+        if args.ab or args.static or args.ab_attention or \
+                args.ab_prefix or args.ab_tp:
+            ap.error("--ab-spec is exclusive with --ab/--static/"
+                     "--ab-attention/--ab-prefix/--ab-tp (one A/B per "
+                     "record)")
+        if args.fleet:
+            ap.error("--ab-spec is exclusive with --fleet (one A/B "
+                     "per record; speculation composes with the fleet "
+                     "via --speculate)")
+        if args.speculate < 1:
+            ap.error("--ab-spec compares speculation off against on — "
+                     "it requires --speculate K with K >= 1")
     if args.mesh and args.fleet:
         ap.error("--mesh shards ONE engine across chips; the fleet "
                  "router sees each mesh as a single logical replica "
@@ -628,7 +683,9 @@ def main() -> int:
             slo=args.slo, admission=args.admission,
             attention=args.attention,
             prefix_caching=args.prefix,
-            mesh=args.mesh or None)
+            mesh=args.mesh or None,
+            speculate_k=args.speculate,
+            draft_layers=args.draft_layers)
     except ValueError as e:          # bad --mesh string: fail at argparse
         ap.error(str(e))
     if args.ab_tp and cfg.tp_degree < 2:
@@ -948,6 +1005,88 @@ def main() -> int:
             "exact_pin": {"compared": compared, "identical": True},
             "tp_over_single": ratio,
         })
+    elif args.ab_spec:
+        import dataclasses
+
+        def spec_lane(tag, lane_cfg):
+            eng = run_continuous(params, lane_cfg, workload)
+            stats = eng.stats()
+            sp = stats.get("spec")
+            print(f"[serve_bench] {tag}: "
+                  f"{stats['tokens_per_sec_per_chip']} tok/s/chip, "
+                  f"ttft p50/p99 {stats['ttft_ms']['p50']}/"
+                  f"{stats['ttft_ms']['p99']} ms, "
+                  f"{stats['by_state']}"
+                  + (f", spec k={sp['k']} dl={sp['draft_layers']} "
+                     f"accept_rate {sp['accept_rate']} "
+                     f"tokens_per_step {sp['tokens_per_step']}"
+                     if sp else ""),
+                  file=sys.stderr, flush=True)
+            if args.pin_exact:
+                pin_exact(params, eng)
+            if args.require_finished and \
+                    stats["by_state"].get("finished") != args.requests:
+                raise SystemExit(
+                    f"not all requests finished: {stats['by_state']}")
+            reqs = sorted(eng.finished + eng.evicted + eng.timed_out
+                          + eng.scheduler.rejected,
+                          key=lambda r: r.rid)
+            return stats, reqs
+
+        base, base_reqs = spec_lane(
+            "spec=off", dataclasses.replace(cfg, speculate_k=0,
+                                            draft_layers=0))
+        spec, spec_reqs = spec_lane(
+            f"spec=on [k={args.speculate}]", cfg)
+        # The exactness abort: every greedy stream must be
+        # bit-identical across the sides — the acceptance rule emits
+        # only target argmaxes of true prefixes, so speculation is a
+        # scheduling change, never a numerics change.
+        if len(base_reqs) != len(spec_reqs):
+            raise SystemExit(
+                f"SPEC AB PIN FAILED: {len(base_reqs)} requests on the "
+                f"base side vs {len(spec_reqs)} speculative")
+        compared = 0
+        for i, (rb, rs) in enumerate(zip(base_reqs, spec_reqs)):
+            if rb.temperature > 0 or rb.state != "finished" \
+                    or rs.state != "finished":
+                continue
+            if rb.output != rs.output:
+                raise SystemExit(
+                    f"SPEC AB PIN FAILED: request #{i} "
+                    f"base={rb.output} spec={rs.output}")
+            compared += 1
+        if not compared:
+            raise SystemExit("SPEC AB PIN FAILED: no greedy pairs "
+                             "finished on both sides — nothing "
+                             "compared")
+        sp = spec.get("spec") or {}
+        print(f"[serve_bench] spec pins: {compared} greedy streams "
+              f"bit-identical base vs speculative; accept_rate "
+              f"{sp.get('accept_rate')}, tokens_per_step "
+              f"{sp.get('tokens_per_step')}",
+              file=sys.stderr, flush=True)
+        base = dict(base)
+        base.setdefault("spec", None)    # explicit base-side stamp
+        ratio = None
+        if base["tokens_per_sec_per_chip"] and \
+                spec["tokens_per_sec_per_chip"]:
+            # Honest on CPU: the draft scan is emulated serially, so
+            # this is usually < 1 here — the record's proven win is
+            # tokens_per_step > 1 (fewer engine ticks per token), not
+            # emulated seconds.
+            ratio = round(spec["tokens_per_sec_per_chip"]
+                          / base["tokens_per_sec_per_chip"], 3)
+        mode, headline = "ab_spec", spec
+        serve = dict(spec, mode="ab_spec", ab_spec={
+            "base": base,
+            "k": args.speculate,
+            "draft_layers": sp.get("draft_layers"),
+            "accept_rate": sp.get("accept_rate"),
+            "tokens_per_step": sp.get("tokens_per_step"),
+            "exact_pin": {"compared": compared, "identical": True},
+            "spec_over_base": ratio,
+        })
     elif args.ab_attention:
         import dataclasses
 
@@ -1001,6 +1140,8 @@ def main() -> int:
             "prefix_caching": ("ab" if args.ab_prefix
                                else args.prefix),
             "mesh": args.mesh or None,
+            "speculate_k": ("ab" if args.ab_spec else args.speculate),
+            "draft_layers": args.draft_layers,
             "system_prompt_len": spl,
             "rate": args.rate,
             "requests": args.requests,
