@@ -199,6 +199,16 @@ def _prefill_lane(params: Dict, pages, pre, *, page_size: int, tp=None,
     return new_pages, pre_logits
 
 
+#: Public alias: under disaggregated serving (FleetConfig.pools) a
+#: prefill replica's steady-state tick IS the chunked-prefill lane —
+#: every request it admits carries prefill_only, so the decode slots
+#: never fill. hvdverify registers this as ``serve.step_prefill_pool``
+#: and machine-checks the no-donation invariant on it directly: the
+#: finished pages park in the handoff bay until the decode pool's
+#: import digest-verifies them, so they must stay readable.
+serve_step_prefill = _prefill_lane
+
+
 def serve_step(params: Dict, pages, dec, pre, *, page_size: int,
                attention: str = "gather", tp=None,
                vocab_parallel: bool = False):
@@ -658,6 +668,14 @@ class ServeEngine:
         self.slots: List[Optional[Request]] = [None] * config.decode_slots
         self.ready: List[Request] = []      # prefilled, awaiting a slot
         self.prefilling: Optional[Request] = None
+        #: Disaggregated-serving handoff bay: ``prefill_only`` requests
+        #: parked fully prefilled (first token emitted, pages held)
+        #: until the fleet ships their KV pages to a decode replica —
+        #: :meth:`export_handoff` / :meth:`release_handoff` on this
+        #: side, :meth:`admit_prefilled` on the receiving one. Parked
+        #: requests never decode here (the serve loop skips the bay),
+        #: but they count in_flight and their deadlines still sweep.
+        self.handoff: List[Request] = []
         self.finished: List[Request] = []
         self.evicted: List[Request] = []    # terminal (requeue off)
         self.timed_out: List[Request] = []  # terminal (deadline passed)
@@ -762,7 +780,8 @@ class ServeEngine:
     @property
     def in_flight(self) -> int:
         return (sum(1 for s in self.slots if s is not None)
-                + len(self.ready) + (1 if self.prefilling else 0))
+                + len(self.ready) + (1 if self.prefilling else 0)
+                + len(self.handoff))
 
     @property
     def idle(self) -> bool:
@@ -798,6 +817,7 @@ class ServeEngine:
             if s is req:
                 self.slots[i] = None
         self.ready = [r for r in self.ready if r is not req]
+        self.handoff = [r for r in self.handoff if r is not req]
         if self.prefilling is req:
             self.prefilling = None
 
@@ -818,7 +838,7 @@ class ServeEngine:
         stream can never hold KV pages past its deadline + one step."""
         now = self.clock()
         live = ([s for s in self.slots if s is not None]
-                + list(self.ready)
+                + list(self.ready) + list(self.handoff)
                 + ([self.prefilling] if self.prefilling else [])
                 + list(self.scheduler.queue))
         for req in live:
@@ -1137,7 +1157,16 @@ class ServeEngine:
                 self.prefilling = None
                 if req.state != RequestState.FINISHED:
                     req.state = RequestState.DECODE
-                    self.ready.append(req)
+                    if req.prefill_only:
+                        # Disaggregated handoff: park fully prefilled
+                        # (pages held, first token emitted) until the
+                        # fleet ships the KV pages to a decode
+                        # replica. A request that finished ON its
+                        # first token never reaches here — it needs no
+                        # decode pool.
+                        self.handoff.append(req)
+                    else:
+                        self.ready.append(req)
 
         self.occupancy_samples.append(self.cache.occupancy())
         self.steps += 1
@@ -1152,6 +1181,81 @@ class ServeEngine:
         req.token_times.append(now)
         if req.done_generating or req.hit_eos(self.config.eos_token):
             self._finish(req)
+
+    # ------------------------------------- disaggregated prefill/decode
+
+    def _handoff_req(self, rid: str) -> Request:
+        for r in self.handoff:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no parked handoff request {rid!r} — expired, "
+                       "already released, or never parked here")
+
+    def handoff_ready(self) -> List[str]:
+        """rids parked in the handoff bay (prefill finished, KV pages
+        ready to ship)."""
+        return [r.rid for r in self.handoff]
+
+    def export_handoff(self, rid: str) -> bytes:
+        """The parked request's finished KV pages as one deterministic
+        blob (:meth:`PagedKVCache.export_pages
+        <horovod_tpu.serve.kvcache.PagedKVCache.export_pages>` over the
+        page-table prefix covering the prompt). READ-ONLY and
+        repeatable — a torn transfer re-exports identical bytes, which
+        is what makes the chunk stream's resume-from-offset sound."""
+        req = self._handoff_req(rid)
+        n_exp = self.cache.pages_needed(req.prompt_len, 1)
+        pages = [int(req.page_table[j]) for j in range(n_exp)]
+        return self.cache.export_pages(pages, req.prompt_len)
+
+    def release_handoff(self, rid: str) -> Request:
+        """Drop the prefill side's hold once the decode replica has
+        COMMITTED the import: pages release through the refcounted path
+        (prefix-shared pages stay alive under the index) and the
+        request leaves every service structure WITHOUT a terminal
+        event — ownership moved, the stream did not end. Returns the
+        request (the inproc fleet re-uses the very same object on the
+        decode side)."""
+        req = self._handoff_req(rid)
+        self.scheduler.release(req)
+        self.handoff = [r for r in self.handoff if r is not req]
+        return req
+
+    def admit_prefilled(self, req: Request, blob: bytes) -> None:
+        """Decode-side handoff admission: import the shipped KV pages
+        into THIS cache's allocator, grant the remainder of the
+        request's worst-case budget (reserve discipline — admitted
+        means it can run to completion), map the page table, and queue
+        the request at its handoff position (``ready``, state DECODE —
+        the next step promotes it into a slot and decodes token 2
+        onward; token 1 was emitted prefill-side). All-or-nothing:
+        :class:`~horovod_tpu.serve.kvcache.OutOfPages` or a typed
+        geometry :class:`~horovod_tpu.serve.transport.FrameError`
+        leaves this engine unchanged, and the caller's handoff stays
+        parked on the prefill side (retry or redispatch — never a
+        half-admitted request)."""
+        from horovod_tpu.serve.transport import FrameError
+
+        imported, positions = self.cache.import_pages(blob)
+        try:
+            if positions != req.prompt_len:
+                raise FrameError(
+                    f"handoff blob covers {positions} positions, "
+                    f"request prompt is {req.prompt_len} — wrong blob "
+                    "for this request")
+            total = self.cache.pages_needed(req.prompt_len,
+                                            req.max_new_tokens)
+            extra = self.cache.allocator.alloc(total - len(imported))
+        except BaseException:
+            self.cache.allocator.release(imported)
+            raise
+        req.pages = list(imported) + list(extra)
+        req.page_table = np.zeros(self.cache.pages_per_seq, np.int32)
+        req.page_table[:total] = np.asarray(req.pages, np.int32)
+        req.prefill_pos = req.prompt_len
+        req.state = RequestState.DECODE
+        req.t_admit = self.clock()
+        self.ready.append(req)
 
     def update_params(self, params: Dict) -> None:
         """Swap the model weights in place — the fleet's rolling-update
@@ -1226,7 +1330,7 @@ class ServeEngine:
         from horovod_tpu.serve.metrics import summarize
 
         everything = (self.finished + self.evicted + self.timed_out
-                      + self.ready
+                      + self.ready + self.handoff
                       + [s for s in self.slots if s is not None]
                       + ([self.prefilling] if self.prefilling else [])
                       + self.scheduler.queue + self.scheduler.rejected)

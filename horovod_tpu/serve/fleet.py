@@ -161,6 +161,10 @@ class Replica:
     liveness = "file"
     #: Host failure-domain index (tcp placement only).
     host: Optional[int] = None
+    #: Disaggregated pool ("prefill"/"decode"; None = colocated).
+    #: Positional off FleetConfig.pools and IMMUTABLE for the fleet's
+    #: lifetime — a relaunched replica keeps its role.
+    role: Optional[str] = None
 
     def __init__(self, rid: int, engine, heartbeat: Optional[Heartbeat]):
         self.id = rid
@@ -413,6 +417,9 @@ class _EngineProxy:
         #: rid -> worker-output tokens already applied to the mirror.
         self._streamed: Dict[int, int] = {}
         self._by_rid: Dict[int, Request] = {}
+        #: Router rids parked in the worker's handoff bay (last step
+        #: RPC's snapshot; always empty outside disaggregated pools).
+        self.handoff_rids: List[int] = []
         #: Last step RPC's prefix-cache snapshot (None: caching off,
         #: or a worker — e.g. the protocol stub — that never stamps
         #: it; every consumer tolerates the absence).
@@ -439,6 +446,7 @@ class _EngineProxy:
             "seed": int(req.seed),
             "age": max(0.0, now - req.arrival),
             "ttl": req.ttl,
+            "prefill_only": bool(getattr(req, "prefill_only", False)),
         })
         if r.get("accepted"):
             self._streamed[req.rid] = 0
@@ -471,6 +479,7 @@ class _EngineProxy:
             self.last_hb = int(s["hb"])
         if s.get("prefix") is not None:
             self.last_prefix = s["prefix"]
+        self.handoff_rids = [int(x) for x in s.get("handoff") or ()]
         stepped = int(s["ticks"]) > self._last_ticks
         self._last_ticks = int(s["ticks"])
         if not self._by_rid:
@@ -702,7 +711,9 @@ class ServeFleet:
         self.replicas: List[Replica] = []
         try:
             for i in range(self.fleet.replicas):
-                self.replicas.append(self._spawn(i))
+                rep = self._spawn(i)
+                rep.role = self.fleet.pool_of(i)
+                self.replicas.append(rep)
         except BaseException:
             # A failed spawn mid-constructor must not orphan the
             # replicas (real OS processes!) already running — close()
@@ -715,6 +726,15 @@ class ServeFleet:
             if self._workdir:
                 shutil.rmtree(self._workdir, ignore_errors=True)
             raise
+
+        # Disaggregated prefill/decode: the KV-handoff coordinator
+        # (serve/disagg.py) runs once per tick after every replica
+        # stepped. None = colocated, zero new code paths.
+        self.disagg = None
+        if self.fleet.pools is not None:
+            from horovod_tpu.serve.disagg import DisaggCoordinator
+
+            self.disagg = DisaggCoordinator(self)
 
     def close(self) -> None:
         """Tear the fleet down and release its host-side footprint.
@@ -1693,10 +1713,16 @@ class ServeFleet:
         return prefix_route_key(req.prompt, self.config.page_size)
 
     def _dispatch(self) -> None:
+        # Disaggregated pools: every admission (fresh or requeued — a
+        # rebased request needs its folded prompt re-prefilled) goes
+        # to the PREFILL pool only; decode-pool slots are never
+        # consumed by admission, and the decode side receives work
+        # exclusively through the KV handoff (serve/disagg.py).
+        pool = self.replicas if self.disagg is None else \
+            self.disagg.prefill_pool()
         while self.queue:
             req = self.queue[0]
-            rep = pick_replica(self.replicas, req,
-                               self._route_key(req))
+            rep = pick_replica(pool, req, self._route_key(req))
             if rep is None:
                 if self._version_stranded(req):
                     # The explicit cross-version policy: the stream
@@ -1715,6 +1741,10 @@ class ServeFleet:
                     continue
                 break   # head waits; order (and requeue priority) holds
             self.queue.pop(0)
+            # Stamped per DISPATCH, not per request: the same request
+            # redispatched after a decode-side death prefills again on
+            # the prefill pool; colocated fleets always stamp False.
+            req.prefill_only = self.disagg is not None
             try:
                 accepted = rep.engine.scheduler.submit(req)
             except TransportError as e:
@@ -1862,6 +1892,12 @@ class ServeFleet:
             ticked.append(rep)
             self._collect(rep)
             occ.append(rep.engine.cache.occupancy())
+        if self.disagg is not None:
+            # KV handoffs AFTER every replica stepped (the handoff
+            # snapshots are this tick's truth): a completed transfer
+            # is fleet progress even when no engine generated.
+            if self.disagg.step(now):
+                progressed = True
         # Heartbeats stamp at the END of the tick, together: replicas
         # step sequentially in-process, so stamping each inside the
         # loop would let one slow step (a fresh replica's compile) age
@@ -1928,6 +1964,8 @@ class ServeFleet:
                            "retries": 0, "ms": 0.0}
         self.transfer_incidents = {}
         self.version_recomputed = 0
+        if self.disagg is not None:
+            self.disagg.reset_metrics()
         for rep in self.replicas:
             if rep.healthy and rep.engine is not None:
                 try:
@@ -2036,9 +2074,11 @@ class ServeFleet:
             "restarts_used": self.restarts_used,
             "max_restarts": self.fleet.max_restarts,
             "detect_s": round(max(detect), 4) if detect else None,
+            "disagg": self.disagg.stats()
+            if self.disagg is not None else None,
             "per_replica": [
                 dict(replica_load(r), id=r.id, state=r.state,
-                     steps=r.steps, restarts=r.restarts,
+                     role=r.role, steps=r.steps, restarts=r.restarts,
                      version=r.version, params_sha=r.params_sha)
                 for r in self.replicas],
         }

@@ -35,13 +35,21 @@ donates.
 
 from __future__ import annotations
 
+import json
 import math
-from typing import Dict, List, Sequence
+import struct
+from typing import Dict, List, Sequence, Tuple
 
 
 class OutOfPages(Exception):
     """Raised by :meth:`PageAllocator.alloc` when the free list cannot
     satisfy the request (all-or-nothing; nothing was allocated)."""
+
+
+#: KV-page blob container magic ("HoroVod KV pages") — the payload the
+#: disaggregated prefill→decode handoff chunk-streams (serve/kv_wire).
+KV_BLOB_MAGIC = b"HVKV"
+_KV_BLOB_HEADER = struct.Struct(">4sI")   # magic, header-JSON length
 
 
 #: Physical pages never handed out: page 0, the reserved null sink
@@ -342,6 +350,132 @@ class PagedKVCache:
             raise
         self.allocator.release([page])
         return new
+
+    # --------------------------------------------- export / import (kv)
+
+    def export_pages(self, pages: Sequence[int],
+                     num_positions: int) -> bytes:
+        """Serialize the finished KV pages covering logical positions
+        ``0..num_positions-1`` into ONE deterministic byte blob — the
+        payload the disaggregated prefill→decode handoff chunk-streams
+        (``serve/kv_wire``). ``pages`` is the request's physical page
+        list in LOGICAL order (its page-table prefix); the tiles ship
+        as per-layer, per-page ``[page_size, H, D]`` K then V arrays in
+        the full logical head layout, so the head-sharded placement
+        under tp is an import-side property (the importer re-places
+        tiles under its OWN ``kv_sharding``) — exporter and importer
+        need not agree on tp degree, only on geometry.
+
+        READ-ONLY: refcounts are untouched, so COW/prefix-shared pages
+        export safely under any sharing (the blob is a copy, like any
+        other reader of a shared page)."""
+        import numpy as np
+
+        from horovod_tpu.serve.transport import FrameError
+
+        ps = self.config.page_size
+        need = pages_needed(num_positions, 1, ps) \
+            if num_positions >= 1 else 0
+        if num_positions < 1 or len(pages) != need:
+            raise FrameError(
+                f"export of {len(pages)} pages for {num_positions} "
+                f"positions — geometry says {need} pages of "
+                f"{ps} positions each")
+        header = json.dumps({
+            "layers": self.num_layers,
+            "page_size": ps,
+            "heads": self.num_heads,
+            "head_dim": self.head_dim,
+            "dtype": self.dtype.name,
+            "pages": len(pages),
+            "positions": int(num_positions),
+        }).encode("utf-8")
+        parts = [_KV_BLOB_HEADER.pack(KV_BLOB_MAGIC, len(header)), header]
+        idx = np.asarray(list(pages), dtype=np.int32)
+        for layer in self.pages:
+            for kv in ("k", "v"):
+                # One gather per layer per K/V: [n, page_size, H, D]
+                # tiles in logical page order (fetches the full head
+                # axis even when the live array is head-sharded).
+                parts.append(np.ascontiguousarray(
+                    np.asarray(layer[kv][idx])).tobytes())
+        return b"".join(parts)
+
+    def import_pages(self, blob: bytes) -> Tuple[List[int], int]:
+        """Inverse of :meth:`export_pages` against THIS cache's
+        allocator and page arrays: validates geometry (a blob from a
+        different model/page shape is a typed
+        :class:`~horovod_tpu.serve.transport.FrameError`, never a
+        silent reshape), allocates the pages all-or-nothing
+        (:class:`OutOfPages` with no state change when the pool lacks
+        room), scatters the tiles in, and returns
+        ``(granted_pages, num_positions)`` — the granted ids in logical
+        order, ready to prefix a page table. Under ``kv_sharding`` the
+        written arrays are re-placed so the tiles land head-sharded on
+        this replica's own mesh."""
+        import numpy as np
+
+        from horovod_tpu.serve.transport import FrameError
+
+        if len(blob) < _KV_BLOB_HEADER.size:
+            raise FrameError(
+                f"kv blob of {len(blob)} bytes is shorter than its "
+                "header — torn payload")
+        magic, hlen = _KV_BLOB_HEADER.unpack_from(blob)
+        if magic != KV_BLOB_MAGIC:
+            raise FrameError(
+                f"bad kv-blob magic {magic!r} — not a HVKV payload")
+        end = _KV_BLOB_HEADER.size + hlen
+        if len(blob) < end:
+            raise FrameError("kv blob torn inside its header")
+        try:
+            h = json.loads(blob[_KV_BLOB_HEADER.size:end].decode("utf-8"))
+            n, positions = int(h["pages"]), int(h["positions"])
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            raise FrameError(f"undecodable kv-blob header: {e!r}"
+                             ) from None
+        ps = self.config.page_size
+        want = {"layers": self.num_layers, "page_size": ps,
+                "heads": self.num_heads, "head_dim": self.head_dim,
+                "dtype": self.dtype.name}
+        got = {k: h.get(k) for k in want}
+        if got != want:
+            raise FrameError(
+                f"kv blob geometry {got} does not match this cache "
+                f"{want} — cross-model/cross-geometry import refused")
+        if positions < 1 or n != pages_needed(positions, 1, ps):
+            raise FrameError(
+                f"kv blob claims {n} pages for {positions} positions — "
+                "inconsistent page math")
+        tile = ps * self.num_heads * self.head_dim
+        dt = np.dtype(self.dtype)
+        total = end + self.num_layers * 2 * n * tile * dt.itemsize
+        if len(blob) != total:
+            raise FrameError(
+                f"kv blob is {len(blob)} bytes, geometry says {total} "
+                "— torn or padded payload")
+        grant = self.allocator.alloc(n)     # all-or-nothing; OutOfPages
+        import jax
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(grant, dtype=jnp.int32)
+        off = end
+        step = n * tile * dt.itemsize
+        try:
+            for layer in self.pages:
+                for kv in ("k", "v"):
+                    tiles = np.frombuffer(
+                        blob[off:off + step], dtype=dt).reshape(
+                            n, ps, self.num_heads, self.head_dim)
+                    off += step
+                    upd = layer[kv].at[idx].set(jnp.asarray(tiles))
+                    if self.kv_sharding is not None:
+                        upd = jax.device_put(upd, self.kv_sharding)
+                    layer[kv] = upd
+        except BaseException:
+            self.allocator.free(grant)
+            raise
+        return grant, positions
 
     # ------------------------------------------------------- page math
 

@@ -35,6 +35,15 @@ error, never a silently wrong model:
   place — a torn or corrupted transfer can never be loaded, partially
   or otherwise (the HVD012 discipline).
 
+The framing/CRC/resume implementation itself lives in
+:mod:`~horovod_tpu.serve.chunk_stream` — ONE spelling shared with the
+disaggregated-serving KV handoff (:mod:`~horovod_tpu.serve.kv_wire`).
+This module keeps its full pre-refactor surface (re-exported) and its
+manifests/chunks stay byte-identical to their PR-15 form, pinned in
+tests/test_chunk_stream.py; what remains here is the params-specific
+payload (the HVPW blob codec) and the file-backed, crash-safe
+assembler.
+
 Everything except the blob <-> params converters is stdlib-only, so
 the protocol-stub test worker (``python -S``, no site-packages) runs
 the identical assembly/verify path the real worker does.
@@ -42,23 +51,26 @@ the identical assembly/verify path the real worker does.
 
 from __future__ import annotations
 
-import base64
 import hashlib
 import json
 import os
 import struct
-import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.serve.chunk_stream import (
+    DEFAULT_CHUNK_BYTES,
+    check_chunk,
+    check_manifest as _check_manifest,
+    chunk_span as _chunk_span,
+    make_chunk,
+    make_manifest as _make_stream_manifest,
+    sha256_hex,
+)
 from horovod_tpu.serve.transport import ChecksumError, FrameError
 
 #: Blob container magic ("HoroVod Params Wire").
 BLOB_MAGIC = b"HVPW"
 _BLOB_HEADER = struct.Struct(">4sI")   # magic, header-JSON length
-
-#: Default transfer chunk size. Base64 expansion (x4/3) must keep a
-#: chunk frame well under transport.MAX_FRAME (16 MiB).
-DEFAULT_CHUNK_BYTES = 1 << 20
 
 _LEAF = "__leaf_{}__"
 
@@ -172,10 +184,6 @@ def params_from_blob(blob: bytes, as_jax: bool = True):
     return dec(header["spec"])
 
 
-def sha256_hex(blob: bytes) -> str:
-    return hashlib.sha256(blob).hexdigest()
-
-
 def blob_spec(blob: bytes) -> Dict:
     """The artifact's full structural fingerprint: the pytree spec
     (every key/nesting, leaf markers in order) plus the per-leaf
@@ -195,113 +203,18 @@ def make_manifest(blob: bytes, *, version: int,
     """The leading frame of every transfer: what the receiver must end
     up holding (version, whole-artifact sha256, sizes) plus the
     per-leaf specs (shape/dtype), so an operator can audit what a
-    version contains without ever loading it."""
-    if version < 1:
-        raise ValueError(f"artifact version must be >= 1, got {version}")
-    if chunk_bytes < 1:
-        raise ValueError(f"chunk_bytes must be >= 1, got {chunk_bytes}")
+    version contains without ever loading it. Shared framing under
+    :func:`chunk_stream.make_manifest
+    <horovod_tpu.serve.chunk_stream.make_manifest>` — the per-leaf
+    specs ride as the consumer ``extra``, keeping the manifest
+    byte-identical to its pre-refactor form."""
     header, _ = _blob_header(blob)
-    total = len(blob)
-    return {
-        "kind": "hvsf-params",
-        "version": int(version),
-        "sha256": sha256_hex(blob),
-        "total_bytes": total,
-        "chunk_bytes": int(chunk_bytes),
-        "num_chunks": max(1, -(-total // chunk_bytes)),
-        "leaves": header["leaves"],
-    }
-
-
-def _chunk_span(manifest: Dict, index: int) -> Tuple[int, int]:
-    cb = int(manifest["chunk_bytes"])
-    total = int(manifest["total_bytes"])
-    offset = index * cb
-    return offset, min(cb, total - offset)
-
-
-def make_chunk(blob: bytes, manifest: Dict, index: int) -> Dict:
-    """One bounded transfer chunk: offset + size + per-chunk crc32 +
-    base64 payload (the frame codec carries JSON)."""
-    if not 0 <= index < int(manifest["num_chunks"]):
-        raise FrameError(
-            f"chunk index {index} outside 0..{manifest['num_chunks'] - 1}")
-    offset, size = _chunk_span(manifest, index)
-    raw = blob[offset:offset + size]
-    return {
-        "version": int(manifest["version"]),
-        "index": int(index),
-        "offset": offset,
-        "size": size,
-        "crc32": zlib.crc32(raw),
-        "data": base64.b64encode(raw).decode("ascii"),
-    }
-
-
-def check_chunk(manifest: Dict, chunk: Dict) -> Tuple[int, bytes]:
-    """Validate one received chunk against the transfer's manifest;
-    returns ``(offset, raw_bytes)``. Every way the chunk can be wrong
-    is a TYPED error — a truncated payload, a mis-indexed or
-    version-mixed chunk is :class:`FrameError`; payload bytes that do
-    not match their own crc32 are :class:`ChecksumError` (the
-    bit-corruption shape the whole-artifact digest would also catch,
-    caught here per chunk so the sender retries one chunk, not the
-    artifact)."""
-    if not isinstance(chunk, dict):
-        raise FrameError(f"chunk is not a mapping: {type(chunk).__name__}")
-    try:
-        version = int(chunk["version"])
-        index = int(chunk["index"])
-        offset = int(chunk["offset"])
-        size = int(chunk["size"])
-        crc = int(chunk["crc32"])
-        data = chunk["data"]
-    except (KeyError, TypeError, ValueError) as e:
-        raise FrameError(f"malformed chunk: {e!r}") from None
-    if version != int(manifest["version"]):
-        raise FrameError(
-            f"chunk carries version {version}, transfer manifest says "
-            f"{manifest['version']} — version mix on the wire")
-    if not 0 <= index < int(manifest["num_chunks"]):
-        raise FrameError(
-            f"chunk index {index} outside 0..{manifest['num_chunks'] - 1}")
-    want_offset, want_size = _chunk_span(manifest, index)
-    if offset != want_offset or size != want_size:
-        raise FrameError(
-            f"chunk {index} claims offset/size {offset}/{size}, manifest "
-            f"geometry says {want_offset}/{want_size}")
-    try:
-        raw = base64.b64decode(data, validate=True)
-    except Exception as e:
-        raise FrameError(f"chunk {index}: undecodable payload: {e}"
-                         ) from None
-    if len(raw) != size:
-        raise FrameError(
-            f"chunk {index}: payload is {len(raw)} bytes, header says "
-            f"{size} — truncated or padded chunk")
-    if zlib.crc32(raw) != crc:
-        raise ChecksumError(
-            f"chunk {index}: crc32 mismatch on {size} payload bytes — "
-            "corrupted in flight or at the source")
-    return offset, raw
+    return _make_stream_manifest(
+        blob, kind="hvsf-params", version=version,
+        chunk_bytes=chunk_bytes, extra={"leaves": header["leaves"]})
 
 
 # ------------------------------------------------------------ assembler
-
-
-def _check_manifest(manifest: Dict) -> None:
-    try:
-        version = int(manifest["version"])
-        sha = manifest["sha256"]
-        total = int(manifest["total_bytes"])
-        cb = int(manifest["chunk_bytes"])
-        n = int(manifest["num_chunks"])
-    except (KeyError, TypeError, ValueError) as e:
-        raise FrameError(f"malformed transfer manifest: {e!r}") from None
-    if version < 1 or total < 0 or cb < 1 \
-            or n != max(1, -(-total // cb)) \
-            or not (isinstance(sha, str) and len(sha) == 64):
-        raise FrameError(f"inconsistent transfer manifest: {manifest!r}")
 
 
 class ArtifactAssembler:

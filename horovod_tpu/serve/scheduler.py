@@ -130,6 +130,14 @@ class Request:                     # tracked by `is` in slot lists
     #: actually recomputed.
     prefix_hits_at_drain: Optional[int] = None
 
+    #: disaggregated serving: this admission runs PREFILL ONLY — the
+    #: engine parks the request in its handoff bay at prefill
+    #: completion (first token emitted) instead of decoding it, and the
+    #: fleet ships the KV pages to a decode-pool replica. Stamped per
+    #: DISPATCH by the fleet (a redispatch to a colocated fleet or a
+    #: fresh prefill replica re-stamps it), False everywhere else.
+    prefill_only: bool = False
+
     state: str = RequestState.QUEUED
     #: prompt tokens already prefilled (chunk progress).
     prefill_pos: int = 0

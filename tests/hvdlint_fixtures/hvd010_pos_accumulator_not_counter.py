@@ -7,7 +7,7 @@ at full speed forever, so the rule must fire through them."""
 def read_forever(sock):
     data = b""
     total = 0
-    while True:
+    while True:  # EXPECT: HVD014 (chunk loop, no deadline/CRC either)
         chunk = sock.recv(4096)  # EXPECT: HVD011 (unbounded too)
         data += chunk
         n = len(chunk)

@@ -13,6 +13,6 @@ def read_frame(sock):
     header = sock.recv(8)  # EXPECT: HVD011
     (length,) = struct.unpack("<Q", header)
     payload = b""
-    while len(payload) < length:
+    while len(payload) < length:  # EXPECT: HVD014 (chunk loop, no CRC)
         payload += sock.recv(length - len(payload))  # EXPECT: HVD011
     return payload

@@ -1,0 +1,16 @@
+"""HVD014 negative: the chunk_stream discipline — every chunk carries
+its own crc32, so a torn or bit-flipped chunk is a typed error at the
+frame boundary, never a silent corruption. The digest identifier in
+scope silences the rule."""
+
+import struct
+import zlib
+
+
+def push_framed(sock, chunks):
+    running = 0
+    for c in chunks:
+        crc = zlib.crc32(c) & 0xFFFFFFFF
+        running = zlib.crc32(c, running) & 0xFFFFFFFF
+        sock.sendall(struct.pack("<II", len(c), crc) + c)
+    return running

@@ -1,0 +1,15 @@
+"""HVD014 negative: a reassembly loop under deadline discipline — the
+socket timeout bounds every chunk read, so a stalled peer becomes a
+typed timeout the caller's death path classifies, not a hang. The
+deadline in scope silences HVD014 (and HVD011)."""
+
+
+def pull_bounded(conn, total, timeout):
+    conn.settimeout(timeout)
+    buf = b""
+    while len(buf) < total:
+        chunk = conn.recv(65536)
+        if not chunk:
+            raise EOFError("peer closed mid-transfer")
+        buf += chunk
+    return buf

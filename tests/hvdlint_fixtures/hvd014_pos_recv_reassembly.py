@@ -1,0 +1,14 @@
+"""HVD014 positive: KV-page reassembly loop pulling chunks off a
+connection with neither discipline in scope. The unbounded recv also
+fires HVD011 (same hang, per-call shape) — both anchor lines are
+marked."""
+
+
+def pull_pages(conn, total):
+    buf = b""
+    while len(buf) < total:  # EXPECT: HVD014
+        chunk = conn.recv(65536)  # EXPECT: HVD011
+        if not chunk:
+            raise EOFError("peer closed mid-transfer")
+        buf += chunk
+    return buf

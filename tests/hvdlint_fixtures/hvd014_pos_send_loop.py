@@ -1,0 +1,9 @@
+"""HVD014 positive: a weights push that pumps raw chunks over a socket
+with no per-chunk CRC and no deadline discipline anywhere in scope — a
+stalled peer hangs the loop forever, and nothing downstream can tell a
+torn stream from a finished one."""
+
+
+def push_params(sock, blob, chunk_bytes):
+    for off in range(0, len(blob), chunk_bytes):  # EXPECT: HVD014
+        sock.sendall(blob[off:off + chunk_bytes])

@@ -96,6 +96,9 @@ def _load_serve_module(name):
     if name != "transport" \
             and "horovod_tpu.serve.transport" not in sys.modules:
         _load_serve_module("transport")
+    if name not in ("transport", "chunk_stream") \
+            and "horovod_tpu.serve.chunk_stream" not in sys.modules:
+        _load_serve_module("chunk_stream")
     spec = importlib.util.spec_from_file_location(
         full, os.path.join(serve_dir, f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)

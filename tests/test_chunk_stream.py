@@ -1,0 +1,219 @@
+"""chunk_stream: the shared framing/CRC/resume layer + the refactor's
+byte-identity pins.
+
+PR 15's weight-roll records digest the JSON bytes of manifests and
+chunks, so the factoring of params_wire's framing into chunk_stream
+must leave the params consumer's wire forms BYTE-IDENTICAL — pinned
+here against a frozen inline replica of the pre-refactor framing code.
+The rest covers the generic layer the KV handoff consumes: kind
+pinning, the in-memory BufferAssembler (contiguity, resume-from-offset
+with partial-trailing-chunk truncation, digest-verified commit)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import horovod_tpu.serve.chunk_stream as cs
+import horovod_tpu.serve.params_wire as pw
+from horovod_tpu.serve.transport import ChecksumError, FrameError
+
+
+def _params():
+    r = np.random.RandomState(7)
+    return {
+        "emb": r.randn(17, 8).astype(np.float32),
+        "layers": [{"w": r.randn(8, 8).astype(np.float32)}],
+    }
+
+
+def _blob():
+    return pw.params_to_blob(_params())
+
+
+# -------------------------------------------------- PR-15 byte identity
+
+
+def _pre_refactor_manifest(blob, *, version, chunk_bytes):
+    """Frozen inline replica of params_wire.make_manifest as shipped in
+    PR 15 — the reference the refactored path must match byte-for-byte
+    (key order included: the weight-roll records digest these JSON
+    bytes)."""
+    import hashlib
+    header = pw.blob_spec(blob)
+    total = len(blob)
+    return {
+        "kind": "hvsf-params",
+        "version": int(version),
+        "sha256": hashlib.sha256(blob).hexdigest(),
+        "total_bytes": total,
+        "chunk_bytes": int(chunk_bytes),
+        "num_chunks": max(1, -(-total // chunk_bytes)),
+        "leaves": header["leaves"],
+    }
+
+
+def _pre_refactor_chunk(blob, manifest, index):
+    """Frozen inline replica of params_wire.make_chunk as shipped in
+    PR 15."""
+    import base64
+    import zlib
+    cb = int(manifest["chunk_bytes"])
+    offset = index * cb
+    size = min(cb, int(manifest["total_bytes"]) - offset)
+    raw = blob[offset:offset + size]
+    return {
+        "version": int(manifest["version"]),
+        "index": int(index),
+        "offset": offset,
+        "size": size,
+        "crc32": zlib.crc32(raw),
+        "data": base64.b64encode(raw).decode("ascii"),
+    }
+
+
+def test_params_manifest_bytes_identical_to_pr15():
+    blob = _blob()
+    for cb in (64, 1 << 10, pw.DEFAULT_CHUNK_BYTES):
+        got = pw.make_manifest(blob, version=3, chunk_bytes=cb)
+        want = _pre_refactor_manifest(blob, version=3, chunk_bytes=cb)
+        assert json.dumps(got) == json.dumps(want)   # bytes, order included
+        assert list(got.keys()) == ["kind", "version", "sha256",
+                                    "total_bytes", "chunk_bytes",
+                                    "num_chunks", "leaves"]
+
+
+def test_params_chunks_bytes_identical_to_pr15():
+    blob = _blob()
+    m = pw.make_manifest(blob, version=2, chunk_bytes=100)
+    for i in range(m["num_chunks"]):
+        got = pw.make_chunk(blob, m, i)
+        want = _pre_refactor_chunk(blob, m, i)
+        assert json.dumps(got) == json.dumps(want)
+        assert list(got.keys()) == ["version", "index", "offset", "size",
+                                    "crc32", "data"]
+
+
+def test_params_wire_reexports_shared_framing():
+    # One implementation, two consumers: the params surface IS the
+    # shared one (identity, not a parallel copy that could drift).
+    assert pw.make_chunk is cs.make_chunk
+    assert pw.check_chunk is cs.check_chunk
+    assert pw.sha256_hex is cs.sha256_hex
+    assert pw.DEFAULT_CHUNK_BYTES == cs.DEFAULT_CHUNK_BYTES
+
+
+def test_generic_manifest_matches_params_manifest():
+    blob = _blob()
+    via_pw = pw.make_manifest(blob, version=5, chunk_bytes=256)
+    via_cs = cs.make_manifest(
+        blob, kind="hvsf-params", version=5, chunk_bytes=256,
+        extra={"leaves": pw.blob_spec(blob)["leaves"]})
+    assert json.dumps(via_pw) == json.dumps(via_cs)
+
+
+# ------------------------------------------------------- generic layer
+
+
+def test_kind_pinning():
+    blob = b"x" * 300
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=128)
+    cs.check_manifest(m, kind="hvsf-kv")
+    with pytest.raises(FrameError):
+        cs.check_manifest(m, kind="hvsf-params")
+    # No kind argument validates geometry only.
+    cs.check_manifest(m)
+
+
+def test_check_manifest_rejects_inconsistent_geometry():
+    blob = b"y" * 100
+    m = cs.make_manifest(blob, kind="k", version=1, chunk_bytes=30)
+    for key, val in (("num_chunks", 2), ("total_bytes", -1),
+                     ("chunk_bytes", 0), ("version", 0),
+                     ("sha256", "short")):
+        bad = dict(m, **{key: val})
+        with pytest.raises(FrameError):
+            cs.check_manifest(bad)
+    with pytest.raises(FrameError):
+        cs.check_manifest({"version": 1})
+
+
+def test_buffer_assembler_round_trip():
+    blob = bytes(range(256)) * 5
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=200)
+    asm = cs.BufferAssembler(kind="hvsf-kv")
+    assert asm.begin(m) == 0
+    for i in range(m["num_chunks"]):
+        asm.write_chunk(cs.make_chunk(blob, m, i))
+    out, sha = asm.commit()
+    assert out == blob and sha == m["sha256"]
+
+
+def test_buffer_assembler_kind_mismatch():
+    m = cs.make_manifest(b"z" * 10, kind="hvsf-params", version=1)
+    with pytest.raises(FrameError):
+        cs.BufferAssembler(kind="hvsf-kv").begin(m)
+
+
+def test_buffer_assembler_contiguity_and_resume():
+    blob = b"q" * 1000
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=300)
+    asm = cs.BufferAssembler(kind="hvsf-kv")
+    asm.begin(m)
+    asm.write_chunk(cs.make_chunk(blob, m, 0))
+    with pytest.raises(FrameError):           # skipping chunk 1
+        asm.write_chunk(cs.make_chunk(blob, m, 2))
+    # A re-begin with the SAME manifest resumes from the verified
+    # prefix instead of resending the blob.
+    assert asm.begin(m) == 300
+    for i in range(1, m["num_chunks"]):
+        asm.write_chunk(cs.make_chunk(blob, m, i))
+    out, _ = asm.commit()
+    assert out == blob
+    # A different payload starts clean.
+    blob2 = b"r" * 1000
+    m2 = cs.make_manifest(blob2, kind="hvsf-kv", version=2,
+                          chunk_bytes=300)
+    assert asm.begin(m2) == 0
+
+
+def test_buffer_assembler_truncates_partial_trailing_chunk():
+    blob = b"s" * 1000
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=300)
+    asm = cs.BufferAssembler(kind="hvsf-kv")
+    asm.begin(m)
+    asm.write_chunk(cs.make_chunk(blob, m, 0))
+    # Simulate a tear mid-write: a ragged tail past the last whole
+    # chunk must never be trusted on resume.
+    asm._buf.extend(b"\x00" * 17)
+    assert asm.begin(m) == 300
+    assert asm.have_bytes == 300
+
+
+def test_buffer_assembler_commit_verifies_digest():
+    blob = b"t" * 400
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=200)
+    corrupt = blob[:-1] + b"u"
+    asm = cs.BufferAssembler(kind="hvsf-kv")
+    asm.begin(m)
+    with pytest.raises(FrameError):           # incomplete commit
+        asm.commit()
+    asm.write_chunk(cs.make_chunk(blob, m, 0))
+    # Second chunk carries self-consistent bytes of the WRONG blob:
+    # per-chunk crc passes, the whole-blob digest must not.
+    asm.write_chunk(cs.make_chunk(corrupt, m, 1))
+    with pytest.raises(ChecksumError):
+        asm.commit()
+    assert asm.have_bytes == 0                # dropped, next try clean
+
+
+def test_buffer_assembler_abort():
+    blob = b"v" * 100
+    m = cs.make_manifest(blob, kind="hvsf-kv", version=1, chunk_bytes=50)
+    asm = cs.BufferAssembler(kind="hvsf-kv")
+    asm.begin(m)
+    asm.write_chunk(cs.make_chunk(blob, m, 0))
+    asm.abort()
+    assert asm.have_bytes == 0 and asm.manifest is None
+    with pytest.raises(FrameError):
+        asm.write_chunk(cs.make_chunk(blob, m, 1))

@@ -132,8 +132,15 @@ def test_registry_shape():
         "serve.step", "serve.step_paged",
         "serve.step_tp", "serve.step_tp_paged",
         "serve.step_spec", "serve.step_spec_paged",
-        "serve.step_spec_tp"}
+        "serve.step_spec_tp",
+        "serve.step_prefill_pool", "serve.step_decode_pool",
+        "serve.step_decode_pool_tp"}
     assert all(p.forbid_donation for p in serve)
+    # The disaggregated pool steps carry the handoff-sharpened
+    # rationale: across the transfer the pages are the only copy.
+    disagg = [p for p in serve if "pool" in p.name]
+    assert len(disagg) == 3
+    assert all("ONLY copy" in p.forbid_donation_why for p in disagg)
     # The speculative programs carry the sharpened donation rationale:
     # the pre-step pages are the rejected window's rollback substrate.
     spec = [p for p in serve if "spec" in p.name]
@@ -144,7 +151,7 @@ def test_registry_shape():
     # The TP variants carry the full HVV2xx surface (sharding table +
     # bound LogicalMesh), like the composed stacks.
     tp_serve = [p for p in serve if "_tp" in p.name]
-    assert len(tp_serve) == 3
+    assert len(tp_serve) == 4
     assert all(p.shardings is not None for p in tp_serve)
     assert all(p.logical_mesh is not None for p in tp_serve)
     assert all(p.reconcile is not None for p in by_group["optimizer"])
@@ -371,6 +378,108 @@ def test_serve_step_tp_verifies_and_donating_variant_is_flagged(
     flagged = verify(lambda p, pages, d, pr: donating(p, pages, d, pr),
                      args, name="serve-tp-donating",
                      forbid_donation=True, forbid_donation_why=_SERVE_WHY)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+
+
+def test_serve_disagg_pool_steps_verify_and_donating_variants_flagged(
+        hvd):
+    """The disaggregated pool programs (this PR): the prefill pool's
+    prefill-lane-only tick (serve_step_prefill) and the decode pool's
+    ``pre=None`` tick both verify clean under forbid_donation, and a
+    donate-the-pages variant of EACH is an HVV104 finding — across the
+    KV handoff the pages are the only copy of the request's history,
+    so donation on either side of the wire is the same bug."""
+    import functools
+
+    import jax
+
+    from tools.hvdverify.registry import (
+        _build_serve_step_decode_pool,
+        _build_serve_step_prefill_pool,
+    )
+
+    why = programs(names=["serve.step_prefill_pool"])[0] \
+        .forbid_donation_why
+    assert "ONLY copy" in why   # the handoff-sharpened rationale
+
+    # Prefill pool: the lane alone, pages parked for handoff.
+    fn, args = _build_serve_step_prefill_pool()
+    clean = verify(fn, args, name="serve.step_prefill_pool",
+                   forbid_donation=True, forbid_donation_why=why)
+    assert not clean.findings
+    assert clean.summary["count"] == 0   # tp=1: no collectives
+
+    from horovod_tpu.serve.engine import serve_step, serve_step_prefill
+
+    donating = jax.jit(
+        functools.partial(serve_step_prefill, page_size=8),
+        donate_argnums=(1,))    # donate the parked pages
+    flagged = verify(lambda p, pages, pr: donating(p, pages, pr),
+                     args, name="prefill-pool-donating",
+                     forbid_donation=True, forbid_donation_why=why)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+    assert "pages" in flagged.findings[0].message
+
+    # Decode pool: serve_step with pre=None, pages just imported.
+    fn, args = _build_serve_step_decode_pool()
+    clean = verify(fn, args, name="serve.step_decode_pool",
+                   forbid_donation=True, forbid_donation_why=why)
+    assert not clean.findings
+
+    step = functools.partial(serve_step, page_size=8)
+    donating = jax.jit(lambda p, pages, d: step(p, pages, d, None),
+                       donate_argnums=(1,))   # donate imported pages
+    flagged = verify(lambda p, pages, d: donating(p, pages, d),
+                     args, name="decode-pool-donating",
+                     forbid_donation=True, forbid_donation_why=why)
+    assert "HVV104" in [f.rule for f in flagged.findings]
+
+
+def test_serve_step_decode_pool_tp_verifies_and_donating_is_flagged(
+        hvd):
+    """The TP decode-pool tick: verifies clean under forbid_donation +
+    the HVV2xx surface with a NON-empty schedule (the TP reductions),
+    and donating the head-sharded imported pages is an HVV104
+    finding — a shard of an imported page on any chip is still the
+    request's only copy of that slice of its history."""
+    import functools
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tools.hvdverify.registry import (
+        _build_serve_step_decode_pool_tp,
+        _logical_mesh,
+        _serve_tp_logical_mesh,
+        _serve_tp_shardings,
+        _shmapped,
+    )
+
+    fn, args = _build_serve_step_decode_pool_tp()
+    clean = verify(fn, args, name="serve.step_decode_pool_tp",
+                   forbid_donation=True,
+                   shardings=_serve_tp_shardings(),
+                   logical_mesh=_serve_tp_logical_mesh())
+    assert not clean.findings
+    assert clean.summary["count"] > 0
+
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve.engine import serve_step
+
+    lm = _logical_mesh("dp=1,tp=4")
+    tp_ax = lm.role_axis("tensor")
+    kv = P(None, None, tp_ax, None)
+    specs = lm_param_specs(2, tp_ax, vocab_parallel=True)
+    step = functools.partial(serve_step, page_size=8, tp=tp_ax,
+                             vocab_parallel=True)
+    donating = jax.jit(
+        _shmapped(lambda p, pages, d: step(p, pages, d, None)[:2],
+                  lm.mesh, in_specs=(specs, kv, P()),
+                  out_specs=(kv, P())),
+        donate_argnums=(1,))    # donate the (sharded) imported pages
+    flagged = verify(lambda p, pages, d: donating(p, pages, d),
+                     args, name="decode-pool-tp-donating",
+                     forbid_donation=True)
     assert "HVV104" in [f.rule for f in flagged.findings]
 
 

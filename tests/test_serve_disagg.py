@@ -1,0 +1,260 @@
+"""Disaggregated prefill/decode serving (horovod_tpu/serve/disagg.py).
+
+The acceptance pins:
+
+* ``FleetConfig(pools={"prefill": P, "decode": D})`` validates
+  fail-fast (exact key set, int >= 1 per pool, P + D == replicas) and
+  normalizes to a hashable fixed-order tuple; ``prefill_replicas`` /
+  ``pool_of`` expose the positional id → pool mapping;
+* a 1-prefill + 1-decode fleet streams BIT-IDENTICAL greedy (and
+  same-seed sampled) tokens to the colocated fleet and ``lm_decode``
+  — the KV handoff is invisible in the output;
+* both transfer-failure sides take their documented recovery path via
+  the coordinator's one-shot ``fault_next_transfer`` hook (the same
+  code path a ``partition:`` netfault exercises): a PREFILL-side tear
+  drains/rebases/requeues at-most-once, a DECODE-side tear leaves the
+  request parked prefill-side for a bit-identical re-export and never
+  requeues it;
+* the pools are scheduled independently — every admission lands on
+  the prefill pool, every request finishes on a decode replica, and
+  each crosses the wire exactly once.
+
+Everything runs inproc on an injectable fake clock; the wire edition
+of the same pins lives in tools/check.sh's disagg smoke (TCP fleet +
+host partition) and serve_bench --ab-disagg.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import parallel_lm as plm
+from horovod_tpu.serve import FleetConfig, ServeConfig, ServeFleet
+
+V, LMAX, LAYERS, H, DH, FFN = 64, 64, 2, 2, 8, 32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX, LAYERS, H,
+                              DH, FFN)
+
+
+def _prompt(i, lp):
+    key = jax.random.fold_in(jax.random.PRNGKey(300), i)
+    return np.asarray(jax.random.randint(key, (lp,), 0, V), np.int32)
+
+
+def _ref(params, prompt, steps):
+    return list(np.asarray(
+        plm.lm_decode(params, jnp.asarray(prompt)[None], steps))[0])
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _cfg(**kw):
+    base = dict(page_size=8, num_pages=32, decode_slots=2,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _fleet(params, clk, *, pools=None, **fleet_kw):
+    fleet_kw.setdefault("replicas", 2)
+    fleet_kw.setdefault("backoff_base", 0.01)
+    fleet_kw.setdefault("max_restarts", 2)
+    fcfg = FleetConfig(pools=pools, **fleet_kw)
+    return ServeFleet(params, _cfg(), fcfg, clock=clk, sleep=clk.sleep)
+
+
+def _run(fl, clk, spec, *, temps=None, base=0):
+    reqs = [fl.submit(_prompt(base + i, lp), n,
+                      temperature=(temps[i] if temps else 0.0),
+                      seed=23 + i)
+            for i, (lp, n) in enumerate(spec)]
+    while not fl.idle:
+        fl.step()
+        clk.t += 0.001
+    return reqs
+
+
+# --------------------------------------------------- config validation
+
+
+class TestPoolsConfig:
+    def test_valid_pools_normalize_and_expose_the_mapping(self):
+        cfg = FleetConfig(replicas=3,
+                          pools={"prefill": 1, "decode": 2})
+        # normalized to a fixed-order tuple of pairs: hashable, and
+        # the prefill count is always pools[0][1]
+        assert cfg.pools == (("prefill", 1), ("decode", 2))
+        hash(cfg)
+        assert cfg.prefill_replicas == 1
+        assert cfg.pool_of(0) == "prefill"
+        assert cfg.pool_of(1) == "decode"
+        assert cfg.pool_of(2) == "decode"
+
+    def test_tuple_of_pairs_input_accepted(self):
+        cfg = FleetConfig(replicas=2,
+                          pools=(("decode", 1), ("prefill", 1)))
+        assert cfg.pools == (("prefill", 1), ("decode", 1))
+
+    def test_colocated_default_has_no_pools(self):
+        cfg = FleetConfig(replicas=2)
+        assert cfg.pools is None
+        assert cfg.prefill_replicas == 0
+        assert cfg.pool_of(0) is None and cfg.pool_of(1) is None
+
+    @pytest.mark.parametrize("pools,match", [
+        ({"prefill": 1, "verify": 1}, "exactly"),
+        ({"prefill": 2}, "exactly"),
+        ({"prefill": 0, "decode": 2}, "int >= 1"),
+        ({"prefill": 1, "decode": "one"}, "int >= 1"),
+        ({"prefill": 1, "decode": 1.0}, "int >= 1"),
+        ({"prefill": 2, "decode": 2}, "partition the fleet"),
+        ({"prefill": 1, "decode": 3}, "partition the fleet"),
+    ])
+    def test_bad_pools_fail_fast(self, pools, match):
+        with pytest.raises(ValueError, match=match):
+            FleetConfig(replicas=2, pools=pools)
+
+
+# --------------------------------------------------- exactness + stats
+
+
+class TestDisaggBitIdentity:
+    def test_streams_match_colocated_and_lm_decode(self, params):
+        spec = [(5, 8), (9, 6), (3, 10), (7, 7), (4, 9), (6, 5)]
+        temps = [0.0, 0.9, 0.0, 0.7, 0.0, 0.0]
+        outs = []
+        for pools in (None, {"prefill": 1, "decode": 1}):
+            clk = FakeClock()
+            fl = _fleet(params, clk, pools=pools)
+            reqs = _run(fl, clk, spec, temps=temps)
+            outs.append((reqs, fl))
+        (colo, _), (dis, fl) = outs
+        for i, (rc, rd) in enumerate(zip(colo, dis)):
+            assert rc.state == "finished" and rd.state == "finished"
+            # the handoff is invisible: disagg == colocated, and the
+            # greedy rows == lm_decode
+            assert rd.output == rc.output, i
+            if temps[i] == 0.0:
+                assert rc.output == _ref(params, _prompt(i, spec[i][0]),
+                                         spec[i][1])
+        st = fl.stats()
+        assert st["by_state"] == {"finished": len(spec)}
+        f = st["fleet"]
+        assert f["redispatched"] == 0 and f["incidents"] == []
+        roles = {c["id"]: c["role"] for c in f["per_replica"]}
+        assert roles == {0: "prefill", 1: "decode"}
+        assert all(c["steps"] > 0 for c in f["per_replica"])
+        d = f["disagg"]
+        assert d["pools"] == {"prefill": 1, "decode": 1}
+        assert d["transfers"] == len(spec)
+        assert d["kv_bytes_shipped"] > 0
+        assert d["chunks_shipped"] >= d["transfers"]
+        assert d["transfer_ms_p50"] is not None
+        assert d["transfer_ms_p99"] is not None
+        assert d["parked"] == 0 and d["failures"] == {}
+        # colocated fleets stamp no disagg block at all
+        assert outs[0][1].stats()["fleet"]["disagg"] is None
+
+    def test_pools_scheduled_independently(self, params):
+        """Every admission lands on the prefill pool, every request
+        finishes on a decode replica, each crosses exactly once."""
+        clk = FakeClock()
+        fl = _fleet(params, clk, pools={"prefill": 1, "decode": 1})
+        spec = [(5, 4), (8, 3), (4, 5), (6, 4), (3, 6)]
+        reqs = _run(fl, clk, spec, base=50)
+        for r in reqs:
+            assert r.state == "finished"
+            assert r.replica == 1          # finished decode-side
+            assert r.prefill_only is False  # cleared at the handoff
+            assert r.redispatches == 0
+        d = fl.stats()["fleet"]["disagg"]
+        assert d["transfers"] == len(spec)
+        # the prefill replica decoded nothing past the handoff token:
+        # its slots and handoff bay are empty once the fleet is idle
+        peng = fl.replicas[0].engine
+        assert all(s is None for s in peng.slots)
+        assert peng.handoff == []
+
+
+# ------------------------------------------------- transfer-tear faults
+
+
+class TestDisaggTransferFaults:
+    SPEC = [(5, 8), (9, 6), (3, 10), (7, 7)]
+
+    def _clean(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk, pools={"prefill": 1, "decode": 1})
+        return _run(fl, clk, self.SPEC, base=70)
+
+    def test_prefill_side_tear_redispatches_at_most_once(self, params):
+        clean = self._clean(params)
+        clk = FakeClock()
+        fl = _fleet(params, clk, pools={"prefill": 1, "decode": 1})
+        # one-shot: the NEXT transfer dies mid-chunk-loop on the
+        # prefill side — the exact shape a partition: netfault on the
+        # prefill host produces
+        fl.disagg.fault_next_transfer = "prefill"
+        faulted = _run(fl, clk, self.SPEC, base=70)
+        f = fl.stats()["fleet"]
+        d = f["disagg"]
+        assert d["failures"] == {"prefill": 1}
+        assert len(f["incidents"]) == 1
+        assert f["restarts_used"] == 1
+        # the parked request (and anything else assigned there) was
+        # drained, rebased, and requeued — at-most-once
+        assert f["redispatched"] >= 1
+        assert any(r.redispatches >= 1 for r in faulted)
+        # the relaunched replica kept its role (positional mapping)
+        assert fl.replicas[0].role == "prefill"
+        assert fl.replicas[1].role == "decode"
+        for i, (rc, rf) in enumerate(zip(clean, faulted)):
+            assert rf.state == "finished", (i, rf.state)
+            assert rf.output == rc.output, i
+
+    def test_decode_side_tear_keeps_request_parked(self, params):
+        clean = self._clean(params)
+        clk = FakeClock()
+        fl = _fleet(params, clk, pools={"prefill": 1, "decode": 1})
+        fl.disagg.fault_next_transfer = "decode"
+        faulted = _run(fl, clk, self.SPEC, base=70)
+        f = fl.stats()["fleet"]
+        d = f["disagg"]
+        assert d["failures"] == {"decode": 1}
+        assert len(f["incidents"]) == 1
+        assert f["restarts_used"] == 1
+        # the decode-side death NEVER requeues: the request stayed
+        # parked on the healthy prefill replica (pages held) and the
+        # re-export toward the relaunched replica is bit-identical
+        assert f["redispatched"] == 0
+        assert all(r.redispatches == 0 and not r.requeued
+                   for r in faulted)
+        # the torn transfer does not count; every request still
+        # crosses exactly once
+        assert d["transfers"] == len(self.SPEC)
+        for i, (rc, rf) in enumerate(zip(clean, faulted)):
+            assert rf.state == "finished", (i, rf.state)
+            assert rf.output == rc.output, i
+
+    def test_fault_hook_is_one_shot(self, params):
+        clk = FakeClock()
+        fl = _fleet(params, clk, pools={"prefill": 1, "decode": 1})
+        fl.disagg.fault_next_transfer = "decode"
+        _run(fl, clk, [(5, 4), (6, 3)], base=90)
+        assert fl.disagg.fault_next_transfer is None
+        assert fl.stats()["fleet"]["disagg"]["failures"] == \
+            {"decode": 1}

@@ -381,3 +381,234 @@ class TestAppendRows:
         np.testing.assert_array_equal(got, want)
         assert got[0].sum() == 0         # null page untouched
         assert got.sum() == 2.0          # nothing else written
+
+
+def _mk_cache(num_pages=9, kv_sharding=None):
+    import jax
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache
+
+    params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1, 2, 4, 8)
+    return PagedKVCache(params, ServeConfig(page_size=8,
+                                            num_pages=num_pages),
+                        kv_sharding=kv_sharding)
+
+
+def _fill(cache, pages, seed):
+    """Write deterministic per-page tiles so round-trip equality is a
+    real content check, not zeros == zeros."""
+    import jax
+    import jax.numpy as jnp
+
+    r = np.random.RandomState(seed)
+    for layer in cache.pages:
+        for kv in ("k", "v"):
+            for p in pages:
+                tile = r.randn(cache.config.page_size, cache.num_heads,
+                               cache.head_dim).astype(np.float32)
+                upd = layer[kv].at[p].set(jnp.asarray(tile))
+                if cache.kv_sharding is not None:
+                    upd = jax.device_put(upd, cache.kv_sharding)
+                layer[kv] = upd
+
+
+def _tiles(cache, pages):
+    return {(li, kv): np.asarray(layer[kv][np.asarray(list(pages))])
+            for li, layer in enumerate(cache.pages) for kv in ("k", "v")}
+
+
+class TestExportImport:
+    """kvcache.export_pages/import_pages: the KV handoff payload the
+    disaggregated prefill->decode transfer chunk-streams."""
+
+    def test_round_trip_bytes_identical(self):
+        src, dst = _mk_cache(), _mk_cache()
+        pages = src.allocator.alloc(3)
+        _fill(src, pages, seed=1)
+        blob = src.export_pages(pages, 20)       # ceil(20/8) = 3 pages
+        grant, positions = dst.import_pages(blob)
+        assert positions == 20 and len(grant) == 3
+        a, b = _tiles(src, pages), _tiles(dst, grant)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+        # export is read-only; import grants exactly n pages
+        assert src.allocator.in_use == 3
+        assert dst.allocator.in_use == 3
+        # deterministic payload (content-addressable for the digest)
+        assert src.export_pages(pages, 20) == blob
+
+    def test_export_is_read_only_under_sharing(self):
+        src = _mk_cache()
+        pages = src.allocator.alloc(2)
+        src.allocator.retain(pages)              # prefix-style share
+        before = {p: src.allocator.refcount(p) for p in pages}
+        src.export_pages(pages, 16)
+        assert {p: src.allocator.refcount(p) for p in pages} == before
+        src.allocator.release(pages)
+        src.allocator.release(pages)
+
+    def test_cow_shared_pages_round_trip(self):
+        """A table holding a COW'd copy plus a still-shared page
+        exports/imports like any other — sharing is a source-side
+        refcount property, invisible in the payload."""
+        src, dst = _mk_cache(), _mk_cache()
+        pages = src.allocator.alloc(2)
+        _fill(src, pages, seed=2)
+        src.allocator.retain(pages)              # second holder
+        new0 = src.cow_page(pages[0])            # writer's private copy
+        table = [new0, pages[1]]
+        blob = src.export_pages(table, 16)
+        grant, _ = dst.import_pages(blob)
+        a, b = _tiles(src, table), _tiles(dst, grant)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+        assert src.allocator.refcount(pages[0]) == 1   # other holder
+        assert src.allocator.refcount(pages[1]) == 2   # still shared
+        assert src.allocator.refcount(new0) == 1
+        dst.allocator.release(grant)
+        src.allocator.release([new0, pages[1]])
+        src.allocator.release(pages)
+        assert src.allocator.available == src.allocator.capacity
+        assert dst.allocator.available == dst.allocator.capacity
+
+    def test_property_churn_round_trip_conservation(self):
+        """Randomized trials under alloc/free churn on BOTH allocators:
+        every export->import lands bit-identical tiles, export never
+        mutates the source, and in_use + available == capacity holds on
+        both sides throughout; everything drains back to full."""
+        rng = random.Random(11)
+        src, dst = _mk_cache(num_pages=33), _mk_cache(num_pages=33)
+        # pre-churn so grants come off a shuffled free list
+        for cache in (src, dst):
+            live = []
+            for _ in range(60):
+                if live and rng.random() < 0.5:
+                    cache.allocator.release(
+                        live.pop(rng.randrange(len(live))))
+                elif cache.allocator.available >= 4:
+                    live.append(cache.allocator.alloc(rng.randint(1, 4)))
+            for g in live:
+                cache.allocator.release(g)
+        for trial in range(6):
+            npos = rng.randint(1, 24 * 8)
+            npos = min(npos, 24 * 8)
+            n = src.pages_needed(npos, 1)
+            if n > min(src.allocator.available, dst.allocator.available):
+                continue
+            pages = src.allocator.alloc(n)
+            _fill(src, pages, seed=100 + trial)
+            shared = pages[:1] if rng.random() < 0.5 else []
+            if shared:
+                src.allocator.retain(shared)
+            before = (src.allocator.in_use, src.allocator.available)
+            blob = src.export_pages(pages, npos)
+            grant, got = dst.import_pages(blob)
+            assert got == npos and len(grant) == n
+            a, b = _tiles(src, pages), _tiles(dst, grant)
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+            assert (src.allocator.in_use, src.allocator.available) \
+                == before
+            for c in (src, dst):
+                assert c.allocator.in_use + c.allocator.available \
+                    == c.allocator.capacity
+            dst.allocator.release(grant)
+            if shared:
+                src.allocator.release(shared)
+            src.allocator.release(pages)
+        assert src.allocator.available == src.allocator.capacity
+        assert dst.allocator.available == dst.allocator.capacity
+
+    def test_tp_sharded_layout_survives(self):
+        """Head-sharded source -> unsharded and re-sharded importers:
+        tile bytes identical either way, and a sharded importer lands
+        the pages on its OWN mesh head-sharded (H/tp per chip)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.parallel.logical import LogicalMesh
+
+        lm = LogicalMesh.from_config("dp=1,tp=2",
+                                     devices=jax.devices()[:2])
+        ax = lm.role_axis("tensor")
+        sh = NamedSharding(lm.mesh, P(None, None, ax, None))
+        src = _mk_cache(kv_sharding=sh)
+        pages = src.allocator.alloc(2)
+        _fill(src, pages, seed=4)
+        blob = src.export_pages(pages, 12)
+        flat, _ = _mk_cache().import_pages(blob)           # tp -> tp=1
+        resh = _mk_cache(kv_sharding=sh)
+        g2, _ = resh.import_pages(blob)                    # tp -> tp
+        a = _tiles(src, pages)
+        for c, grant in ((resh, g2),):
+            b = _tiles(c, grant)
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+        arr = resh.pages[0]["k"]
+        shard = arr.addressable_shards[0].data
+        assert shard.shape[2] == src.num_heads // 2        # H/tp
+        # unsharded importer got the same bytes too
+        m = _mk_cache()
+        g3, _ = m.import_pages(blob)
+        for key, want in a.items():
+            np.testing.assert_array_equal(
+                want, _tiles(m, g3)[key])
+
+    def test_geometry_mismatch_refused(self):
+        from horovod_tpu.serve.transport import FrameError
+
+        src = _mk_cache()
+        pages = src.allocator.alloc(1)
+        blob = src.export_pages(pages, 8)
+        import jax
+
+        from horovod_tpu.models import parallel_lm as plm
+        from horovod_tpu.serve import PagedKVCache
+
+        params = plm.init_lm_params(jax.random.PRNGKey(0), 32, 32, 1, 4,
+                                    4, 16)                 # 4 heads
+        other = PagedKVCache(params,
+                             ServeConfig(page_size=8, num_pages=9))
+        with pytest.raises(FrameError, match="geometry"):
+            other.import_pages(blob)
+        assert other.allocator.in_use == 0                 # no grant
+
+    def test_torn_blob_refused(self):
+        from horovod_tpu.serve.transport import FrameError
+
+        src, dst = _mk_cache(), _mk_cache()
+        pages = src.allocator.alloc(1)
+        blob = src.export_pages(pages, 8)
+        for bad in (blob[:-1], blob + b"\x00", b"JUNK" + blob[4:],
+                    blob[:3]):
+            with pytest.raises(FrameError):
+                dst.import_pages(bad)
+        assert dst.allocator.in_use == 0
+
+    def test_import_out_of_pages_all_or_nothing(self):
+        from horovod_tpu.serve.kvcache import OutOfPages
+
+        src, dst = _mk_cache(), _mk_cache()
+        pages = src.allocator.alloc(3)
+        blob = src.export_pages(pages, 24)
+        held = dst.allocator.alloc(6)                      # 2 free < 3
+        snap = _tiles(dst, range(dst.config.num_pages))
+        with pytest.raises(OutOfPages):
+            dst.import_pages(blob)
+        assert dst.allocator.available == 2                # no change
+        after = _tiles(dst, range(dst.config.num_pages))
+        for key in snap:                                   # no write
+            np.testing.assert_array_equal(snap[key], after[key])
+        dst.allocator.release(held)
+
+    def test_export_page_math_validated(self):
+        from horovod_tpu.serve.transport import FrameError
+
+        src = _mk_cache()
+        pages = src.allocator.alloc(2)
+        with pytest.raises(FrameError):
+            src.export_pages(pages, 8)     # 8 positions need 1 page
+        with pytest.raises(FrameError):
+            src.export_pages(pages, 0)

@@ -108,6 +108,21 @@
 #                               sha256, zero requests drop or reject,
 #                               greedy streams stay bit-identical to
 #                               the clean run, zero leftover workers)
+#   tools/check.sh --no-disagg  skip the disaggregated-serving smoke
+#                               (round-20 tentpole: 1 prefill + 1
+#                               decode CPU replica behind the TCP
+#                               transport — every request prefills in
+#                               one pool, ships its KV pages over the
+#                               chunk-stream wire (per-chunk CRC +
+#                               sha256 digest-verify), and decodes in
+#                               the other; greedy streams must be
+#                               bit-identical to the colocated fleet
+#                               AND lm_decode, then a third lane
+#                               network-partitions the host 2 s
+#                               mid-run — transfers mid-flight tear,
+#                               drain + requeue at-most-once, and
+#                               every stream must stay
+#                               redispatch-pin-exact; no zombies)
 #   tools/check.sh --no-prefix  skip the prefix-caching smoke
 #   tools/check.sh --no-tp-serve  skip the TP-decode smoke (round-18
 #                               tentpole: the identical 8-request
@@ -137,6 +152,7 @@ FLEET=1
 FLEET_PROC=1
 FLEET_TCP=1
 FLEET_UPDATE=1
+DISAGG=1
 PREFIX=1
 TP_SERVE=1
 HIER=1
@@ -151,11 +167,12 @@ for arg in "$@"; do
     --no-fleet-proc) FLEET_PROC=0 ;;
     --no-fleet-tcp) FLEET_TCP=0 ;;
     --no-fleet-update) FLEET_UPDATE=0 ;;
+    --no-disagg) DISAGG=0 ;;
     --no-prefix) PREFIX=0 ;;
     --no-tp-serve) TP_SERVE=0 ;;
     --no-hier) HIER=0 ;;
     --verify) VERIFY=1 ;;
-    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-spec] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-prefix] [--no-tp-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
+    *) echo "usage: tools/check.sh [--sanitize] [--no-elastic] [--no-serve] [--no-spec] [--no-fleet] [--no-fleet-proc] [--no-fleet-tcp] [--no-fleet-update] [--no-disagg] [--no-prefix] [--no-tp-serve] [--no-hier] [--verify]" >&2; exit 2 ;;
   esac
 done
 
@@ -442,6 +459,57 @@ print("rolling-update smoke: torn push -> 1 classified transfer retry "
     exit 1
   fi
   echo "rolling-update smoke: zero surviving worker processes"
+fi
+
+if [[ "$DISAGG" == "1" ]]; then
+  echo "== disaggregated-serving smoke (1 prefill + 1 decode TCP replica, KV pages over the wire, host partitioned 2s mid-run: streams bit-identical colocated vs disagg vs faulted, no zombies) =="
+  PRE_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  DISAGG_OUT=$(JAX_PLATFORMS=cpu python tools/serve_bench.py \
+    --layers 2 --d-model 64 --heads 2 --vocab 128 \
+    --requests 8 --rate 200 --prompt-min 4 --prompt-max 12 \
+    --new-min 2 --new-max 6 --decode-slots 2 --prefill-chunk 4 \
+    --page-size 8 --pools 1,1 --ab-disagg --fleet-transport tcp \
+    --fleet-max-restarts 4 \
+    --fault-plan "partition:host=0,at=50%,secs=2" \
+    --pin-exact --require-finished)
+  echo "$DISAGG_OUT" | python -c '
+import json, sys
+rec = json.loads(sys.stdin.read().strip().splitlines()[-1])
+s = rec["serve"]
+assert s["mode"] == "ab_disagg", s["mode"]
+assert s["by_state"] == {"finished": 8}, s["by_state"]
+d = s["disagg"]
+assert d["pools"] == {"prefill": 1, "decode": 1}, d["pools"]
+# every request crossed the wire: prefilled in one pool, decoded in
+# the other, pages chunk-streamed with per-chunk CRC + sha256 verify
+assert d["transfers"] >= 8, d["transfers"]
+assert d["kv_bytes_shipped"] > 0, d
+assert d["transfer_ms_p50"] is not None and d["transfer_ms_p99"] is not None, d
+# bit-identity across the split (and vs lm_decode via --pin-exact)
+assert d["exact_pin"]["identical"] is True
+assert d["exact_pin"]["compared"] == 8, d["exact_pin"]
+assert d["disagg_over_colocated"] is not None, d
+# the faulted third lane: the partition darkened the KV channel
+# mid-run — drained, requeued at-most-once, still pin-exact
+rp = d["redispatch_pin"]
+assert rp["identical"] is True and rp["compared"] == 8, rp
+assert rp["incidents_by_class"].get("host_down") == 1, rp
+print("disagg smoke: %d KV transfer(s) %dB shipped (p50/p99 %s/%s ms), "
+      "8/8 streams bit-identical colocated vs disagg, partition -> "
+      "host_down x1 with %s redispatched, still pin-exact; "
+      "disagg/colocated p99 TTFT %s" % (
+          d["transfers"], d["kv_bytes_shipped"],
+          d["transfer_ms_p50"], d["transfer_ms_p99"],
+          rp["redispatched"], d["disagg_over_colocated"]))
+'
+  POST_WORKERS=$(pgrep -f "horovod_tpu.serve.worker" || true)
+  LEAKED=$(comm -13 <(echo "$PRE_WORKERS" | sort) <(echo "$POST_WORKERS" | sort) | tr -d '[:space:]')
+  if [[ -n "$LEAKED" ]]; then
+    echo "disagg smoke: ORPHANED worker processes survive:" >&2
+    pgrep -af "horovod_tpu.serve.worker" >&2
+    exit 1
+  fi
+  echo "disagg smoke: zero surviving worker processes"
 fi
 
 if [[ "$PREFIX" == "1" ]]; then

@@ -165,7 +165,7 @@ def main(argv: Sequence[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.hvdlint",
         description="Distributed-training static analysis "
-                    "(rules HVD001-HVD012; docs/static_analysis.md).")
+                    "(rules HVD001-HVD014; docs/static_analysis.md).")
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint")
     parser.add_argument("--select", default="",

@@ -1163,6 +1163,105 @@ def check_hvd013(tree: ast.AST) -> List[RawFinding]:
     return findings
 
 
+# ----------------------------------------------------------------- HVD014
+
+#: Socket chunk-transfer method names that ALWAYS mark a loop as a
+#: chunked wire transfer, whatever the receiver is called.
+CHUNK_SOCKET_CALL_NAMES = {"sendall", "sendto", "recvfrom", "recv_into"}
+
+#: Ambiguous spellings (generators have ``.send``, queues have
+#: ``.recv``): these only count when the receiver's name says
+#: socket/pipe/stream (the HVD011 marker vocabulary).
+CHUNK_AMBIGUOUS_CALL_NAMES = {"send", "recv"}
+
+
+def check_hvd014(tree: ast.AST) -> List[RawFinding]:
+    """Chunked socket send/recv loop with neither a per-chunk deadline
+    nor a CRC/digest check in scope — the torn-transfer shape.
+
+    A ``for``/``while`` loop that pumps chunks over a socket is the
+    repo's hottest wire surface (weights pushes, KV-page handoffs), and
+    it fails in two distinct ways the loop itself cannot see: a peer
+    that stalls mid-stream hangs an unbounded loop forever (the HVD011
+    hang, amplified — one chunk of thousands is enough), and a torn or
+    bit-flipped chunk assembles into a silently corrupt artifact the
+    importer admits as real weights/KV. The shipped discipline is
+    ``serve/chunk_stream.py`` (the canonical negative): every chunk is
+    framed with its own crc32, the assembled artifact is sha256-gated,
+    and both sides run under the transport's absolute-deadline recv.
+    Flagged: a loop whose body (nested defs excluded) calls a socket
+    chunk-transfer method — ``sendall``/``sendto``/``recvfrom``/
+    ``recv_into`` always; bare ``send``/``recv`` only on a receiver
+    whose name says socket/pipe (``sock``, ``conn``, ``stream``, ...) —
+    inside a function with NEITHER deadline discipline (an identifier
+    containing ``timeout``/``deadline``, or a bounding call such as
+    ``settimeout``/``select``) NOR a digest identifier
+    (crc/crc32/sha256/checksum/...) in scope. Either discipline
+    silences; a loop that cannot hang AND cannot tear needs both, which
+    in this repo means: frame it through chunk_stream.
+    """
+    findings: List[RawFinding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        nodes = _own_scope_nodes(fn)
+        sig_names = [a.arg for a in fn.args.args
+                     + fn.args.kwonlyargs
+                     + ([fn.args.vararg] if fn.args.vararg else [])
+                     + ([fn.args.kwarg] if fn.args.kwarg else [])]
+        idents = set(sig_names)
+        bounded = False
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                idents.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr)
+            elif isinstance(n, ast.keyword) and n.arg:
+                idents.add(n.arg)
+            elif isinstance(n, ast.Call) and \
+                    trailing_name(n.func) in DEADLINE_CALL_NAMES:
+                bounded = True
+        if bounded or any(m in i.lower() for i in idents
+                          for m in DEADLINE_NAME_MARKERS):
+            continue
+        if any(m in i.lower() for i in idents
+               for m in DIGEST_NAME_MARKERS):
+            continue
+        for loop in nodes:
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            verb = None
+            for call in _subtree_nodes(loop.body + loop.orelse):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)):
+                    continue
+                name = call.func.attr
+                if name in CHUNK_SOCKET_CALL_NAMES:
+                    verb = name
+                    break
+                if name in CHUNK_AMBIGUOUS_CALL_NAMES:
+                    recv_name = trailing_name(call.func.value) or ""
+                    if any(m in recv_name.lower()
+                           for m in STREAM_RECEIVER_MARKERS):
+                        verb = f"{recv_name}.{name}"
+                        break
+            if verb is None:
+                continue
+            findings.append(RawFinding(
+                loop.lineno, loop.col_offset, "HVD014", "error",
+                f"chunked socket transfer loop ({verb}()) with no "
+                "per-chunk deadline and no CRC/digest check in scope: "
+                "a peer stalling mid-stream hangs the loop forever, "
+                "and a torn/bit-flipped chunk assembles into silently "
+                "corrupt weights/KV the importer admits as real — "
+                "frame the stream through serve/chunk_stream.py "
+                "(per-chunk crc32 + whole-artifact sha256 under the "
+                "transport's deadline-sliced recv), or add either "
+                "discipline and suppress with the reason the other "
+                "cannot apply"))
+    return findings
+
+
 RULES = {
     "HVD001": check_hvd001,
     "HVD002": check_hvd002,
@@ -1177,4 +1276,5 @@ RULES = {
     "HVD011": check_hvd011,
     "HVD012": check_hvd012,
     "HVD013": check_hvd013,
+    "HVD014": check_hvd014,
 }

@@ -1152,6 +1152,127 @@ def _build_serve_step_spec_tp():
         (params, cache.pages, dec, pre)
 
 
+def _build_serve_step_prefill_pool():
+    """The PREFILL pool's compiled tick under disaggregated serving
+    (``FleetConfig.pools``): a prefill replica admits every request
+    with ``prefill_only`` set, so its steady-state step is the
+    chunked-prefill lane ALONE — ``serve_step_prefill`` (engine.py's
+    public alias for the lane both step variants share), jitted over
+    the abstract page pool exactly as the mixed step traces it. The
+    donation stakes are sharpest here: between prefill completion and
+    the decode pool's digest-verified admit, these pages are the only
+    copy of the request's KV, parked in the handoff bay."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step_prefill
+
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), 64, 32, 2, 2,
+                                   8, 32))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    C = cfg.prefill_chunk
+    sds = jax.ShapeDtypeStruct
+    pre = {"tokens": sds((C,), jnp.int32), "start": sds((), jnp.int32),
+           "length": sds((), jnp.int32),
+           "table": sds((pps,), jnp.int32)}
+    fn = jax.jit(functools.partial(serve_step_prefill,
+                                   page_size=cfg.page_size))
+    return (lambda p, pages, pr: fn(p, pages, pr)), \
+        (params, cache.pages, pre)
+
+
+def _build_serve_step_decode_pool(attention: str = "gather"):
+    """The DECODE pool's compiled tick: ``serve_step`` with
+    ``pre=None`` — the engine's decode-only variant, which is what a
+    decode replica runs every step once the pools split (it never
+    prefills; its pages arrive via the KV wire's import). Donation
+    here invalidates the handoff position the import just
+    digest-verified — the admitted pages ARE the request's history."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step
+
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4, attention=attention)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), 64, 32, 2, 2,
+                                   8, 32))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S = cfg.decode_slots
+    sds = jax.ShapeDtypeStruct
+    dec = {"tok": sds((S,), jnp.int32), "pos": sds((S,), jnp.int32),
+           "active": sds((S,), jnp.bool_),
+           "tables": sds((S, pps), jnp.int32)}
+    step = functools.partial(serve_step, page_size=cfg.page_size,
+                             attention=cfg.attention)
+    fn = jax.jit(lambda p, pages, d: step(p, pages, d, None))
+    return (lambda p, pages, d: fn(p, pages, d)), \
+        (params, cache.pages, dec)
+
+
+def _build_serve_step_decode_pool_tp():
+    """The TP-sharded decode-pool tick (``ServeConfig.mesh`` binding a
+    tensor axis on a decode replica): ``serve_step`` with ``pre=None``
+    under shard_map — head-sharded imported pages (the KV wire
+    preserves the shard layout tile-by-tile), Megatron params,
+    replicated control dict and full-vocab logits. Donation of ANY
+    head-shard of an imported page is the same bug, per chip — plus
+    the HVV2xx sweep over the declared specs."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import parallel_lm as plm
+    from horovod_tpu.models.parallel_lm import lm_param_specs
+    from horovod_tpu.serve import PagedKVCache, ServeConfig
+    from horovod_tpu.serve.engine import serve_step
+
+    V, LMAX, LAYERS, H, DH, FFN = _SERVE_TP_GEOM
+    lm = _logical_mesh(_SERVE_TP_MESH)
+    tp_ax = lm.role_axis("tensor")
+    cfg = ServeConfig(page_size=8, num_pages=16, decode_slots=2,
+                      prefill_chunk=4, mesh=_SERVE_TP_MESH)
+    params = jax.eval_shape(
+        lambda: plm.init_lm_params(jax.random.PRNGKey(0), V, LMAX,
+                                   LAYERS, H, DH, FFN))
+    cache = PagedKVCache(params, cfg, abstract=True)
+    pps = cache.pages_per_seq
+    S = cfg.decode_slots
+    sds = jax.ShapeDtypeStruct
+    dec = {"tok": sds((S,), jnp.int32), "pos": sds((S,), jnp.int32),
+           "active": sds((S,), jnp.bool_),
+           "tables": sds((S, pps), jnp.int32)}
+    param_specs = lm_param_specs(LAYERS, tp_ax, vocab_parallel=True)
+    kv = P(None, None, tp_ax, None)
+    step = functools.partial(serve_step, page_size=cfg.page_size,
+                             attention=cfg.attention, tp=tp_ax,
+                             vocab_parallel=True)
+    # pre_logits is None in the decode-only variant; drop it so the
+    # shard_map out_specs match the two real outputs.
+    fn = jax.jit(_shmapped(
+        lambda p, pages, d: step(p, pages, d, None)[:2], lm.mesh,
+        in_specs=(param_specs, kv, P()),
+        out_specs=(kv, P())))
+    return (lambda p, pages, d: fn(p, pages, d)), \
+        (params, cache.pages, dec)
+
+
 def _serve_tp_shardings():
     """HVV201 claims for the TP step: the Megatron param placement +
     the head-sharded page pool, all resolved through the rules table
@@ -1335,6 +1456,39 @@ def _make_registry() -> List[Program]:
         forbid_donation_why=_SPEC_WHY + (
             " — TP edition: head-shards of the window's rows live on "
             "every chip"),
+        shardings=_serve_tp_shardings,
+        logical_mesh=_serve_tp_logical_mesh))
+
+    # The disaggregated pool steps (FleetConfig.pools): the prefill
+    # pool's prefill-lane-only tick and the decode pool's pre=None
+    # tick, each EXACTLY the program a pool replica runs steady-state.
+    # The donation invariant is sharpest across the handoff: between
+    # prefill completion and the decode pool's digest-verified admit,
+    # the parked pages are the only copy of the request's KV.
+    _DISAGG_WHY = _SERVE_WHY + (
+        " — disaggregated edition: across the KV handoff the pages "
+        "are the ONLY copy of the request's history (parked in the "
+        "prefill bay, or just digest-verified into the decode "
+        "allocator); a donating step tears the very bytes the wire's "
+        "CRC/sha256 discipline promises to deliver")
+    progs.append(Program(
+        "serve.step_prefill_pool", "serve",
+        lambda: _build_serve_step_prefill_pool(),
+        forbid_donation=True,
+        forbid_donation_why=_DISAGG_WHY))
+    progs.append(Program(
+        "serve.step_decode_pool", "serve",
+        lambda: _build_serve_step_decode_pool(),
+        forbid_donation=True,
+        forbid_donation_why=_DISAGG_WHY))
+    progs.append(Program(
+        "serve.step_decode_pool_tp", "serve",
+        lambda: _build_serve_step_decode_pool_tp(),
+        forbid_donation=True,
+        forbid_donation_why=_DISAGG_WHY + (
+            " — TP edition: the wire preserves the head-sharded tile "
+            "layout, so every chip holds a shard of each imported "
+            "page"),
         shardings=_serve_tp_shardings,
         logical_mesh=_serve_tp_logical_mesh))
 

@@ -229,6 +229,25 @@ LANES = [
                        "--rate", "8", "--new-min", "16",
                        "--new-max", "256", "--speculate", "4",
                        "--ab-spec", "--require-finished"]),
+    # Disaggregated prefill/decode A/B (round-20 tentpole,
+    # serve/disagg.py + serve/kv_wire.py): the IDENTICAL mixed
+    # long-prefill/short-decode Poisson workload through a colocated
+    # 2-replica fleet, then split 1 prefill + 1 decode — every request
+    # prefills in one pool, ships its finished KV pages over the
+    # chunk-stream wire (per-page [page_size, H, D] tiles, per-chunk
+    # CRC + whole-manifest sha256, resume-from-offset) and decodes in
+    # the other. The bench ABORTS unless every greedy stream is
+    # bit-identical colocated vs disaggregated (and vs lm_decode);
+    # serve.disagg stamps transfers / kv_bytes_shipped / transfer
+    # p50/p99 / TTFT+TBT both sides / disagg_over_colocated p99 TTFT.
+    # Long prefills + short decodes is disaggregation's home turf —
+    # the interference the split removes is prefill chunks stealing
+    # decode ticks.
+    ("serve_disagg_ab", ["tools/serve_bench.py", "--requests", "64",
+                         "--rate", "8", "--prompt-min", "64",
+                         "--prompt-max", "192", "--new-min", "4",
+                         "--new-max", "32", "--pools", "1,1",
+                         "--ab-disagg", "--require-finished"]),
     ("transformer_lm", ["bench.py", "--model", "transformer_lm"]),
     # Adjacent to the dense lane so the A/B shares chip condition: the
     # chunked fused loss removes the step's largest HBM tensor.

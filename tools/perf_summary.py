@@ -310,6 +310,41 @@ def prefix_cell(rec):
     return cell.strip() or "—"
 
 
+def disagg_cell(rec):
+    """Compact render of the record's disaggregated-serving stamps
+    (tools/serve_bench.py --pools/--ab-disagg; horovod_tpu/serve/
+    disagg.py): "1p+1d 8tx 0.09MB tf 16/365ms d/c 13.8" = 1 prefill +
+    1 decode replica, 8 KV-page transfers totalling 0.09 MB over the
+    chunk-stream wire, transfer p50/p99, and the disaggregated side's
+    p99 TTFT over the colocated side's from the A/B (the bench aborts
+    unless the streams were bit-identical, so a rendered cell implies
+    the pin held). Colocated (and pre-disagg) records render as
+    em-dash."""
+    s = rec.get("serve")
+    if not isinstance(s, dict):
+        return "—"
+    d = s.get("disagg")
+    if d is None and isinstance(s.get("fleet"), dict):
+        d = s["fleet"].get("disagg")
+    if not isinstance(d, dict):
+        return "—"
+    pools = d.get("pools") or {}
+    cell = ""
+    if pools:
+        cell = f"{pools.get('prefill', '?')}p+{pools.get('decode', '?')}d"
+    if d.get("transfers") is not None:
+        cell += f" {d['transfers']}tx"
+        if d.get("kv_bytes_shipped"):
+            cell += f" {d['kv_bytes_shipped'] / 1e6:.2f}MB"
+    if d.get("transfer_ms_p50") is not None:
+        p99 = d.get("transfer_ms_p99")
+        p99s = f"{p99:g}" if isinstance(p99, (int, float)) else "?"
+        cell += f" tf {d['transfer_ms_p50']:g}/{p99s}ms"
+    if d.get("disagg_over_colocated") is not None:
+        cell += f" d/c {d['disagg_over_colocated']:g}"
+    return cell.strip() or "—"
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--today", action="store_true",
@@ -318,9 +353,9 @@ def main():
     ok, err = load(args.today)
     print("| lane | value | unit | window | mesh | overlap | wire "
           "| collectives | flash grid | snapshot | elastic | serve "
-          "| fleet | prefix | peak | probe TF | stamp (UTC) |")
+          "| fleet | prefix | disagg | peak | probe TF | stamp (UTC) |")
     print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-          "---|---|")
+          "---|---|---|")
     for lane in sorted(ok):
         stamp, rec = ok[lane]
         peak = rec.get("peak")
@@ -340,6 +375,7 @@ def main():
               f"| {serve_cell(rec)} "
               f"| {fleet_cell(rec)} "
               f"| {prefix_cell(rec)} "
+              f"| {disagg_cell(rec)} "
               f"| {fmt(peak) if peak is not None else '—'} "
               f"| {fmt(probe) if probe is not None else '—'} "
               f"| {stamp[11:19]} |")

@@ -117,6 +117,30 @@ Modes:
               deterministic injector) and ``serve.fleet`` additionally
               stamps ``hosts``/``host_incidents`` on both A/B sides.
 
+  --pools P,D (fleet) split the replicas into a PREFILL pool (P) and a
+              DECODE pool (D) behind the same router — disaggregated
+              serving (horovod_tpu/serve/disagg.py): every admission
+              prefills on the prefill pool, then the finished KV pages
+              ship over the chunk-stream wire (per-chunk crc32, sha256
+              digest-verified commit) to a decode replica picked by
+              the ordinary load keys + prefix-affinity. Implies
+              ``--fleet P+D`` when --fleet is absent; ``serve.fleet``
+              stamps the ``disagg`` block (transfers,
+              kv_bytes_shipped, transfer p50/p99 ms, parked,
+              failures). Composes with --fault-plan: a partition: (or
+              kill:) fault mid-transfer exercises the drain →
+              rebase_for_recompute → requeue recovery, at-most-once
+  --ab-disagg run the IDENTICAL workload on a COLOCATED fleet (same
+              replica count, no pools) then on the DISAGGREGATED
+              pools, ABORT unless every greedy stream is bit-identical
+              across the sides (the handoff is a placement change,
+              never a numerics change), and stamp both +
+              ``serve.disagg`` (kv_bytes_shipped, transfer p50/p99,
+              TTFT/TBT both sides, disagg_over_colocated p99-TTFT).
+              With --fault-plan a THIRD lane runs the disaggregated
+              fleet faulted and the redispatch pin compares it against
+              the clean disaggregated side. Requires --pools; exclusive
+              with the other A/Bs and --rolling-update-at
   --rolling-update-at T
               (fleet only) trigger a mid-run ZERO-DOWNTIME rolling
               weight update at offset T (seconds or % of the arrival
@@ -338,6 +362,37 @@ def pin_prefix_sides(off_reqs, on_reqs):
     return compared
 
 
+def pin_disagg_sides(colo_reqs, dis_reqs):
+    """The --ab-disagg exactness abort: the i-th submitted request
+    must emit the bit-identical greedy stream on the colocated fleet
+    and on the disaggregated pools — the KV handoff ships the SAME
+    pages the prefill produced, so not one token may move. Returns
+    pairs compared."""
+    if len(colo_reqs) != len(dis_reqs):
+        raise SystemExit(
+            f"DISAGG AB PIN FAILED: {len(colo_reqs)} requests "
+            f"colocated vs {len(dis_reqs)} disaggregated")
+    compared = 0
+    for i, (rc, rd) in enumerate(zip(colo_reqs, dis_reqs)):
+        if list(rc.prompt[:rc.orig_prompt_len]) != \
+                list(rd.prompt[:rd.orig_prompt_len]):
+            raise SystemExit(
+                f"DISAGG AB PIN FAILED: request #{i} prompts differ "
+                "across sides (workload must be identical)")
+        if rc.temperature > 0 or \
+                rc.state != "finished" or rd.state != "finished":
+            continue
+        if rc.output != rd.output:
+            raise SystemExit(
+                f"DISAGG AB PIN FAILED: request #{i} "
+                f"colocated={rc.output} disagg={rd.output}")
+        compared += 1
+    if not compared:
+        raise SystemExit("DISAGG AB PIN FAILED: no greedy pairs "
+                         "finished on both sides — nothing compared")
+    return compared
+
+
 def pin_prefix_cold(reqs, page_size, label):
     """The --ab-prefix efficiency pin: group finished requests by
     (route key, serving replica) — EXACTLY ONE request per group may
@@ -542,6 +597,15 @@ def main() -> int:
                          "0 = unbounded)")
     ap.add_argument("--fleet-backoff", type=float, default=0.05,
                     help="relaunch backoff base (doubles per attempt)")
+    ap.add_argument("--pools", default="",
+                    help="disaggregated prefill/decode pools as 'P,D' "
+                         "(replica ids 0..P-1 prefill, the rest "
+                         "decode); implies --fleet P+D")
+    ap.add_argument("--ab-disagg", action="store_true",
+                    help="run colocated then disaggregated on the "
+                         "identical workload; abort unless every "
+                         "greedy stream is bit-identical; stamp "
+                         "serve.disagg (requires --pools)")
     ap.add_argument("--pin-exact", action="store_true",
                     help="assert greedy engine output == lm_decode "
                          "for every finished request")
@@ -595,6 +659,38 @@ def main() -> int:
         if args.speculate < 1:
             ap.error("--ab-spec compares speculation off against on — "
                      "it requires --speculate K with K >= 1")
+    pools = None
+    if args.pools:
+        try:
+            p_n, d_n = (int(x) for x in args.pools.split(","))
+        except ValueError:
+            ap.error(f"--pools must be 'P,D' (two ints), got "
+                     f"{args.pools!r}")
+        if p_n < 1 or d_n < 1:
+            ap.error(f"--pools needs both pools >= 1, got {args.pools}")
+        if args.fleet and args.fleet != p_n + d_n:
+            ap.error(f"--pools {args.pools} must partition --fleet "
+                     f"{args.fleet} exactly (P + D = {p_n + d_n})")
+        args.fleet = args.fleet or (p_n + d_n)
+        pools = {"prefill": p_n, "decode": d_n}
+        if args.ab_spec:
+            # the --ab-spec/--fleet exclusivity check above ran before
+            # --pools implied the fleet
+            ap.error("--pools drives a fleet — exclusive with "
+                     "--ab-spec (one A/B per record)")
+    if args.ab_disagg:
+        if not args.pools:
+            ap.error("--ab-disagg compares colocated against "
+                     "disaggregated pools — it requires --pools P,D")
+        if args.ab or args.static or args.ab_attention or \
+                args.ab_prefix or args.ab_tp or args.ab_spec:
+            ap.error("--ab-disagg is exclusive with --ab/--static/"
+                     "--ab-attention/--ab-prefix/--ab-tp/--ab-spec "
+                     "(one A/B per record)")
+        if args.rolling_update_at:
+            ap.error("--ab-disagg is exclusive with "
+                     "--rolling-update-at (one A/B per record; the "
+                     "faulted third lane composes via --fault-plan)")
     if args.mesh and args.fleet:
         ap.error("--mesh shards ONE engine across chips; the fleet "
                  "router sees each mesh as a single logical replica "
@@ -729,7 +825,7 @@ def main() -> int:
                 rpc_deadline=args.fleet_rpc_deadline,
                 push_chunk_bytes=args.fleet_push_chunk_bytes,
                 push_retries=args.fleet_push_retries,
-                hosts=hosts)
+                hosts=hosts, pools=pools)
         except ValueError as e:
             ap.error(str(e))
 
@@ -739,8 +835,10 @@ def main() -> int:
             update_at = (update_at_s if update_at_s is not None
                          else update_at_frac * horizon)
 
-        def fleet_lane(tag, fault_plan="", update=None, lane_cfg=None):
-            fl, reqs = run_fleet(params, lane_cfg or cfg, fleet_cfg,
+        def fleet_lane(tag, fault_plan="", update=None, lane_cfg=None,
+                       lane_fleet=None):
+            fl, reqs = run_fleet(params, lane_cfg or cfg,
+                                 lane_fleet or fleet_cfg,
                                  workload, fault_plan, update_at=update)
             try:
                 stats = fl.stats()
@@ -771,7 +869,14 @@ def main() -> int:
                           + ("y" if p["retries"] == 1 else "ies"))
                          (f["params_push"])
                          if (f.get("params_push") or {}).get("pushes")
-                         else ""),
+                         else "")
+                      + ((lambda d: f", disagg {d['pools']['prefill']}"
+                          f"p+{d['pools']['decode']}d: "
+                          f"{d['transfers']} KV transfer(s) "
+                          f"{d['kv_bytes_shipped']}B, transfer p50/p99 "
+                          f"{d['transfer_ms_p50']}/"
+                          f"{d['transfer_ms_p99']} ms")(f["disagg"])
+                         if f.get("disagg") else ""),
                       file=sys.stderr, flush=True)
                 if args.pin_exact:
                     pin_exact(params, fl)
@@ -787,7 +892,66 @@ def main() -> int:
                 fl.close()   # one namespaced heartbeat dir per fleet
             return stats, reqs
 
-        if args.ab_prefix:
+        if args.ab_disagg:
+            import dataclasses
+
+            colo, colo_reqs = fleet_lane(
+                f"fleet x{args.fleet} colocated",
+                lane_fleet=dataclasses.replace(fleet_cfg, pools=None))
+            dtag = f"fleet x{args.fleet} disagg {p_n}p+{d_n}d"
+            dis, dis_reqs = fleet_lane(dtag)
+            compared = pin_disagg_sides(colo_reqs, dis_reqs)
+            df = (dis.get("fleet") or {}).get("disagg") or {}
+            if not df.get("transfers"):
+                raise SystemExit(
+                    "DISAGG AB FAILED: the disaggregated side shipped "
+                    f"no KV transfers ({df or 'no disagg block'})")
+            print(f"[serve_bench] disagg pin: {compared} greedy "
+                  "streams bit-identical colocated vs disaggregated "
+                  f"({df['transfers']} KV transfer(s), "
+                  f"{df['kv_bytes_shipped']} bytes shipped)",
+                  file=sys.stderr, flush=True)
+            redispatch_block = None
+            if args.fault_plan:
+                faulted, faulted_reqs = fleet_lane(
+                    f"{dtag} faulted [{args.fault_plan}]",
+                    args.fault_plan)
+                rcompared = pin_redispatch_exact(dis_reqs, faulted_reqs)
+                print(f"[serve_bench] disagg redispatch pin: "
+                      f"{rcompared} greedy streams bit-identical "
+                      "disagg-clean vs disagg-faulted",
+                      file=sys.stderr, flush=True)
+                redispatch_block = {
+                    "fault_plan": args.fault_plan,
+                    "compared": rcompared, "identical": True,
+                    "incidents_by_class": (faulted.get("fleet") or {})
+                    .get("incidents_by_class"),
+                    "redispatched": (faulted.get("fleet") or {})
+                    .get("redispatched"),
+                }
+            c99 = (colo.get("ttft_ms") or {}).get("p99")
+            d99 = (dis.get("ttft_ms") or {}).get("p99")
+            ratio = round(d99 / c99, 3) if c99 and d99 else None
+            mode, headline = "ab_disagg", dis
+            serve = dict(dis, mode="ab_disagg", disagg={
+                "pools": {"prefill": p_n, "decode": d_n},
+                "colocated": colo,
+                "transfers": df.get("transfers"),
+                "kv_bytes_shipped": df.get("kv_bytes_shipped"),
+                "transfer_ms_p50": df.get("transfer_ms_p50"),
+                "transfer_ms_p99": df.get("transfer_ms_p99"),
+                "ttft_ms": dis.get("ttft_ms"),
+                "tbt_ms": dis.get("tbt_ms"),
+                "colocated_ttft_ms": colo.get("ttft_ms"),
+                "colocated_tbt_ms": colo.get("tbt_ms"),
+                "exact_pin": {"compared": compared, "identical": True},
+                "redispatch_pin": redispatch_block,
+                "p99_ttft_colocated_ms": c99,
+                "p99_ttft_disagg_ms": d99,
+                "disagg_over_colocated": ratio,
+            })
+            clean = None
+        elif args.ab_prefix:
             import dataclasses
 
             off, off_reqs = fleet_lane(
@@ -1157,6 +1321,7 @@ def main() -> int:
                 "rolling_update_at": args.rolling_update_at or None,
                 "push_chunk_bytes": args.fleet_push_chunk_bytes,
                 "push_retries": args.fleet_push_retries,
+                "pools": args.pools or None,
             } if args.fleet else None),
         },
     }), flush=True)
